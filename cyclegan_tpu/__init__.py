@@ -1,0 +1,22 @@
+"""cyclegan_tpu — a TPU-native CycleGAN training framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+bryanlimy/tf2-cyclegan (reference mounted at /root/reference):
+
+- ResNet-9 generators + 70x70 PatchGAN discriminators as Flax modules
+  (reference: cyclegan/model.py) with reflection padding and InstanceNorm
+  (XLA-fused, with a Pallas TPU kernel for the fused norm).
+- LSGAN + cycle-consistency + identity losses with the reference's exact
+  gradient semantics (reference: main.py:207-262) fused into a single
+  jitted train step with ONE backward pass.
+- Data parallelism over a `jax.sharding.Mesh` with XLA collectives over
+  ICI/DCN, replacing tf.distribute.MirroredStrategy + NCCL
+  (reference: main.py:370, setup.sh:28).
+- TFDS-compatible input pipeline with folder and synthetic fallbacks
+  (reference: main.py:18-83), per-host sharded for multi-host pods.
+- Single-slot auto-resume checkpointing via Orbax
+  (reference: main.py:148-170) and TensorBoard scalar/image-cycle logging
+  (reference: cyclegan/utils.py).
+"""
+
+__version__ = "0.1.0"

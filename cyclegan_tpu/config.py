@@ -1,0 +1,148 @@
+"""Configuration for the TPU-native CycleGAN framework.
+
+Captures every hyperparameter the reference hard-codes
+(/root/reference/main.py and cyclegan/model.py) in one typed, immutable
+config tree, plus TPU-specific knobs (mesh shape, dtypes, remat) that have
+no reference counterpart.
+
+Reference hard-coded values being captured:
+- image sizes 286 (resize) / 256 (crop): main.py:14-15
+- shuffle buffer 256: main.py:20
+- dataset name: main.py:22
+- lambda_cycle=10.0, lambda_identity=5.0: main.py:116-118
+- Adam lr=2e-4, beta1=0.5, beta2=0.9: main.py:134-145
+- seed 1234: main.py:366-367
+- architecture sizes: model.py:129-134, 172-174
+- CLI defaults (output_dir='runs', epochs=200, batch_size=1, verbose=1):
+  main.py:405-413
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class GeneratorConfig:
+    """ResNet generator architecture (reference model.py:129-169)."""
+
+    filters: int = 64
+    num_downsampling_blocks: int = 2
+    num_residual_blocks: int = 9
+    num_upsample_blocks: int = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class DiscriminatorConfig:
+    """70x70 PatchGAN discriminator architecture (reference model.py:172-213)."""
+
+    filters: int = 64
+    num_downsampling: int = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    generator: GeneratorConfig = GeneratorConfig()
+    discriminator: DiscriminatorConfig = DiscriminatorConfig()
+    image_size: int = 256  # main.py:15
+    channels: int = 3
+    # TPU knobs (no reference counterpart):
+    compute_dtype: str = "float32"  # "bfloat16" for MXU-friendly mixed precision
+    remat: bool = False  # jax.checkpoint residual blocks (512^2 HBM relief)
+    instance_norm_impl: str = "auto"  # "xla" | "pallas" | "auto"
+
+    @property
+    def input_shape(self) -> Tuple[int, int, int]:
+        return (self.image_size, self.image_size, self.channels)
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Four independent Adams (reference main.py:134-145)."""
+
+    learning_rate: float = 2e-4
+    b1: float = 0.5
+    b2: float = 0.9  # NOT the CycleGAN-paper 0.999 — reference quirk
+
+
+@dataclasses.dataclass(frozen=True)
+class LossConfig:
+    """LSGAN + cycle + identity weights (reference main.py:116-118)."""
+
+    lambda_cycle: float = 10.0
+    lambda_identity: float = 5.0  # 0.5 * lambda_cycle
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Input pipeline (reference main.py:18-83)."""
+
+    dataset: str = "horse2zebra"  # main.py:22 ("cycle_gan/horse2zebra")
+    data_dir: Optional[str] = None  # folder with trainA/trainB/testA/testB
+    source: str = "auto"  # "tfds" | "folder" | "synthetic" | "auto"
+    resize_size: int = 286  # main.py:14
+    crop_size: int = 256  # main.py:15
+    shuffle_buffer: int = 256  # main.py:20
+    # Reference quirk: `.cache()` AFTER random augmentation (main.py:53-54)
+    # freezes the augmentations after epoch 1. Reproduced by default;
+    # set False for fresh augmentations every epoch.
+    cache_augmented: bool = True
+    synthetic_train_size: int = 64  # samples per domain when source=synthetic
+    synthetic_test_size: int = 16
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Device mesh layout. Replaces MirroredStrategy (main.py:370)."""
+
+    # Axis names for the mesh; batch is sharded over "data", spatial (H)
+    # over "spatial" when spatial_parallelism > 1 (512^2 HBM relief — the
+    # image-model analog of sequence parallelism).
+    data_axis: str = "data"
+    spatial_axis: str = "spatial"
+    spatial_parallelism: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    output_dir: str = "runs"  # main.py:407
+    epochs: int = 200  # main.py:408
+    batch_size: int = 1  # per-device; global = n_devices * batch_size (main.py:372,409)
+    verbose: int = 1  # main.py:410
+    clear_output_dir: bool = False  # main.py:411
+    seed: int = 1234  # main.py:366-367
+    checkpoint_every: int = 10  # main.py:400
+    plot_samples: int = 5  # main.py:77
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    model: ModelConfig = ModelConfig()
+    optimizer: OptimizerConfig = OptimizerConfig()
+    loss: LossConfig = LossConfig()
+    data: DataConfig = DataConfig()
+    parallel: ParallelConfig = ParallelConfig()
+    train: TrainConfig = TrainConfig()
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def tiny_test_config() -> Config:
+    """A miniature config for fast CPU tests: same topology, tiny sizes."""
+    return Config(
+        model=ModelConfig(
+            generator=GeneratorConfig(filters=4, num_residual_blocks=1),
+            discriminator=DiscriminatorConfig(filters=4),
+            image_size=32,
+        ),
+        data=DataConfig(
+            source="synthetic",
+            resize_size=36,
+            crop_size=32,
+            synthetic_train_size=8,
+            synthetic_test_size=4,
+        ),
+        train=TrainConfig(epochs=1, batch_size=2),
+    )

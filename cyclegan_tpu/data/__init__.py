@@ -1,0 +1,22 @@
+"""Input pipeline: unpaired two-domain image datasets, per-host sharded.
+
+TPU-native re-design of the reference's tf.data/TFDS pipeline
+(/root/reference/main.py:18-83).
+"""
+
+from cyclegan_tpu.data.sources import (
+    FolderSource,
+    SyntheticSource,
+    TFDSSource,
+    resolve_source,
+)
+from cyclegan_tpu.data.pipeline import CycleGANData, build_data
+
+__all__ = [
+    "FolderSource",
+    "SyntheticSource",
+    "TFDSSource",
+    "resolve_source",
+    "CycleGANData",
+    "build_data",
+]

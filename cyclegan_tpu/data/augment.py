@@ -1,0 +1,64 @@
+"""Host-side image preprocessing, matching the reference's semantics.
+
+Reference pipeline (/root/reference/main.py:35-50):
+  train: random_flip_left_right -> resize (286, 286) bilinear ->
+         random_crop (256, 256, 3) -> x/127.5 - 1
+  test:  resize (256, 256) bilinear -> x/127.5 - 1
+
+Bilinear resize uses TF2's half-pixel-center convention. RNG streams are
+index-seeded (numpy Philox), so augmentation is deterministic per
+(seed, epoch, sample) and identical across hosts — statistical, not
+bitwise, parity with TF's stateful RNG (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def normalize_image(img: np.ndarray) -> np.ndarray:
+    """uint8 [0,255] -> float32 [-1, 1] (main.py:35-38)."""
+    return img.astype(np.float32) / 127.5 - 1.0
+
+
+def resize_bilinear(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """Bilinear resize with half-pixel centers (TF2 tf.image.resize
+    default). img: [H, W, C] float32 -> [out_h, out_w, C] float32."""
+    img = np.asarray(img, np.float32)
+    in_h, in_w = img.shape[:2]
+    if (in_h, in_w) == (out_h, out_w):
+        return img
+
+    def coords(out_n, in_n):
+        c = (np.arange(out_n, dtype=np.float32) + 0.5) * (in_n / out_n) - 0.5
+        lo = np.floor(c)
+        frac = c - lo
+        i0 = np.clip(lo, 0, in_n - 1).astype(np.int64)
+        i1 = np.clip(lo + 1, 0, in_n - 1).astype(np.int64)
+        return i0, i1, frac.astype(np.float32)
+
+    y0, y1, fy = coords(out_h, in_h)
+    x0, x1, fx = coords(out_w, in_w)
+    top = img[y0][:, x0] * (1 - fx)[None, :, None] + img[y0][:, x1] * fx[None, :, None]
+    bot = img[y1][:, x0] * (1 - fx)[None, :, None] + img[y1][:, x1] * fx[None, :, None]
+    return top * (1 - fy)[:, None, None] + bot * fy[:, None, None]
+
+
+def preprocess_train(
+    img: np.ndarray, rng: np.random.Generator, resize_size: int = 286, crop_size: int = 256
+) -> np.ndarray:
+    """Random flip -> resize -> random crop -> normalize (main.py:40-45)."""
+    if rng.random() < 0.5:
+        img = img[:, ::-1]
+    img = resize_bilinear(img.astype(np.float32), resize_size, resize_size)
+    max_off = resize_size - crop_size
+    oy = int(rng.integers(0, max_off + 1))
+    ox = int(rng.integers(0, max_off + 1))
+    img = img[oy : oy + crop_size, ox : ox + crop_size]
+    return normalize_image(img)
+
+
+def preprocess_test(img: np.ndarray, crop_size: int = 256) -> np.ndarray:
+    """Resize -> normalize (main.py:47-50)."""
+    img = resize_bilinear(img.astype(np.float32), crop_size, crop_size)
+    return normalize_image(img)

@@ -1,0 +1,211 @@
+"""Two-domain zipped batch pipeline with static shapes and prefetch.
+
+Mirrors the reference pipeline (/root/reference/main.py:18-83):
+- both train domains truncated to min(|trainA|, |trainB|) (main.py:30-31),
+- steps = ceil(n / global_batch) (main.py:32-33),
+- per-domain map -> cache -> shuffle (main.py:53-60); the reference's
+  cache-AFTER-augment quirk (augmentations frozen after epoch 1) is
+  reproduced when `cache_augmented=True` and fixed when False,
+- zip of the two batched domains (main.py:70-74),
+- a 5-pair batch-1 plot set from the test split (main.py:76-77).
+
+TPU-first differences:
+- Every batch has a STATIC shape: the final ragged batch is zero-padded to
+  the global batch size with a {0,1} per-sample weight mask (exact
+  remainder semantics, one compiled program — see parallel/dp.py).
+- Shuffling is a full per-epoch permutation (deterministic, seeded),
+  not tf.data's buffer-256 partial shuffle — a strict improvement with
+  identical training statistics.
+- Per-host sharding for multi-host pods: each process materializes only
+  its 1/process_count slice of every global batch (the DCN input-sharding
+  story, SURVEY.md §2.4), indices deterministic so hosts never disagree.
+- Background-thread prefetch overlaps host preprocessing with device
+  steps (the AUTOTUNE prefetch analog, main.py:72).
+"""
+
+from __future__ import annotations
+
+import math
+import queue
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from cyclegan_tpu.config import Config
+from cyclegan_tpu.data.augment import preprocess_test, preprocess_train
+from cyclegan_tpu.data.sources import Source, resolve_source, split_tag
+
+Batch = Tuple[np.ndarray, np.ndarray, np.ndarray]  # x, y, weights
+
+
+class _Prefetcher:
+    """Tiny background-thread prefetcher (depth-2 queue)."""
+
+    def __init__(self, it: Iterator[Batch], depth: int = 2):
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._sentinel = object()
+        self._err: Optional[BaseException] = None
+
+        def run():
+            try:
+                for item in it:
+                    self._q.put(item)
+            except BaseException as e:  # propagate to consumer
+                self._err = e
+            finally:
+                self._q.put(self._sentinel)
+
+        self._t = threading.Thread(target=run, daemon=True)
+        self._t.start()
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is self._sentinel:
+                if self._err is not None:
+                    raise self._err
+                return
+            yield item
+
+
+class CycleGANData:
+    """Materialized, preprocessed two-domain dataset with epoch iterators."""
+
+    def __init__(self, config: Config, global_batch_size: int, source: Optional[Source] = None):
+        c = config.data
+        self.config = config
+        self.global_batch_size = int(global_batch_size)
+        self.source = source or resolve_source(c)
+        self.seed = config.train.seed
+
+        self.n_train = min(self.source.split_size("trainA"), self.source.split_size("trainB"))
+        self.n_test = min(self.source.split_size("testA"), self.source.split_size("testB"))
+        # ceil(n / global_batch) (main.py:32-33)
+        self.train_steps = math.ceil(self.n_train / self.global_batch_size)
+        self.test_steps = math.ceil(self.n_test / self.global_batch_size)
+
+        try:
+            import jax
+
+            self._process_index = jax.process_index()
+            self._process_count = jax.process_count()
+        except Exception:
+            self._process_index, self._process_count = 0, 1
+
+        # Test split: deterministic preprocessing, cached (main.py:62-68).
+        self._test_a = self._prep_test("testA")
+        self._test_b = self._prep_test("testB")
+
+        # Train split: cache of epoch-0 augmentations (reference quirk,
+        # main.py:53-54) when cache_augmented.
+        self._train_cache: Optional[Tuple[List[np.ndarray], List[np.ndarray]]] = None
+        if c.cache_augmented:
+            self._train_cache = (
+                self._prep_train("trainA", epoch=0),
+                self._prep_train("trainB", epoch=0),
+            )
+
+    # -- preprocessing ---------------------------------------------------
+
+    def _prep_test(self, split: str) -> List[np.ndarray]:
+        c = self.config.data
+        n = self.n_test
+        return [
+            preprocess_test(self.source.load(split, i), c.crop_size) for i in range(n)
+        ]
+
+    def _augment_one(self, split: str, epoch: int, i: int) -> np.ndarray:
+        """Deterministic per-(seed, split, epoch, sample) augmentation —
+        identical on every host, reproducible across restarts."""
+        c = self.config.data
+        rng = np.random.default_rng((self.seed, split_tag(split), epoch, i))
+        return preprocess_train(
+            self.source.load(split, int(i)), rng, c.resize_size, c.crop_size
+        )
+
+    def _prep_train(self, split: str, epoch: int) -> List[np.ndarray]:
+        return [self._augment_one(split, epoch, i) for i in range(self.n_train)]
+
+    # -- iteration -------------------------------------------------------
+
+    def _epoch_order(self, epoch: int, domain: int, n: int) -> np.ndarray:
+        """Deterministic per-epoch, per-domain permutation (the shuffle of
+        main.py:55/60, full-permutation instead of buffer-256)."""
+        rng = np.random.default_rng((self.seed, 0xD0 + domain, epoch))
+        return rng.permutation(n)
+
+    def _host_slice(self, idx: np.ndarray) -> np.ndarray:
+        """This host's contiguous slice of one global batch's indices."""
+        if self._process_count == 1:
+            return idx
+        per_host = len(idx) // self._process_count
+        lo = self._process_index * per_host
+        return idx[lo : lo + per_host]
+
+    def _batches(self, get_a, get_b, order_a: np.ndarray, order_b: np.ndarray) -> Iterator[Batch]:
+        """Yield host-local (x, y, weights) batches, each the 1/P slice of
+        a zero-padded static global batch. `get_a`/`get_b` map a sample
+        index to a preprocessed image and are only called for indices this
+        host owns (lazy: runs inside the prefetch thread, overlapping the
+        device step)."""
+        gbs = self.global_batch_size
+        n = len(order_a)
+        crop = self.config.data.crop_size
+        ch = 3
+        for start in range(0, n, gbs):
+            ga = order_a[start : start + gbs]
+            gb = order_b[start : start + gbs]
+            k = len(ga)
+            weights = np.zeros((gbs,), np.float32)
+            weights[:k] = 1.0
+            # pad index lists to full batch (padded samples masked out)
+            pad = np.zeros((gbs - k,), np.int64)
+            ga = np.concatenate([ga, pad]) if k < gbs else ga
+            gb = np.concatenate([gb, pad]) if k < gbs else gb
+            la, lb = self._host_slice(ga), self._host_slice(gb)
+            wlocal = self._host_slice(weights)
+            x = np.stack([get_a(i) for i in la]).astype(np.float32)
+            y = np.stack([get_b(i) for i in lb]).astype(np.float32)
+            if k < gbs:
+                # zero out padded positions on this host
+                x = x * wlocal[:, None, None, None]
+                y = y * wlocal[:, None, None, None]
+            assert x.shape[1:] == (crop, crop, ch)
+            yield x, y, wlocal
+
+    def train_epoch(self, epoch: int, prefetch: bool = True) -> Iterator[Batch]:
+        if self._train_cache is not None:
+            items_a, items_b = self._train_cache
+            get_a = items_a.__getitem__
+            get_b = items_b.__getitem__
+        else:
+            # Fresh augmentation, lazily per owned index (runs in the
+            # prefetch thread — fixes the reference's frozen-augment quirk
+            # without stalling the device).
+            get_a = lambda i: self._augment_one("trainA", epoch, i)
+            get_b = lambda i: self._augment_one("trainB", epoch, i)
+        it = self._batches(
+            get_a,
+            get_b,
+            self._epoch_order(epoch, 0, self.n_train),
+            self._epoch_order(epoch, 1, self.n_train),
+        )
+        return iter(_Prefetcher(it)) if prefetch else it
+
+    def test_epoch(self, prefetch: bool = True) -> Iterator[Batch]:
+        order = np.arange(self.n_test)
+        it = self._batches(self._test_a.__getitem__, self._test_b.__getitem__, order, order)
+        return iter(_Prefetcher(it)) if prefetch else it
+
+    def plot_pairs(self, k: Optional[int] = None) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """First k test pairs at batch 1 (main.py:76-77)."""
+        k = k if k is not None else self.config.train.plot_samples
+        k = min(k, self.n_test)
+        return [
+            (self._test_a[i][None, ...], self._test_b[i][None, ...]) for i in range(k)
+        ]
+
+
+def build_data(config: Config, global_batch_size: int) -> CycleGANData:
+    return CycleGANData(config, global_batch_size)

@@ -1,0 +1,163 @@
+"""Dataset sources for unpaired image-to-image translation.
+
+The reference hard-wires TFDS `cycle_gan/horse2zebra` with four splits
+trainA/trainB/testA/testB (/root/reference/main.py:22-26). Here a source
+is anything that can produce those four splits as uint8 RGB arrays:
+
+- `TFDSSource`: the same TFDS datasets (horse2zebra, apple2orange,
+  monet2photo, ... — main.py:22 is the only dataset-specific line in the
+  reference), gated on `tensorflow_datasets` being importable.
+- `FolderSource`: a directory with trainA/ trainB/ testA/ testB/ image
+  folders (the standard CycleGAN dataset layout).
+- `SyntheticSource`: deterministic procedurally-generated images for
+  tests/benchmarks and egress-free environments.
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from typing import List, Protocol
+
+import numpy as np
+
+SPLITS = ("trainA", "trainB", "testA", "testB")
+
+
+def split_tag(split: str) -> int:
+    """Stable cross-process tag for a split name (NOT Python's hash(),
+    which is salted per process and would desynchronize hosts)."""
+    return zlib.crc32(split.encode()) & 0xFFFF
+
+
+class Source(Protocol):
+    name: str
+
+    def split_size(self, split: str) -> int: ...
+
+    def load(self, split: str, index: int) -> np.ndarray:
+        """Return one uint8 RGB image [H, W, 3]."""
+        ...
+
+
+class SyntheticSource:
+    """Deterministic synthetic images; index-seeded so every epoch and
+    every host sees identical data without any files."""
+
+    def __init__(self, train_size: int = 64, test_size: int = 16, image_size: int = 256):
+        self.name = "synthetic"
+        self._sizes = {
+            "trainA": train_size,
+            "trainB": train_size,
+            "testA": test_size,
+            "testB": test_size,
+        }
+        self._hw = image_size
+
+    def split_size(self, split: str) -> int:
+        return self._sizes[split]
+
+    def load(self, split: str, index: int) -> np.ndarray:
+        seed = split_tag(split) * 100003 + index
+        rng = np.random.RandomState(seed % (2**31))
+        hw = self._hw
+        # Smooth random blobs rather than white noise so losses behave
+        # like natural images (finite gradients, non-trivial cycles).
+        low = rng.randint(0, 256, size=(8, 8, 3), dtype=np.uint8).astype(np.float32)
+        reps = (hw + 7) // 8
+        img = np.kron(low, np.ones((reps, reps, 1), np.float32))[:hw, :hw]
+        img += rng.randn(hw, hw, 3) * 8.0
+        return np.clip(img, 0, 255).astype(np.uint8)
+
+
+class FolderSource:
+    """trainA/trainB/testA/testB folders of images under `root`."""
+
+    EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+
+    def __init__(self, root: str):
+        self.name = f"folder:{root}"
+        self.root = root
+        self._files = {}
+        for split in SPLITS:
+            d = os.path.join(root, split)
+            if not os.path.isdir(d):
+                raise FileNotFoundError(f"missing split directory: {d}")
+            files = sorted(
+                os.path.join(d, f)
+                for f in os.listdir(d)
+                if f.lower().endswith(self.EXTS)
+            )
+            if not files:
+                raise FileNotFoundError(f"no images in {d}")
+            self._files[split] = files
+
+    def split_size(self, split: str) -> int:
+        return len(self._files[split])
+
+    def load(self, split: str, index: int) -> np.ndarray:
+        path = self._files[split][index]
+        if path.endswith(".npy"):
+            arr = np.load(path)
+        else:
+            from PIL import Image
+
+            with Image.open(path) as im:
+                arr = np.asarray(im.convert("RGB"))
+        if arr.dtype != np.uint8:
+            arr = np.clip(arr, 0, 255).astype(np.uint8)
+        return arr
+
+
+class TFDSSource:
+    """TFDS cycle_gan/<name> (reference main.py:22-26), import-gated."""
+
+    def __init__(self, dataset: str = "horse2zebra", data_dir: str | None = None):
+        try:
+            import tensorflow_datasets as tfds
+        except ImportError as e:  # pragma: no cover - env without TFDS
+            raise ImportError(
+                "tensorflow_datasets is not available; use a FolderSource "
+                "(--data_dir) or SyntheticSource (--data_source synthetic)"
+            ) from e
+        self.name = f"tfds:cycle_gan/{dataset}"
+        builder = tfds.builder(f"cycle_gan/{dataset}", data_dir=data_dir)
+        builder.download_and_prepare()
+        self._splits = {}
+        self._sizes = {}
+        for split in SPLITS:
+            ds = builder.as_dataset(split=split, as_supervised=True)
+            # Label discarded, as in reference main.py:40.
+            self._splits[split] = [np.asarray(img) for img, _ in ds.as_numpy_iterator()]
+            self._sizes[split] = len(self._splits[split])
+
+    def split_size(self, split: str) -> int:
+        return self._sizes[split]
+
+    def load(self, split: str, index: int) -> np.ndarray:
+        return self._splits[split][index]
+
+
+def resolve_source(data_config) -> Source:
+    """Pick a source per config: explicit, else folder if data_dir given,
+    else TFDS if importable, else synthetic."""
+    c = data_config
+
+    def synthetic():
+        return SyntheticSource(
+            c.synthetic_train_size, c.synthetic_test_size, image_size=c.crop_size
+        )
+
+    if c.source == "synthetic":
+        return synthetic()
+    if c.source == "folder" or (c.source == "auto" and c.data_dir):
+        if not c.data_dir:
+            raise ValueError("--data_source folder requires --data_dir")
+        return FolderSource(c.data_dir)
+    if c.source == "tfds":
+        return TFDSSource(c.dataset, data_dir=c.data_dir)
+    # auto without data_dir: try TFDS, fall back to synthetic
+    try:
+        return TFDSSource(c.dataset)
+    except ImportError:
+        return synthetic()

@@ -1,0 +1,22 @@
+"""Flax model zoo: ResNet generators and PatchGAN discriminators.
+
+TPU-native re-design of /root/reference/cyclegan/model.py.
+"""
+
+from cyclegan_tpu.models.modules import (
+    InstanceNorm,
+    ResidualBlock,
+    Downsample,
+    Upsample,
+)
+from cyclegan_tpu.models.generator import ResNetGenerator
+from cyclegan_tpu.models.discriminator import PatchGANDiscriminator
+
+__all__ = [
+    "InstanceNorm",
+    "ResidualBlock",
+    "Downsample",
+    "Upsample",
+    "ResNetGenerator",
+    "PatchGANDiscriminator",
+]

@@ -1,0 +1,74 @@
+"""70x70 PatchGAN discriminator as a Flax module.
+
+TPU-native equivalent of the reference's `get_discriminator`
+(/root/reference/cyclegan/model.py:172-213):
+
+  Conv4x4 s2 -> 64 (WITH bias — Keras default), LeakyReLU(0.2)
+  3 downsample blocks (no bias): 128 s2, 256 s2, 512 s1, each IN + LeakyReLU(0.2)
+  Conv4x4 s1 -> 1 (SAME, with bias), no activation — raw logits
+
+Output is a 32x32x1 patch map for 256^2 input; ~2.77M parameters.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from cyclegan_tpu.config import DiscriminatorConfig
+from cyclegan_tpu.models.modules import Downsample, init_normal
+
+
+class PatchGANDiscriminator(nn.Module):
+    config: DiscriminatorConfig = DiscriminatorConfig()
+    dtype: Optional[Any] = None
+    norm_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        in_dtype = x.dtype
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+        leaky = functools.partial(nn.leaky_relu, negative_slope=0.2)
+
+        # Stem (model.py:179-186): bias on, no norm
+        y = nn.Conv(
+            cfg.filters,
+            (4, 4),
+            strides=(2, 2),
+            padding="SAME",
+            use_bias=True,
+            kernel_init=init_normal,
+            dtype=self.dtype,
+        )(x)
+        y = leaky(y)
+
+        # Downsampling trunk (model.py:188-205): strides 2, 2, then 1
+        filters = cfg.filters
+        for i in range(cfg.num_downsampling):
+            filters *= 2
+            strides = (2, 2) if i < 2 else (1, 1)
+            y = Downsample(
+                filters,
+                kernel_size=(4, 4),
+                strides=strides,
+                activation=leaky,
+                dtype=self.dtype,
+                norm_impl=self.norm_impl,
+            )(y)
+
+        # Patch logits head (model.py:207-211): bias on, no activation
+        y = nn.Conv(
+            1,
+            (4, 4),
+            strides=(1, 1),
+            padding="SAME",
+            use_bias=True,
+            kernel_init=init_normal,
+            dtype=self.dtype,
+        )(y)
+        return y.astype(in_dtype)

@@ -1,0 +1,139 @@
+"""Building-block Flax modules for the CycleGAN model zoo.
+
+TPU-native equivalents of the reference's Keras blocks
+(/root/reference/cyclegan/model.py:36-126). Parameters are kept in
+float32; compute may run in bfloat16 (`dtype`) so convs hit the MXU at
+full rate while instance-norm statistics stay in float32.
+
+Initialization matches the reference: conv kernels and instance-norm
+gamma ~ N(0, 0.02) (model.py:10-11 — note gamma centred at 0, a
+reference quirk reproduced deliberately), biases/betas zero.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from cyclegan_tpu.ops.norm import instance_norm
+from cyclegan_tpu.ops.padding import reflect_pad
+
+Dtype = Any
+
+# N(0, 0.02) for conv kernels and IN gammas (reference model.py:10-11).
+init_normal = nn.initializers.normal(stddev=0.02)
+
+
+class InstanceNorm(nn.Module):
+    """Learned instance normalization (reference: tfa InstanceNormalization).
+
+    eps=1e-3 matches tfa's GroupNormalization default; gamma init
+    N(0, 0.02) matches model.py:11.
+    """
+
+    eps: float = 1e-3
+    impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        ch = x.shape[-1]
+        scale = self.param("scale", init_normal, (ch,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros_init(), (ch,), jnp.float32)
+        return instance_norm(x, scale, bias, eps=self.eps, impl=self.impl)
+
+
+class ResidualBlock(nn.Module):
+    """reflect-pad(1) > Conv3x3 valid > IN > ReLU > reflect-pad(1) > Conv3x3
+    > IN > +skip  (reference model.py:36-74). Filters inferred from input
+    channels (model.py:46); convs have no bias (model.py:44).
+    """
+
+    dtype: Optional[Dtype] = None
+    norm_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        filters = x.shape[-1]
+        y = reflect_pad(x, 1)
+        y = nn.Conv(
+            filters,
+            (3, 3),
+            padding="VALID",
+            use_bias=False,
+            kernel_init=init_normal,
+            dtype=self.dtype,
+        )(y)
+        y = InstanceNorm(impl=self.norm_impl)(y)
+        y = nn.relu(y)
+        y = reflect_pad(y, 1)
+        y = nn.Conv(
+            filters,
+            (3, 3),
+            padding="VALID",
+            use_bias=False,
+            kernel_init=init_normal,
+            dtype=self.dtype,
+        )(y)
+        y = InstanceNorm(impl=self.norm_impl)(y)
+        return x + y
+
+
+class Downsample(nn.Module):
+    """Conv (stride 2 default, SAME, no bias) > IN > optional activation
+    (reference model.py:77-100).
+    """
+
+    filters: int
+    kernel_size: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (2, 2)
+    activation: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = nn.relu
+    dtype: Optional[Dtype] = None
+    norm_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        y = nn.Conv(
+            self.filters,
+            self.kernel_size,
+            strides=self.strides,
+            padding="SAME",
+            use_bias=False,
+            kernel_init=init_normal,
+            dtype=self.dtype,
+        )(x)
+        y = InstanceNorm(impl=self.norm_impl)(y)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+
+class Upsample(nn.Module):
+    """ConvTranspose (3x3, stride 2, SAME, no bias) > IN > optional
+    activation (reference model.py:103-126). Output spatial dims exactly
+    double the input, matching TF Conv2DTranspose SAME semantics.
+    """
+
+    filters: int
+    kernel_size: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (2, 2)
+    activation: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = nn.relu
+    dtype: Optional[Dtype] = None
+    norm_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        y = nn.ConvTranspose(
+            self.filters,
+            self.kernel_size,
+            strides=self.strides,
+            padding="SAME",
+            use_bias=False,
+            kernel_init=init_normal,
+            dtype=self.dtype,
+        )(x)
+        y = InstanceNorm(impl=self.norm_impl)(y)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y

@@ -1,0 +1,11 @@
+"""TPU-native primitive ops: reflection padding, instance normalization.
+
+XLA lowers these to fused elementwise/reduction HLO; a Pallas kernel is
+provided for the fused instance-norm path where measurement shows XLA
+fusion is poor.
+"""
+
+from cyclegan_tpu.ops.padding import reflect_pad
+from cyclegan_tpu.ops.norm import instance_norm
+
+__all__ = ["reflect_pad", "instance_norm"]

@@ -1,0 +1,84 @@
+"""Instance normalization for NHWC tensors.
+
+TPU-native equivalent of tfa.layers.InstanceNormalization as used by the
+reference in every conv block (/root/reference/cyclegan/model.py:58, 71,
+96, 122, 143): per-sample, per-channel statistics over the spatial dims,
+learned gamma/beta, epsilon 1e-3 (tfa GroupNormalization default).
+
+Statistics are per-(N, C), so data-parallel batch sharding is
+semantics-free — no cross-replica moments, unlike batch norm. Statistics
+are always computed in float32 even under bfloat16 compute.
+
+Two implementations:
+- "xla": jnp reductions; XLA fuses mean/var/normalize into the surrounding
+  elementwise graph.
+- "pallas": a fused single-pass Pallas TPU kernel (ops/pallas/norm_kernel.py)
+  for the cases where XLA's fusion leaves the activation in HBM between the
+  moment pass and the normalize pass.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _instance_norm_xla(
+    x: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    eps: float,
+) -> jnp.ndarray:
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=(1, 2), keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=(1, 2), keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    y = (xf - mean) * inv
+    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(orig_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "impl"))
+def instance_norm(
+    x: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    eps: float = 1e-3,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Normalize x over its spatial dims per (sample, channel).
+
+    Args:
+      x: [N, H, W, C] activations.
+      scale: [C] learned gamma (reference init N(0, 0.02) — model.py:11).
+      bias: [C] learned beta (zeros init).
+      eps: numerical epsilon; 1e-3 matches tfa's default.
+      impl: "xla" | "pallas" | "auto". "auto" uses the Pallas kernel on TPU
+        when the shape is tileable, else XLA.
+    """
+    if impl == "pallas" or (impl == "auto" and _pallas_eligible(x)):
+        from cyclegan_tpu.ops.pallas.norm_kernel import instance_norm_pallas
+
+        try:
+            return instance_norm_pallas(x, scale, bias, eps=eps)
+        except NotImplementedError:
+            pass
+    return _instance_norm_xla(x, scale, bias, eps)
+
+
+def _pallas_eligible(x: jnp.ndarray) -> bool:
+    """Use the Pallas kernel only on TPU backends with lane-aligned channels."""
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return False
+    if backend not in ("tpu",):
+        return False
+    if x.ndim != 4:
+        return False
+    # One (H, W) slab per (n, c) grid step must fit VMEM comfortably.
+    h, w = x.shape[1], x.shape[2]
+    return h * w * 4 <= 4 * 1024 * 1024

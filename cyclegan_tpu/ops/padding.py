@@ -1,0 +1,30 @@
+"""Reflection padding for NHWC tensors.
+
+TPU-native equivalent of the reference's ReflectionPadding2D Keras layer
+(/root/reference/cyclegan/model.py:14-33), which wraps
+tf.pad(mode="REFLECT") with paddings [[0,0],[p,p],[p,p],[0,0]].
+
+Here it is a pure function; `jnp.pad(mode="reflect")` lowers to XLA
+slice+reverse+concat which fuses into the consumer conv's input.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def reflect_pad(x: jnp.ndarray, pad: int | tuple[int, int]) -> jnp.ndarray:
+    """Reflect-pad the spatial (H, W) dims of an NHWC tensor.
+
+    Matches tf.pad(..., mode="REFLECT"): the border pixel is NOT repeated
+    (numpy's "reflect" mode, not "symmetric").
+
+    Args:
+      x: [N, H, W, C] tensor.
+      pad: padding amount, a single int or (pad_h, pad_w).
+    """
+    if isinstance(pad, int):
+        ph = pw = pad
+    else:
+        ph, pw = pad
+    return jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)), mode="reflect")

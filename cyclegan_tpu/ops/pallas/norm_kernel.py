@@ -1,0 +1,18 @@
+"""Fused single-pass Pallas TPU kernel for instance normalization.
+
+Placeholder: implemented in the kernel milestone. `instance_norm` in
+ops/norm.py falls back to the XLA implementation until then.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def instance_norm_pallas(
+    x: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    eps: float = 1e-3,
+) -> jnp.ndarray:
+    raise NotImplementedError("Pallas instance-norm kernel not yet implemented")

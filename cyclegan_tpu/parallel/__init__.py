@@ -1,0 +1,31 @@
+"""Distributed runtime: device mesh + sharded steps over XLA collectives.
+
+Replaces the reference's L1 layer — tf.distribute.MirroredStrategy over
+NCCL (/root/reference/main.py:370, setup.sh:28) — with a
+`jax.sharding.Mesh`, batch-sharded global arrays, and XLA all-reduces
+over ICI/DCN.
+"""
+
+from cyclegan_tpu.parallel.mesh import (
+    MeshPlan,
+    make_mesh_plan,
+    batch_sharding,
+    replicated,
+)
+from cyclegan_tpu.parallel.dp import (
+    shard_train_step,
+    shard_test_step,
+    shard_batch,
+    pad_to_global_batch,
+)
+
+__all__ = [
+    "MeshPlan",
+    "make_mesh_plan",
+    "batch_sharding",
+    "replicated",
+    "shard_train_step",
+    "shard_test_step",
+    "shard_batch",
+    "pad_to_global_batch",
+]

@@ -1,0 +1,87 @@
+"""Device-mesh construction and sharding layouts.
+
+The mesh is 2-D: ("data", "spatial").
+
+- "data": the batch axis — the TPU-native replacement for
+  MirroredStrategy's replica set (reference main.py:370-372). Gradients
+  all-reduce over this axis via XLA (`psum` under shard_map, or
+  compiler-inserted collectives under jit), riding ICI within a slice and
+  DCN across hosts — no NCCL (reference setup.sh:28).
+- "spatial": optional sharding of the image-height axis for the 512^2
+  config (BASELINE.md) — the image-model analog of sequence/context
+  parallelism. XLA SPMD inserts halo exchanges for spatially-partitioned
+  convolutions automatically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cyclegan_tpu.config import ParallelConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    mesh: Mesh
+    data_axis: str
+    spatial_axis: str
+
+    @property
+    def n_data(self) -> int:
+        return self.mesh.shape[self.data_axis]
+
+    @property
+    def n_spatial(self) -> int:
+        return self.mesh.shape[self.spatial_axis]
+
+    @property
+    def n_devices(self) -> int:
+        return self.n_data * self.n_spatial
+
+    def batch_spec(self) -> P:
+        """Images: batch over "data", H over "spatial" (NHWC)."""
+        if self.n_spatial > 1:
+            return P(self.data_axis, self.spatial_axis, None, None)
+        return P(self.data_axis)
+
+    def weight_spec(self) -> P:
+        """Per-sample weights: [N] over "data"."""
+        return P(self.data_axis)
+
+
+def make_mesh_plan(
+    config: Optional[ParallelConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> MeshPlan:
+    """Build the mesh over all (or given) devices.
+
+    Degrades gracefully to a 1x1 mesh on a single device, the analog of
+    MirroredStrategy's single-replica fallback (SURVEY.md §4).
+    """
+    config = config or ParallelConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sp = max(1, config.spatial_parallelism)
+    if n % sp != 0:
+        raise ValueError(f"{n} devices not divisible by spatial_parallelism={sp}")
+    dp = n // sp
+    dev_array = np.asarray(devices).reshape(dp, sp)
+    mesh = Mesh(dev_array, (config.data_axis, config.spatial_axis))
+    return MeshPlan(mesh=mesh, data_axis=config.data_axis, spatial_axis=config.spatial_axis)
+
+
+def batch_sharding(plan: MeshPlan) -> NamedSharding:
+    return NamedSharding(plan.mesh, plan.batch_spec())
+
+
+def weight_sharding(plan: MeshPlan) -> NamedSharding:
+    return NamedSharding(plan.mesh, plan.weight_spec())
+
+
+def replicated(plan: MeshPlan) -> NamedSharding:
+    return NamedSharding(plan.mesh, P())

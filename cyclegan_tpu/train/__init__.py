@@ -1,0 +1,17 @@
+"""Training engine: functional state, fused jitted steps, epoch loops."""
+
+from cyclegan_tpu.train.state import CycleGANState, create_state, build_models
+from cyclegan_tpu.train.steps import (
+    make_train_step,
+    make_test_step,
+    make_cycle_step,
+)
+
+__all__ = [
+    "CycleGANState",
+    "create_state",
+    "build_models",
+    "make_train_step",
+    "make_test_step",
+    "make_cycle_step",
+]

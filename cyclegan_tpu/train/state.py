@@ -1,0 +1,94 @@
+"""Functional training state for CycleGAN.
+
+Replaces the reference's stateful `CycleGAN` object (/root/reference/
+main.py:106-155) — four Keras models + four tf.keras Adam optimizers
+living under a `strategy.scope()` — with a single immutable pytree of
+four param trees and four optax Adam states. The whole state threads
+through one jitted step function and shards over a `jax.sharding.Mesh`
+with no strategy scopes or variable mirroring.
+
+Naming follows the reference (main.py:128-131):
+  G: X->Y generator     F: Y->X generator
+  d_x: judges domain-X realism (reference `dis_X`)
+  d_y: judges domain-Y realism (reference `dis_Y`)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from cyclegan_tpu.config import Config
+from cyclegan_tpu.models import PatchGANDiscriminator, ResNetGenerator
+
+
+class CycleGANState(struct.PyTreeNode):
+    step: jnp.ndarray
+    g_params: Any
+    f_params: Any
+    dx_params: Any
+    dy_params: Any
+    g_opt: Any
+    f_opt: Any
+    dx_opt: Any
+    dy_opt: Any
+
+
+def make_optimizer(config: Config) -> optax.GradientTransformation:
+    """Adam(2e-4, b1=0.5, b2=0.9) as in reference main.py:134-145.
+
+    eps=1e-7 matches the Keras Adam default used by the reference.
+    """
+    opt = config.optimizer
+    return optax.adam(opt.learning_rate, b1=opt.b1, b2=opt.b2, eps=1e-7)
+
+
+def build_models(
+    config: Config,
+) -> Tuple[ResNetGenerator, PatchGANDiscriminator]:
+    """One generator module and one discriminator module definition.
+
+    The same module definition is applied with two independent param trees
+    (G/F and d_x/d_y) — the functional equivalent of the reference
+    building four Keras models (main.py:128-131).
+    """
+    m = config.model
+    dtype = jnp.bfloat16 if m.compute_dtype == "bfloat16" else None
+    gen = ResNetGenerator(
+        config=m.generator,
+        out_channels=m.channels,
+        dtype=dtype,
+        remat=m.remat,
+        norm_impl=m.instance_norm_impl,
+    )
+    disc = PatchGANDiscriminator(
+        config=m.discriminator, dtype=dtype, norm_impl=m.instance_norm_impl
+    )
+    return gen, disc
+
+
+def create_state(config: Config, rng: jax.Array) -> CycleGANState:
+    """Initialize the four networks and four optimizer states."""
+    gen, disc = build_models(config)
+    dummy = jnp.zeros((1, *config.model.input_shape), jnp.float32)
+    rg, rf, rdx, rdy = jax.random.split(rng, 4)
+    g_params = gen.init(rg, dummy)
+    f_params = gen.init(rf, dummy)
+    dx_params = disc.init(rdx, dummy)
+    dy_params = disc.init(rdy, dummy)
+    tx = make_optimizer(config)
+    return CycleGANState(
+        step=jnp.zeros((), jnp.int32),
+        g_params=g_params,
+        f_params=f_params,
+        dx_params=dx_params,
+        dy_params=dy_params,
+        g_opt=tx.init(g_params),
+        f_opt=tx.init(f_params),
+        dx_opt=tx.init(dx_params),
+        dy_opt=tx.init(dy_params),
+    )

@@ -1,0 +1,24 @@
+"""Platform selection hardening.
+
+Some environments install a sitecustomize that registers an out-of-tree
+PJRT plugin and force-overrides `jax_platforms` at interpreter start,
+defeating the `JAX_PLATFORMS` env var. Calling `ensure_platform_from_env`
+before the first device query re-asserts the user's choice so CPU-only
+runs (tests, dry runs) never touch accelerator tunnels.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_platform_from_env() -> None:
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", plat)
+    except Exception:
+        pass

@@ -1,0 +1,36 @@
+"""Cycle-panel plotting, every checkpoint epoch.
+
+Equivalent of the reference's `plot_cycle` (/root/reference/cyclegan/
+utils.py:112-145): run the inference cycle over the 5-pair plot set,
+rescale to uint8 via (x + 1) * 127.5, and emit the two panel families
+  X_cycle = [X, G(X), F(G(X))]   and   Y_cycle = [Y, F(Y), G(F(Y))].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cyclegan_tpu.utils.summary import Summary
+
+
+def to_uint8(x: np.ndarray) -> np.ndarray:
+    """[-1, 1] float -> uint8 (reference utils.py:127-131)."""
+    return np.clip((np.asarray(x, np.float32) + 1.0) * 127.5, 0, 255).astype(np.uint8)
+
+
+def plot_cycle(plot_pairs, cycle_fn, state, summary: Summary, epoch: int) -> None:
+    """cycle_fn: (state, x, y) -> (fake_x, fake_y, cycle_x, cycle_y)
+    (the jitted inference step, train/steps.py make_cycle_step)."""
+    x_rows, y_rows = [], []
+    for x, y in plot_pairs:
+        fake_x, fake_y, cycle_x, cycle_y = cycle_fn(state, x, y)
+        x_rows.append(np.stack([to_uint8(x[0]), to_uint8(fake_y[0]), to_uint8(cycle_x[0])]))
+        y_rows.append(np.stack([to_uint8(y[0]), to_uint8(fake_x[0]), to_uint8(cycle_y[0])]))
+    x_cycle = np.stack(x_rows)  # [n, 3, H, W, C]
+    y_cycle = np.stack(y_rows)
+    summary.image_cycle(
+        "X_cycle", x_cycle, titles=["X", "G(X)", "F(G(X))"], step=epoch, training=False
+    )
+    summary.image_cycle(
+        "Y_cycle", y_cycle, titles=["Y", "F(Y)", "G(F(Y))"], step=epoch, training=False
+    )

@@ -1,0 +1,97 @@
+"""TensorBoard logging with split train/test writers.
+
+Equivalent of the reference's `Summary` helper (/root/reference/cyclegan/
+utils.py:14-99): train events in `output_dir`, test events in
+`output_dir/test` so TensorBoard overlays them; scalar, image, and
+matplotlib-figure summaries under the same tag names.
+
+Implemented over tensorboardX (pure-Python event writer) — no TF runtime
+in the logging path.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Optional
+
+import numpy as np
+
+
+class Summary:
+    """Two event writers: index 0 = train (output_dir), 1 = test
+    (output_dir/test) (reference utils.py:21-24)."""
+
+    def __init__(self, output_dir: str):
+        from tensorboardX import SummaryWriter
+
+        self.output_dir = output_dir
+        os.makedirs(output_dir, exist_ok=True)
+        self._writers = [
+            SummaryWriter(output_dir),
+            SummaryWriter(os.path.join(output_dir, "test")),
+        ]
+
+    def _writer(self, training: bool):
+        return self._writers[0 if training else 1]
+
+    def scalar(self, tag: str, value, step: int, training: bool = True) -> None:
+        self._writer(training).add_scalar(tag, float(value), global_step=step)
+
+    def image(self, tag: str, image: np.ndarray, step: int, training: bool = True) -> None:
+        """image: [H, W, C] or [N, H, W, C] uint8."""
+        w = self._writer(training)
+        if image.ndim == 4:
+            for i, im in enumerate(image):
+                w.add_image(f"{tag}/{i}", im, global_step=step, dataformats="HWC")
+        else:
+            w.add_image(tag, image, global_step=step, dataformats="HWC")
+
+    def figure(
+        self,
+        tag: str,
+        figure,
+        step: int,
+        training: bool = True,
+        close: bool = True,
+    ) -> None:
+        """Render a matplotlib figure into an image summary
+        (reference utils.py:39-59)."""
+        import matplotlib.pyplot as plt
+
+        buf = io.BytesIO()
+        figure.savefig(buf, dpi=120, format="png", bbox_inches="tight")
+        buf.seek(0)
+        from PIL import Image
+
+        arr = np.asarray(Image.open(buf).convert("RGB"))
+        self.image(tag, arr, step=step, training=training)
+        if close:
+            plt.close(figure)
+
+    def image_cycle(
+        self,
+        tag: str,
+        images: np.ndarray,
+        titles: Optional[list] = None,
+        step: int = 0,
+        training: bool = False,
+    ) -> None:
+        """One 1x3 panel row per sample: [input, translated, cycled]
+        (reference utils.py:61-99)."""
+        import matplotlib.pyplot as plt
+
+        titles = titles or ["X", "G(X)", "F(G(X))"]
+        n = images.shape[0]
+        for i in range(n):
+            fig, axes = plt.subplots(1, 3, figsize=(9, 3.2), dpi=120)
+            for j, ax in enumerate(axes):
+                ax.imshow(images[i, j])
+                ax.set_title(titles[j], fontsize=10)
+                ax.axis("off")
+            fig.tight_layout()
+            self.figure(f"{tag}/{i}", fig, step=step, training=training)
+
+    def close(self) -> None:
+        for w in self._writers:
+            w.close()

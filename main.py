@@ -1,0 +1,150 @@
+"""Train CycleGAN on a TPU mesh.
+
+CLI-compatible with the reference entry point (/root/reference/
+main.py:405-413): the same five flags with the same defaults
+(--output_dir, --epochs, --batch_size, --verbose, --clear_output_dir),
+plus TPU-framework extensions (dataset/source selection, mixed precision,
+spatial parallelism, remat) that default to reference behavior.
+
+Orchestration mirrors reference main() (main.py:358-402): clear/create
+output dir, seed, build mesh (replacing MirroredStrategy), global batch =
+n_data_shards * per-device batch, Summary writers, datasets, state,
+auto-resume, epoch loop with checkpoint + cycle plots every 10 epochs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from shutil import rmtree
+from time import time
+
+import jax
+import numpy as np
+
+from cyclegan_tpu.utils.platform import ensure_platform_from_env
+
+
+def main(args: argparse.Namespace) -> None:
+    ensure_platform_from_env()
+    from cyclegan_tpu.config import (
+        Config,
+        DataConfig,
+        ModelConfig,
+        ParallelConfig,
+        TrainConfig,
+    )
+    from cyclegan_tpu.data import build_data
+    from cyclegan_tpu.parallel import make_mesh_plan, shard_test_step, shard_train_step
+    from cyclegan_tpu.train import create_state, make_cycle_step, make_test_step, make_train_step
+    from cyclegan_tpu.train import loop
+    from cyclegan_tpu.utils import Summary, plot_cycle
+    from cyclegan_tpu.utils.checkpoint import Checkpointer
+
+    if args.clear_output_dir and os.path.exists(args.output_dir):
+        rmtree(args.output_dir)
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    config = Config(
+        model=ModelConfig(
+            compute_dtype="bfloat16" if args.bf16 else "float32",
+            remat=args.remat,
+            image_size=args.image_size,
+        ),
+        data=DataConfig(
+            dataset=args.dataset,
+            data_dir=args.data_dir,
+            source=args.data_source,
+            cache_augmented=not args.fresh_augment,
+            crop_size=args.image_size,
+            resize_size=int(args.image_size * 286 / 256),
+            synthetic_train_size=args.synthetic_train_size,
+            synthetic_test_size=args.synthetic_test_size,
+        ),
+        parallel=ParallelConfig(spatial_parallelism=args.spatial_parallelism),
+        train=TrainConfig(
+            output_dir=args.output_dir,
+            epochs=args.epochs,
+            batch_size=args.batch_size,
+            verbose=args.verbose,
+            clear_output_dir=args.clear_output_dir,
+        ),
+    )
+
+    np.random.seed(config.train.seed)
+
+    # Device mesh — replaces MirroredStrategy (reference main.py:370-373).
+    plan = make_mesh_plan(config.parallel)
+    global_batch_size = plan.n_data * config.train.batch_size
+    print(f"Devices: {plan.n_devices} ({plan.n_data} data x {plan.n_spatial} spatial), "
+          f"global batch size: {global_batch_size}")
+
+    summary = Summary(config.train.output_dir)
+    data = build_data(config, global_batch_size)
+    print(f"Dataset {data.source.name}: {data.n_train} train / {data.n_test} test pairs, "
+          f"{data.train_steps} train steps, {data.test_steps} test steps per epoch")
+
+    state = create_state(config, jax.random.PRNGKey(config.train.seed))
+
+    # Auto-resume from the single checkpoint slot (reference main.py:383).
+    ckpt = Checkpointer(config.train.output_dir)
+    state, start_epoch, resumed = ckpt.restore_if_exists(state)
+    if resumed:
+        print(f"Resumed from {ckpt.slot} at epoch {start_epoch}")
+
+    train_step = shard_train_step(plan, make_train_step(config, global_batch_size))
+    test_step = shard_test_step(plan, make_test_step(config, global_batch_size))
+    cycle_step = jax.jit(make_cycle_step(config))
+
+    for epoch in range(start_epoch, config.train.epochs):
+        print(f"Epoch {epoch + 1:03d}/{config.train.epochs:03d}")
+        start = time()
+        state = loop.train_epoch(config, data, plan, train_step, state, summary, epoch)
+        results = loop.test_epoch(config, data, plan, test_step, state, summary, epoch)
+        elapse = time() - start
+        summary.scalar("elapse", elapse, step=epoch)
+        summary.scalar(
+            "images_per_sec",
+            loop.images_per_sec(2 * data.n_train, elapse),
+            step=epoch,
+        )
+        loop.print_epoch_summary(results, elapse)
+
+        if epoch % config.train.checkpoint_every == 0 or epoch == config.train.epochs - 1:
+            ckpt.save(state, epoch)
+            print(f"saved checkpoint to {ckpt.slot}")
+            plot_cycle(data.plot_pairs(), cycle_step, state, summary, epoch)
+
+    summary.close()
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    # Reference-compatible flags (reference main.py:406-411)
+    parser.add_argument("--output_dir", default="runs")
+    parser.add_argument("--epochs", default=200, type=int)
+    parser.add_argument("--batch_size", default=1, type=int,
+                        help="per-data-shard batch size; global = n_data_shards * batch_size")
+    parser.add_argument("--verbose", default=1, type=int, choices=[0, 1, 2])
+    parser.add_argument("--clear_output_dir", action="store_true")
+    # Framework extensions
+    parser.add_argument("--dataset", default="horse2zebra",
+                        help="TFDS cycle_gan/<name> dataset")
+    parser.add_argument("--data_dir", default=None,
+                        help="folder with trainA/trainB/testA/testB image dirs")
+    parser.add_argument("--data_source", default="auto",
+                        choices=["auto", "tfds", "folder", "synthetic"])
+    parser.add_argument("--image_size", default=256, type=int)
+    parser.add_argument("--bf16", action="store_true",
+                        help="bfloat16 compute (fp32 params/optimizer)")
+    parser.add_argument("--remat", action="store_true",
+                        help="rematerialize residual blocks (512^2 HBM relief)")
+    parser.add_argument("--spatial_parallelism", default=1, type=int,
+                        help="shard the image H axis over this many mesh columns")
+    parser.add_argument("--fresh_augment", action="store_true",
+                        help="re-augment every epoch instead of reproducing the "
+                             "reference's cache-after-augment behavior")
+    parser.add_argument("--synthetic_train_size", default=64, type=int,
+                        help="samples per domain for --data_source synthetic")
+    parser.add_argument("--synthetic_test_size", default=16, type=int)
+    main(parser.parse_args())

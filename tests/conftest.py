@@ -1,0 +1,40 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX import.
+
+The reference is smoke-tested only by running main.py on whatever devices
+are visible (SURVEY.md §4 — it has no tests). Here every test runs on
+8 virtual CPU devices so distributed semantics (batch sharding, grad
+all-reduce) are exercised without TPU hardware.
+"""
+
+import os
+
+# Force CPU even when the session env points JAX at a TPU tunnel
+# (JAX_PLATFORMS=axon): tests must be hermetic and host-only.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize may import jax before this file runs,
+# freezing JAX_PLATFORMS at its launch-time value — override post-import.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from cyclegan_tpu.config import tiny_test_config  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tiny_config():
+    return tiny_test_config()
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

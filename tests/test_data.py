@@ -1,0 +1,154 @@
+"""Input-pipeline tests: shapes, normalization range, determinism,
+cache-after-augment reproduction, ragged-batch padding, zip semantics
+(reference main.py:18-83)."""
+
+import numpy as np
+import pytest
+
+from cyclegan_tpu.config import Config, DataConfig, TrainConfig, tiny_test_config
+from cyclegan_tpu.data import build_data
+from cyclegan_tpu.data.augment import (
+    normalize_image,
+    preprocess_test,
+    preprocess_train,
+    resize_bilinear,
+)
+from cyclegan_tpu.data.sources import SyntheticSource
+
+
+def test_normalize_range():
+    img = np.asarray([[0, 127.5, 255]], np.float32)[..., None]
+    out = normalize_image(img)
+    np.testing.assert_allclose(out.ravel(), [-1.0, 0.0, 1.0])
+
+
+def test_resize_bilinear_identity():
+    img = np.random.RandomState(0).rand(8, 8, 3).astype(np.float32)
+    np.testing.assert_array_equal(resize_bilinear(img, 8, 8), img)
+
+
+def test_resize_bilinear_constant_preserved():
+    img = np.full((10, 10, 3), 7.0, np.float32)
+    out = resize_bilinear(img, 286, 286)
+    assert out.shape == (286, 286, 3)
+    np.testing.assert_allclose(out, 7.0, rtol=1e-6)
+
+
+def test_resize_bilinear_matches_tf_convention():
+    # 2x upsample of [0, 1] with half-pixel centers:
+    # out coords map to src [-0.25, 0.25, 0.75, 1.25] -> [0, .25, .75, 1]
+    img = np.asarray([[0.0, 1.0]], np.float32).reshape(1, 2, 1)
+    out = resize_bilinear(img, 1, 4)
+    np.testing.assert_allclose(out.ravel(), [0.0, 0.25, 0.75, 1.0], atol=1e-6)
+
+
+def test_preprocess_train_shape_and_range():
+    img = np.random.RandomState(0).randint(0, 256, (300, 200, 3), dtype=np.uint8)
+    rng = np.random.default_rng(0)
+    out = preprocess_train(img, rng, resize_size=286, crop_size=256)
+    assert out.shape == (256, 256, 3)
+    assert out.min() >= -1.0 and out.max() <= 1.0
+
+
+def test_preprocess_test_deterministic():
+    img = np.random.RandomState(1).randint(0, 256, (100, 120, 3), dtype=np.uint8)
+    a = preprocess_test(img, 256)
+    b = preprocess_test(img, 256)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (256, 256, 3)
+
+
+@pytest.fixture(scope="module")
+def tiny_data():
+    cfg = tiny_test_config()
+    return build_data(cfg, global_batch_size=4)
+
+
+def test_steps_ceil_semantics(tiny_data):
+    # 8 train samples at global batch 4 -> 2 steps; 4 test at 4 -> 1.
+    assert tiny_data.train_steps == 2
+    assert tiny_data.test_steps == 1
+
+
+def test_train_epoch_batches(tiny_data):
+    batches = list(tiny_data.train_epoch(0, prefetch=False))
+    assert len(batches) == tiny_data.train_steps
+    for x, y, w in batches:
+        assert x.shape == (4, 32, 32, 3)
+        assert y.shape == (4, 32, 32, 3)
+        assert w.shape == (4,)
+        assert x.min() >= -1.0 and x.max() <= 1.0
+
+
+def test_cache_augmented_frozen_across_epochs(tiny_data):
+    """Reference quirk (main.py:53-54): augmentations frozen after epoch 1
+    — same images across epochs, possibly different order."""
+    b0 = sorted(list(tiny_data.train_epoch(0, prefetch=False))[0][0].sum(axis=(1, 2, 3)).tolist())
+    b1 = sorted(list(tiny_data.train_epoch(1, prefetch=False))[0][0].sum(axis=(1, 2, 3)).tolist())
+    all0 = np.concatenate([b[0] for b in tiny_data.train_epoch(0, prefetch=False)])
+    all1 = np.concatenate([b[0] for b in tiny_data.train_epoch(1, prefetch=False)])
+    s0 = sorted(all0.sum(axis=(1, 2, 3)).tolist())
+    s1 = sorted(all1.sum(axis=(1, 2, 3)).tolist())
+    np.testing.assert_allclose(s0, s1, rtol=1e-5)
+
+
+def test_fresh_augment_varies_across_epochs():
+    cfg = tiny_test_config()
+    cfg = Config(
+        model=cfg.model,
+        data=DataConfig(
+            source="synthetic", resize_size=36, crop_size=32,
+            synthetic_train_size=8, synthetic_test_size=4,
+            cache_augmented=False,
+        ),
+        train=cfg.train,
+    )
+    data = build_data(cfg, global_batch_size=4)
+    all0 = np.concatenate([b[0] for b in data.train_epoch(0, prefetch=False)])
+    all1 = np.concatenate([b[0] for b in data.train_epoch(1, prefetch=False)])
+    assert not np.allclose(sorted(all0.sum(axis=(1, 2, 3))), sorted(all1.sum(axis=(1, 2, 3))))
+
+
+def test_shuffle_differs_between_epochs(tiny_data):
+    x0 = list(tiny_data.train_epoch(0, prefetch=False))[0][0]
+    x1 = list(tiny_data.train_epoch(1, prefetch=False))[0][0]
+    # same cached images (above test), different order with high prob
+    assert not np.array_equal(x0, x1)
+
+
+def test_ragged_final_batch_padded():
+    cfg = tiny_test_config()  # 8 train samples
+    data = build_data(cfg, global_batch_size=3)  # 3 steps: 3+3+2
+    assert data.train_steps == 3
+    batches = list(data.train_epoch(0, prefetch=False))
+    x, y, w = batches[-1]
+    assert x.shape[0] == 3
+    np.testing.assert_array_equal(w, [1.0, 1.0, 0.0])
+    # padded sample must be zeroed
+    assert np.abs(x[2]).sum() == 0
+
+
+def test_plot_pairs(tiny_data):
+    pairs = tiny_data.plot_pairs(5)
+    # min(5, n_test=4) pairs at batch 1 (main.py:76-77)
+    assert len(pairs) == 4
+    for x, y in pairs:
+        assert x.shape == (1, 32, 32, 3)
+        assert y.shape == (1, 32, 32, 3)
+
+
+def test_prefetch_yields_same_batches(tiny_data):
+    direct = list(tiny_data.train_epoch(0, prefetch=False))
+    pre = list(tiny_data.train_epoch(0, prefetch=True))
+    assert len(direct) == len(pre)
+    for (a, b, c), (d, e, f) in zip(direct, pre):
+        np.testing.assert_array_equal(a, d)
+        np.testing.assert_array_equal(c, f)
+
+
+def test_synthetic_source_deterministic():
+    s1 = SyntheticSource(4, 2, 32)
+    s2 = SyntheticSource(4, 2, 32)
+    np.testing.assert_array_equal(s1.load("trainA", 0), s2.load("trainA", 0))
+    assert not np.array_equal(s1.load("trainA", 0), s1.load("trainA", 1))
+    assert not np.array_equal(s1.load("trainA", 0), s1.load("trainB", 0))

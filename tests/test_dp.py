@@ -1,0 +1,139 @@
+"""Distributed-without-a-cluster tests (SURVEY.md §4): on 8 virtual CPU
+devices, the DP-sharded step must equal the single-device step, for both
+the compiler-scheduled jit path and the explicit shard_map+psum path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cyclegan_tpu.parallel import (
+    make_mesh_plan,
+    pad_to_global_batch,
+    shard_batch,
+    shard_test_step,
+    shard_train_step,
+)
+from cyclegan_tpu.parallel.collective import shard_map_train_step
+from cyclegan_tpu.config import ParallelConfig
+from cyclegan_tpu.train import create_state, make_test_step, make_train_step
+
+
+@pytest.fixture(scope="module")
+def batch(tiny_config):
+    cfg = tiny_config
+    n = 8
+    kx, ky = jax.random.split(jax.random.PRNGKey(7))
+    s = cfg.model.image_size
+    x = np.asarray(jax.random.uniform(kx, (n, s, s, 3), minval=-1, maxval=1))
+    y = np.asarray(jax.random.uniform(ky, (n, s, s, 3), minval=-1, maxval=1))
+    w = np.ones((n,), np.float32)
+    return x, y, w
+
+
+@pytest.fixture()  # function-scoped: shard_train_step donates the state
+def state0(tiny_config):
+    return create_state(tiny_config, jax.random.PRNGKey(0))
+
+
+def tree_allclose(a, b, rtol=2e-4, atol=1e-6, msg=""):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(
+            np.asarray(la, np.float32), np.asarray(lb, np.float32),
+            rtol=rtol, atol=atol, err_msg=msg,
+        )
+
+
+def test_dp_jit_equals_single_device(tiny_config, state0, batch, devices):
+    cfg, (x, y, w) = tiny_config, batch
+    gbs = x.shape[0]
+
+    # Single device (first CPU device only).
+    single = jax.jit(make_train_step(cfg, gbs))
+    s1, m1 = single(state0, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w))
+
+    # 8-way data parallel via compiler-scheduled sharding.
+    plan = make_mesh_plan(ParallelConfig(), devices)
+    assert plan.n_data == 8
+    step = shard_train_step(plan, make_train_step(cfg, gbs))
+    xs, ys, ws = shard_batch(plan, x, y, w)
+    state_rep = jax.device_put(state0, jax.NamedSharding(plan.mesh, jax.P()))
+    s8, m8 = step(state_rep, xs, ys, ws)
+
+    for k in m1:
+        np.testing.assert_allclose(float(m1[k]), float(m8[k]), rtol=2e-4, atol=1e-6, err_msg=k)
+    tree_allclose(s1.g_params, s8.g_params, msg="g_params diverged")
+    tree_allclose(s1.dx_params, s8.dx_params, msg="dx_params diverged")
+
+
+def test_dp_shard_map_psum_equals_single_device(tiny_config, state0, batch, devices):
+    cfg, (x, y, w) = tiny_config, batch
+    gbs = x.shape[0]
+    single = jax.jit(make_train_step(cfg, gbs))
+    s1, m1 = single(state0, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w))
+
+    plan = make_mesh_plan(ParallelConfig(), devices)
+    step = shard_map_train_step(plan, cfg, gbs)
+    xs, ys, ws = shard_batch(plan, x, y, w)
+    s8, m8 = step(state0, xs, ys, ws)
+
+    for k in m1:
+        np.testing.assert_allclose(float(m1[k]), float(m8[k]), rtol=2e-4, atol=1e-6, err_msg=k)
+    tree_allclose(s1.g_params, s8.g_params, msg="g_params diverged (psum path)")
+    tree_allclose(s1.f_params, s8.f_params, msg="f_params diverged (psum path)")
+
+
+def test_dp_test_step_matches(tiny_config, state0, batch, devices):
+    cfg, (x, y, w) = tiny_config, batch
+    gbs = x.shape[0]
+    m1 = jax.jit(make_test_step(cfg, gbs))(
+        state0, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w)
+    )
+    plan = make_mesh_plan(ParallelConfig(), devices)
+    step = shard_test_step(plan, make_test_step(cfg, gbs))
+    xs, ys, ws = shard_batch(plan, x, y, w)
+    m8 = step(jax.device_put(state0, jax.NamedSharding(plan.mesh, jax.P())), xs, ys, ws)
+    for k in m1:
+        np.testing.assert_allclose(float(m1[k]), float(m8[k]), rtol=2e-4, atol=1e-6, err_msg=k)
+
+
+def test_ragged_final_batch_padding(tiny_config, state0, devices):
+    """5 real samples padded to a global batch of 8 across 8 devices must
+    equal the unpadded 5-sample computation at the same global_batch_size
+    (reference remainder semantics, main.py:32-33)."""
+    cfg = tiny_config
+    s = cfg.model.image_size
+    kx, ky = jax.random.split(jax.random.PRNGKey(3))
+    x5 = np.asarray(jax.random.uniform(kx, (5, s, s, 3), minval=-1, maxval=1))
+    y5 = np.asarray(jax.random.uniform(ky, (5, s, s, 3), minval=-1, maxval=1))
+    gbs = 8  # ceil-semantics: final batch of 5 at global batch 8
+
+    m_ref = jax.jit(make_test_step(cfg, gbs))(
+        state0, jnp.asarray(x5), jnp.asarray(y5), jnp.ones((5,), jnp.float32)
+    )
+
+    xp, yp, wp = pad_to_global_batch(x5, y5, gbs)
+    assert xp.shape[0] == 8 and wp.sum() == 5
+    plan = make_mesh_plan(ParallelConfig(), devices)
+    step = shard_test_step(plan, make_test_step(cfg, gbs))
+    xs, ys, ws = shard_batch(plan, xp, yp, wp)
+    m_pad = step(jax.device_put(state0, jax.NamedSharding(plan.mesh, jax.P())), xs, ys, ws)
+    for k in m_ref:
+        np.testing.assert_allclose(float(m_ref[k]), float(m_pad[k]), rtol=2e-4, atol=1e-6, err_msg=k)
+
+
+def test_spatial_sharding_compiles_and_matches(tiny_config, state0, batch, devices):
+    """2-D mesh (4 data x 2 spatial): H-axis sharding — XLA inserts halo
+    exchanges for the convs; results must match single-device."""
+    cfg, (x, y, w) = tiny_config, batch
+    gbs = x.shape[0]
+    m1 = jax.jit(make_test_step(cfg, gbs))(
+        state0, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w)
+    )
+    plan = make_mesh_plan(ParallelConfig(spatial_parallelism=2), devices)
+    assert plan.n_data == 4 and plan.n_spatial == 2
+    step = shard_test_step(plan, make_test_step(cfg, gbs))
+    xs, ys, ws = shard_batch(plan, x, y, w)
+    m8 = step(jax.device_put(state0, jax.NamedSharding(plan.mesh, jax.P())), xs, ys, ws)
+    for k in m1:
+        np.testing.assert_allclose(float(m1[k]), float(m8[k]), rtol=5e-4, atol=1e-5, err_msg=k)

@@ -1,0 +1,50 @@
+"""End-to-end slice test: `python main.py` on synthetic data — epoch
+loop, TB event files, checkpoint write, auto-resume on second run
+(the minimum end-to-end slice of SURVEY.md §7 step 4)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_main(out_dir, extra=()):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # single CPU device is fine here
+    cmd = [
+        sys.executable, "main.py",
+        "--output_dir", str(out_dir),
+        "--epochs", "1",
+        "--batch_size", "2",
+        "--verbose", "0",
+        "--data_source", "synthetic",
+        "--image_size", "32",
+        "--synthetic_train_size", "4",
+        "--synthetic_test_size", "2",
+        *extra,
+    ]
+    return subprocess.run(
+        cmd, cwd=REPO, env=env, capture_output=True, text=True, timeout=900
+    )
+
+
+@pytest.mark.slow
+def test_main_end_to_end_and_resume(tmp_path):
+    out = tmp_path / "run"
+    r = run_main(out)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    # TB event files for train and test writers (utils.py:21-24 parity)
+    assert any(f.startswith("events") for f in os.listdir(out))
+    assert any(f.startswith("events") for f in os.listdir(out / "test"))
+    # single checkpoint slot written (main.py:400-401 parity)
+    assert (out / "checkpoints" / "checkpoint").is_dir()
+    assert "MAE(X, F(G(X)))" in r.stdout
+
+    # Second run resumes (epochs=1 already done -> trains nothing more)
+    r2 = run_main(out)
+    assert r2.returncode == 0, f"stdout:\n{r2.stdout}\nstderr:\n{r2.stderr}"
+    assert "Resumed" in r2.stdout

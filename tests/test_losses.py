@@ -1,0 +1,73 @@
+"""Loss tests vs hand-computed scalars, including the lambda=10/lambda=5
+weights (main.py:116-118) and sum/global_batch scaling (main.py:172-174)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from cyclegan_tpu import losses
+
+
+def w(n):
+    return jnp.ones((n,), jnp.float32)
+
+
+def test_mae_per_sample():
+    a = jnp.asarray([[[1.0, 2.0]], [[0.0, 0.0]]])  # [2,1,2]
+    b = jnp.asarray([[[0.0, 0.0]], [[1.0, 3.0]]])
+    np.testing.assert_allclose(np.asarray(losses.mae(a, b)), [1.5, 2.0])
+
+
+def test_mse_per_sample():
+    a = jnp.asarray([[[1.0, 2.0]], [[0.0, 0.0]]])
+    b = jnp.asarray([[[0.0, 0.0]], [[1.0, 3.0]]])
+    np.testing.assert_allclose(np.asarray(losses.mse(a, b)), [2.5, 5.0])
+
+
+def test_bce_matches_manual():
+    y_true = jnp.asarray([[1.0], [0.0]])
+    y_pred = jnp.asarray([[0.8], [0.3]])
+    got = np.asarray(losses.bce(y_true, y_pred))
+    want = [-np.log(0.8), -np.log(0.7)]
+    np.testing.assert_allclose(got, want, rtol=1e-3)
+
+
+def test_scaled_mean_divides_by_global_batch():
+    # Two local samples but global batch 8 (DP with 4 replicas):
+    per_sample = jnp.asarray([3.0, 5.0])
+    got = losses.scaled_mean(per_sample, w(2), 8)
+    assert float(got) == 1.0  # (3+5)/8
+
+
+def test_weights_mask_padded_samples():
+    per_sample = jnp.asarray([3.0, 5.0, 100.0])
+    weights = jnp.asarray([1.0, 1.0, 0.0])  # third sample is padding
+    got = losses.scaled_mean(per_sample, weights, 2)
+    assert float(got) == 4.0
+
+
+def test_generator_loss_lsgan():
+    # D(fake) = 0.5 everywhere -> MSE(1, 0.5) = 0.25 per sample
+    d_fake = jnp.full((2, 4, 4, 1), 0.5)
+    got = losses.generator_loss(d_fake, w(2), 2)
+    np.testing.assert_allclose(float(got), 0.25, rtol=1e-6)
+
+
+def test_cycle_loss_lambda_10():
+    real = jnp.zeros((1, 2, 2, 1))
+    cycled = jnp.full((1, 2, 2, 1), 0.3)
+    got = losses.cycle_loss(real, cycled, w(1), 1, lambda_cycle=10.0)
+    np.testing.assert_allclose(float(got), 3.0, rtol=1e-6)
+
+
+def test_identity_loss_lambda_5():
+    real = jnp.zeros((1, 2, 2, 1))
+    same = jnp.full((1, 2, 2, 1), 0.2)
+    got = losses.identity_loss(real, same, w(1), 1, lambda_identity=5.0)
+    np.testing.assert_allclose(float(got), 1.0, rtol=1e-6)
+
+
+def test_discriminator_loss_half_sum():
+    d_real = jnp.full((1, 2, 2, 1), 0.8)  # MSE(1, .8) = .04
+    d_fake = jnp.full((1, 2, 2, 1), 0.4)  # MSE(0, .4) = .16
+    got = losses.discriminator_loss(d_real, d_fake, w(1), 1)
+    np.testing.assert_allclose(float(got), 0.5 * (0.04 + 0.16), rtol=1e-6)

@@ -1,0 +1,107 @@
+"""Model-zoo tests (SURVEY.md §4: output shapes 256^2 -> 256^2x3 and
+256^2 -> 32x32x1 patch map; param counts ~11.4M / ~2.77M)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cyclegan_tpu.config import DiscriminatorConfig, GeneratorConfig
+from cyclegan_tpu.models import PatchGANDiscriminator, ResNetGenerator
+
+
+def n_params(tree):
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(tree))
+
+
+@pytest.fixture(scope="module")
+def full_gen():
+    gen = ResNetGenerator()
+    x = jnp.zeros((1, 256, 256, 3))
+    params = jax.eval_shape(gen.init, jax.random.PRNGKey(0), x)
+    return gen, params
+
+
+def test_generator_param_count(full_gen):
+    _, params = full_gen
+    # Reference gen_G has ~11.4M params (SURVEY.md §2.1, model.py:129-169).
+    assert n_params(params) == 11_383_427
+
+
+def test_discriminator_param_count():
+    disc = PatchGANDiscriminator()
+    x = jnp.zeros((1, 256, 256, 3))
+    params = jax.eval_shape(disc.init, jax.random.PRNGKey(0), x)
+    assert n_params(params) == 2_765_633
+
+
+def test_generator_output_shape_256(full_gen):
+    gen, params = full_gen
+    x = jnp.zeros((2, 256, 256, 3))
+    out = jax.eval_shape(gen.apply, params, x)
+    assert out.shape == (2, 256, 256, 3)
+
+
+def test_discriminator_patch_map_shape():
+    disc = PatchGANDiscriminator()
+    x = jnp.zeros((2, 256, 256, 3))
+    params = jax.eval_shape(disc.init, jax.random.PRNGKey(0), x)
+    out = jax.eval_shape(disc.apply, params, x)
+    assert out.shape == (2, 32, 32, 1)  # 70x70 PatchGAN logits map
+
+
+def test_generator_output_shape_512(full_gen):
+    # Fully convolutional: 512^2 config (BASELINE.md) reuses the same params.
+    gen, params = full_gen
+    x = jnp.zeros((1, 512, 512, 3))
+    out = jax.eval_shape(gen.apply, params, x)
+    assert out.shape == (1, 512, 512, 3)
+
+
+def test_generator_tanh_range():
+    gen = ResNetGenerator(config=GeneratorConfig(filters=4, num_residual_blocks=1))
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 32, 32, 3))
+    params = gen.init(jax.random.PRNGKey(0), x)
+    y = gen.apply(params, x)
+    assert float(jnp.max(jnp.abs(y))) <= 1.0
+
+
+def test_discriminator_logits_unbounded_sign():
+    # Raw logits head: no activation (model.py:207-211)
+    disc = PatchGANDiscriminator(config=DiscriminatorConfig(filters=4))
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 32, 32, 3)) * 3
+    params = disc.init(jax.random.PRNGKey(0), x)
+    y = np.asarray(disc.apply(params, x))
+    assert y.min() < 0 or y.max() > 0  # not squashed
+
+
+def test_bfloat16_compute_fp32_params():
+    gen = ResNetGenerator(
+        config=GeneratorConfig(filters=4, num_residual_blocks=1), dtype=jnp.bfloat16
+    )
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 32, 32, 3))
+    params = gen.init(jax.random.PRNGKey(0), x)
+    for leaf in jax.tree.leaves(params):
+        assert leaf.dtype == jnp.float32
+    y = gen.apply(params, x)
+    assert y.dtype == x.dtype  # cast back at the boundary
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_init_statistics_match_reference():
+    # Conv kernels and IN gammas ~ N(0, 0.02); biases/betas zero
+    # (reference model.py:10-11).
+    gen = ResNetGenerator()
+    x = jnp.zeros((1, 64, 64, 3))
+    params = gen.init(jax.random.PRNGKey(0), x)
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    kernel_stds, zeros_ok = [], True
+    for path, leaf in flat:
+        name = jax.tree_util.keystr(path)
+        arr = np.asarray(leaf)
+        if "kernel" in name or "scale" in name:
+            kernel_stds.append(arr.std())
+        elif "bias" in name:
+            zeros_ok &= (arr == 0).all()
+    assert zeros_ok
+    assert 0.015 < np.mean(kernel_stds) < 0.025
