@@ -63,22 +63,25 @@ def instance_norm(
         from cyclegan_tpu.ops.pallas.norm_kernel import instance_norm_pallas
 
         try:
-            return instance_norm_pallas(x, scale, bias, eps=eps)
+            # Explicit impl="pallas" on a non-TPU backend runs the kernel
+            # in interpret mode (correct everywhere, slow — useful for
+            # tests); the auto path only selects Pallas on TPU.
+            interpret = jax.default_backend() != "tpu"
+            return instance_norm_pallas(x, scale, bias, eps=eps, interpret=interpret)
         except NotImplementedError:
             pass
     return _instance_norm_xla(x, scale, bias, eps)
 
 
 def _pallas_eligible(x: jnp.ndarray) -> bool:
-    """Use the Pallas kernel only on TPU backends with lane-aligned channels."""
+    """Use the Pallas kernel only on TPU backends when the (sample,
+    channel-tile) slab fits VMEM (see ops/pallas/norm_kernel.py)."""
     try:
         backend = jax.default_backend()
     except Exception:
         return False
     if backend not in ("tpu",):
         return False
-    if x.ndim != 4:
-        return False
-    # One (H, W) slab per (n, c) grid step must fit VMEM comfortably.
-    h, w = x.shape[1], x.shape[2]
-    return h * w * 4 <= 4 * 1024 * 1024
+    from cyclegan_tpu.ops.pallas.norm_kernel import eligible
+
+    return eligible(x.shape)

@@ -1,12 +1,131 @@
 """Fused single-pass Pallas TPU kernel for instance normalization.
 
-Placeholder: implemented in the kernel milestone. `instance_norm` in
-ops/norm.py falls back to the XLA implementation until then.
+Motivation (SURVEY.md §2.2): the reference leans on cuDNN + TF fusion for
+tfa.layers.InstanceNormalization (model.py:58 etc.). XLA compiles the op
+as a reduce pass plus a normalize pass — the activation crosses HBM
+three times (write, read for moments, read for normalize). This kernel
+keeps one (sample, channel-tile) slab resident in VMEM and does
+moments + normalize + affine in a single pass: one HBM read, one write.
+
+Layout: x reshaped to [N, H*W, C]; grid (N, C/C_BLK); block
+[1, HW, C_BLK] with channels on lanes (last dim, 128) and HW on
+sublanes — reductions run on the VPU over sublanes. Statistics always in
+float32 (also under bfloat16 inputs).
+
+Backward is a custom VJP using the saved per-(n,c) mean/inv residuals:
+  xhat = (x - mean) * inv
+  dbias  = sum_{N,HW} g
+  dscale = sum_{N,HW} g * xhat
+  dx = scale * inv * (g - mean_hw(g) - xhat * mean_hw(g * xhat))
+implemented in XLA (fuses into two passes); the forward is the
+bandwidth-critical op inside the 9 residual blocks.
+
+Eligibility: the slab (HW x 128 x 4B, x2 for in+out) must fit VMEM
+(~16MB/core) — true for the generator trunk at 256^2 input
+(64x64x256 activations, where 18 of the ~22 instance norms run), not
+for the two outermost layers; ops/norm.py falls back to XLA there.
 """
 
 from __future__ import annotations
 
+import functools
+from typing import Tuple
+
+import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Max sublane extent (H*W) for a resident slab: 8192 * 128 lanes * 4B = 4MB
+# per buffer; in + out + margin stays well under the ~16MB VMEM budget.
+MAX_RESIDENT_HW = 8192
+C_BLK = 128
+
+
+def eligible(shape: Tuple[int, ...]) -> bool:
+    """True if [N, H, W, C] can use the single-pass resident kernel: the
+    per-grid-step slab is (H*W, C_BLK) floats (stats are f32 even for
+    bf16 inputs), so the bound is on H*W alone."""
+    if len(shape) != 4:
+        return False
+    _, h, w, _ = shape
+    return h * w <= MAX_RESIDENT_HW
+
+
+def _fwd_kernel(x_ref, scale_ref, bias_ref, y_ref, mean_ref, inv_ref, *, eps):
+    x = x_ref[0].astype(jnp.float32)  # [HW, Cb]
+    hw = x.shape[0]
+    mean = jnp.sum(x, axis=0, keepdims=True) / hw  # [1, Cb]
+    centered = x - mean
+    var = jnp.sum(centered * centered, axis=0, keepdims=True) / hw
+    inv = jax.lax.rsqrt(var + eps)
+    scale = scale_ref[0].astype(jnp.float32)  # [Cb]
+    bias = bias_ref[0].astype(jnp.float32)
+    y = centered * inv * scale[None, :] + bias[None, :]
+    y_ref[0] = y.astype(y_ref.dtype)
+    mean_ref[0] = mean[0]
+    inv_ref[0] = inv[0]
+
+
+def _forward(x4, scale, bias, eps, interpret):
+    n, h, w, c = x4.shape
+    hw = h * w
+    x = x4.reshape(n, hw, c)
+    c_blk = min(c, C_BLK)
+    grid = (n, pl.cdiv(c, c_blk))
+    y, mean, inv = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, hw, c_blk), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, c_blk), lambda i, j: (0, j)),
+            pl.BlockSpec((1, c_blk), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, hw, c_blk), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, c_blk), lambda i, j: (i, j)),
+            pl.BlockSpec((1, c_blk), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, hw, c), x.dtype),
+            jax.ShapeDtypeStruct((n, c), jnp.float32),
+            jax.ShapeDtypeStruct((n, c), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, scale.reshape(1, c), bias.reshape(1, c))
+    return y.reshape(n, h, w, c), mean, inv
+
+
+@functools.lru_cache(maxsize=None)
+def _build(eps: float, interpret: bool):
+    @jax.custom_vjp
+    def op(x, scale, bias):
+        y, _, _ = _forward(x, scale, bias, eps, interpret)
+        return y
+
+    def op_fwd(x, scale, bias):
+        y, mean, inv = _forward(x, scale, bias, eps, interpret)
+        return y, (x, scale, bias, mean, inv)
+
+    def op_bwd(res, g):
+        x, scale, bias, mean, inv = res
+        n, h, w, c = x.shape
+        xf = x.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        mean_b = mean[:, None, None, :]
+        inv_b = inv[:, None, None, :]
+        xhat = (xf - mean_b) * inv_b
+        dbias = jnp.sum(gf, axis=(0, 1, 2))
+        dscale = jnp.sum(gf * xhat, axis=(0, 1, 2))
+        g_mean = jnp.mean(gf, axis=(1, 2), keepdims=True)
+        gx_mean = jnp.mean(gf * xhat, axis=(1, 2), keepdims=True)
+        dx = scale.astype(jnp.float32)[None, None, None, :] * inv_b * (
+            gf - g_mean - xhat * gx_mean
+        )
+        return dx.astype(x.dtype), dscale.astype(scale.dtype), dbias.astype(bias.dtype)
+
+    op.defvjp(op_fwd, op_bwd)
+    return op
 
 
 def instance_norm_pallas(
@@ -14,5 +133,12 @@ def instance_norm_pallas(
     scale: jnp.ndarray,
     bias: jnp.ndarray,
     eps: float = 1e-3,
+    interpret: bool = False,
 ) -> jnp.ndarray:
-    raise NotImplementedError("Pallas instance-norm kernel not yet implemented")
+    """Fused instance norm. Raises NotImplementedError when the shape
+    cannot stay VMEM-resident (caller falls back to XLA)."""
+    if not eligible(x.shape):
+        raise NotImplementedError(
+            f"shape {x.shape} exceeds resident-slab limit (H*W <= {MAX_RESIDENT_HW})"
+        )
+    return _build(float(eps), bool(interpret))(x, scale, bias)
