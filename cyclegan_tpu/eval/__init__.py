@@ -1,0 +1,21 @@
+"""Evaluation: Fréchet Inception Distance harness.
+
+The reference computes no quantitative quality metric (SURVEY.md §6);
+FID@200ep on horse2zebra is the north-star named by BASELINE.md, so the
+harness lives here in the framework.
+"""
+
+from cyclegan_tpu.eval.fid import (
+    FIDAccumulator,
+    frechet_distance,
+    matrix_sqrt_newton_schulz,
+)
+from cyclegan_tpu.eval.features import RandomConvFeatures, build_feature_extractor
+
+__all__ = [
+    "FIDAccumulator",
+    "frechet_distance",
+    "matrix_sqrt_newton_schulz",
+    "RandomConvFeatures",
+    "build_feature_extractor",
+]

@@ -1,0 +1,107 @@
+"""FID evaluation of a trained CycleGAN checkpoint.
+
+Computes FID(G(testA), testB) and FID(F(testB), testA) — translated
+domain vs real target domain over the test split — the quality bar
+BASELINE.md names (the reference has no equivalent; SURVEY.md §6).
+
+Usage:
+  python -m cyclegan_tpu.eval.evaluate --output_dir runs \
+      --data_source synthetic [--features random]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Dict
+
+import jax
+import numpy as np
+
+from cyclegan_tpu.utils.platform import ensure_platform_from_env
+
+
+def evaluate_fid(config, state, data, feature_extractor, batch_size: int = 8) -> Dict[str, float]:
+    from cyclegan_tpu.eval.fid import FIDAccumulator, fid_from_accumulators
+    from cyclegan_tpu.train.state import build_models
+
+    if data.n_test < 2:
+        raise ValueError(
+            f"FID needs at least 2 test pairs per domain; got {data.n_test}"
+        )
+    gen, _ = build_models(config)
+
+    @jax.jit
+    def translate(state, x, y):
+        # Only the two translation forwards FID needs (not the 4-apply
+        # cycle step — the reconstructions would be discarded).
+        return gen.apply(state.f_params, y), gen.apply(state.g_params, x)
+
+    acc = {k: FIDAccumulator(feature_extractor.dim) for k in
+           ["real_a", "real_b", "fake_a", "fake_b"]}
+
+    for x, y, w in data.test_epoch(prefetch=False):
+        fake_x, fake_y = translate(state, x, y)
+        keep = np.asarray(w) > 0  # drop zero-padded rows of the final batch
+        acc["real_a"].update(np.asarray(feature_extractor(x))[keep])
+        acc["real_b"].update(np.asarray(feature_extractor(y))[keep])
+        acc["fake_a"].update(np.asarray(feature_extractor(fake_x))[keep])
+        acc["fake_b"].update(np.asarray(feature_extractor(fake_y))[keep])
+
+    return {
+        f"fid/{feature_extractor.name}/G(A)_vs_B": fid_from_accumulators(
+            acc["fake_b"], acc["real_b"]
+        ),
+        f"fid/{feature_extractor.name}/F(B)_vs_A": fid_from_accumulators(
+            acc["fake_a"], acc["real_a"]
+        ),
+    }
+
+
+def main(args: argparse.Namespace) -> None:
+    ensure_platform_from_env()
+    from cyclegan_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+    from cyclegan_tpu.data import build_data
+    from cyclegan_tpu.eval.features import build_feature_extractor
+    from cyclegan_tpu.train import create_state
+    from cyclegan_tpu.utils.checkpoint import Checkpointer
+
+    # Mirror main.py's geometry derivation so a checkpoint trained at
+    # --image_size N is evaluated at the same resolution.
+    config = Config(
+        model=ModelConfig(image_size=args.image_size),
+        data=DataConfig(
+            dataset=args.dataset,
+            data_dir=args.data_dir,
+            source=args.data_source,
+            crop_size=args.image_size,
+            resize_size=int(args.image_size * 286 / 256),
+            synthetic_test_size=args.synthetic_test_size,
+        ),
+        train=TrainConfig(output_dir=args.output_dir),
+    )
+    data = build_data(config, global_batch_size=args.batch_size)
+    state = create_state(config, jax.random.PRNGKey(config.train.seed))
+    ckpt = Checkpointer(args.output_dir)
+    state, _, resumed = ckpt.restore_if_exists(state)
+    if not resumed:
+        print(f"WARNING: no checkpoint under {args.output_dir}; evaluating init weights")
+
+    fx = build_feature_extractor(args.features, args.feature_weights)
+    scores = evaluate_fid(config, state, data, fx, batch_size=args.batch_size)
+    print(json.dumps({k: round(v, 4) for k, v in scores.items()}))
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--output_dir", default="runs")
+    p.add_argument("--dataset", default="horse2zebra")
+    p.add_argument("--data_dir", default=None)
+    p.add_argument("--data_source", default="auto",
+                   choices=["auto", "tfds", "folder", "synthetic"])
+    p.add_argument("--batch_size", default=8, type=int)
+    p.add_argument("--image_size", default=256, type=int)
+    p.add_argument("--features", default="auto", choices=["auto", "random", "inception"])
+    p.add_argument("--feature_weights", default=None)
+    p.add_argument("--synthetic_test_size", default=16, type=int)
+    main(p.parse_args())

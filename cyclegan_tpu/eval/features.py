@@ -1,0 +1,96 @@
+"""Feature extractors for FID.
+
+Canonical FID uses InceptionV3 pool3 (2048-d). Pretrained weights are
+not shippable in this offline image, so the extractor is pluggable:
+
+- `InceptionFeatures`: loads InceptionV3 weights from a user-provided
+  .npz file (keys documented below) when available.
+- `RandomConvFeatures`: a fixed-seed random convolutional network.
+  Random-feature Fréchet distances are a recognized proxy (they rank
+  distribution shifts monotonically even untrained); deterministic
+  across runs/hosts by construction. Scores are NOT comparable to
+  Inception-FID numbers — the harness labels which extractor produced
+  a score.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class _RandomConvNet(nn.Module):
+    """5 stride-2 conv stages + global average pool -> feature vector."""
+
+    width: int = 64
+    features: int = 2048
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.width
+        for i in range(5):
+            x = nn.Conv(min(w * 2**i, self.features), (3, 3), strides=(2, 2))(x)
+            x = nn.gelu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.features)(x)
+        return x
+
+
+class RandomConvFeatures:
+    """Deterministic random-CNN feature extractor (offline FID proxy)."""
+
+    name = "random_conv_2048"
+    dim = 2048
+
+    def __init__(self, seed: int = 20260729):
+        self._net = _RandomConvNet()
+        dummy = jnp.zeros((1, 64, 64, 3))
+        self._params = self._net.init(jax.random.PRNGKey(seed), dummy)
+        self._apply = jax.jit(self._net.apply)
+
+    def __call__(self, images: jnp.ndarray) -> jnp.ndarray:
+        """images: [N, H, W, 3] in [-1, 1] -> [N, 2048]."""
+        return self._apply(self._params, images)
+
+
+class InceptionFeatures:
+    """InceptionV3 pool3 features from an .npz weight file.
+
+    Expected file: flax-style flattened param dict saved via
+    `np.savez(path, **{'/'.join(k): v for k, v in flat_params})` for an
+    InceptionV3 port. The port itself is not implemented yet (no weights
+    are obtainable in this offline image), so construction always raises
+    NotImplementedError.
+    """
+
+    name = "inception_v3_pool3"
+    dim = 2048
+
+    def __init__(self, weights_path: str):
+        raise NotImplementedError(
+            "InceptionV3 FID requires a weights file; this offline image has "
+            "none. Use RandomConvFeatures or provide weights in a later round."
+        )
+
+
+def build_feature_extractor(kind: str = "auto", weights_path: Optional[str] = None):
+    import sys
+
+    if kind in ("auto", "random"):
+        if kind == "auto" and weights_path:
+            try:
+                return InceptionFeatures(weights_path)
+            except (NotImplementedError, FileNotFoundError) as e:
+                print(
+                    f"WARNING: requested Inception weights unusable ({e}); "
+                    "falling back to random-conv features — scores are NOT "
+                    "comparable to Inception-FID numbers",
+                    file=sys.stderr,
+                )
+        return RandomConvFeatures()
+    if kind == "inception":
+        return InceptionFeatures(weights_path or "")
+    raise ValueError(f"unknown feature extractor: {kind}")

@@ -1,0 +1,95 @@
+"""Fréchet distance between feature distributions, TPU-native.
+
+FID(A, B) = |mu_A - mu_B|^2 + tr(S_A + S_B - 2 (S_A S_B)^{1/2})
+
+The matrix square root is the classical CPU bottleneck (scipy sqrtm is
+O(d^3) LAPACK on host). Here it runs as Newton-Schulz iterations — pure
+matmuls on the MXU, jittable and differentiable — with a scipy
+cross-check in tests. Feature accumulation is streaming (sum / outer-sum)
+so image batches never need to be held in memory.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("iters",))
+def matrix_sqrt_newton_schulz(a: jnp.ndarray, iters: int = 30) -> jnp.ndarray:
+    """Square root of a PSD matrix via Newton-Schulz iteration.
+
+    Converges quadratically for ||I - A/||A|||| < 1; PSD covariances from
+    FID stats qualify after normalization. f32 throughout; all matmuls.
+    """
+    dim = a.shape[0]
+    norm = jnp.sqrt(jnp.sum(a * a)) + 1e-12
+    y0 = a / norm
+    eye = jnp.eye(dim, dtype=a.dtype)
+
+    def body(_, yz):
+        y, z = yz
+        t = 0.5 * (3.0 * eye - z @ y)
+        return y @ t, t @ z
+
+    y, _ = jax.lax.fori_loop(0, iters, body, (y0, eye))
+    return y * jnp.sqrt(norm)
+
+
+def frechet_distance(
+    mu_a: jnp.ndarray, sigma_a: jnp.ndarray, mu_b: jnp.ndarray, sigma_b: jnp.ndarray
+) -> jnp.ndarray:
+    """FID from Gaussian moments. Uses sqrt(S_A) S_B sqrt(S_A) — same
+    spectrum as S_A S_B but symmetric PSD, which Newton-Schulz handles
+    robustly."""
+    diff = mu_a - mu_b
+    eps = 1e-6 * jnp.eye(sigma_a.shape[0], dtype=sigma_a.dtype)
+    sa = sigma_a + eps
+    sb = sigma_b + eps
+    sqrt_a = matrix_sqrt_newton_schulz(sa)
+    inner = sqrt_a @ sb @ sqrt_a
+    covmean = matrix_sqrt_newton_schulz(0.5 * (inner + inner.T))
+    return jnp.sum(diff * diff) + jnp.trace(sa) + jnp.trace(sb) - 2.0 * jnp.trace(covmean)
+
+
+class FIDAccumulator:
+    """Streaming mean/covariance of feature batches (one per domain)."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+        self.n = 0
+        self._sum = np.zeros((dim,), np.float64)
+        self._outer = np.zeros((dim, dim), np.float64)
+
+    def update(self, feats) -> None:
+        f = np.asarray(feats, np.float64)
+        assert f.ndim == 2 and f.shape[1] == self.dim
+        self.n += f.shape[0]
+        self._sum += f.sum(axis=0)
+        self._outer += f.T @ f
+
+    def stats(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self.n < 2:
+            raise ValueError(
+                f"need at least 2 feature samples for a covariance, got {self.n}"
+            )
+        mu = self._sum / self.n
+        cov = (self._outer - self.n * np.outer(mu, mu)) / (self.n - 1)
+        return mu, cov
+
+
+def fid_from_accumulators(acc_a: FIDAccumulator, acc_b: FIDAccumulator) -> float:
+    mu_a, sig_a = acc_a.stats()
+    mu_b, sig_b = acc_b.stats()
+    return float(
+        frechet_distance(
+            jnp.asarray(mu_a, jnp.float32),
+            jnp.asarray(sig_a, jnp.float32),
+            jnp.asarray(mu_b, jnp.float32),
+            jnp.asarray(sig_b, jnp.float32),
+        )
+    )
