@@ -44,16 +44,40 @@ def resize_bilinear(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
     return top * (1 - fy)[:, None, None] + bot * fy[:, None, None]
 
 
-def preprocess_train(
-    img: np.ndarray, rng: np.random.Generator, resize_size: int = 286, crop_size: int = 256
-) -> np.ndarray:
-    """Random flip -> resize -> random crop -> normalize (main.py:40-45)."""
-    if rng.random() < 0.5:
-        img = img[:, ::-1]
-    img = resize_bilinear(img.astype(np.float32), resize_size, resize_size)
+def draw_augment_params(rng: np.random.Generator, resize_size: int, crop_size: int):
+    """The RNG decision stream for one training image: (flip, oy, ox).
+    Shared by the numpy and native (C++) paths so they are
+    decision-identical."""
+    flip = rng.random() < 0.5
     max_off = resize_size - crop_size
     oy = int(rng.integers(0, max_off + 1))
     ox = int(rng.integers(0, max_off + 1))
+    return flip, oy, ox
+
+
+def preprocess_train(
+    img: np.ndarray,
+    rng: np.random.Generator,
+    resize_size: int = 286,
+    crop_size: int = 256,
+    use_native: bool | None = None,
+) -> np.ndarray:
+    """Random flip -> resize -> random crop -> normalize (main.py:40-45).
+
+    Dispatches to the fused C++ kernel (data/native.py) when built,
+    falling back to the identical-algorithm numpy path.
+    """
+    flip, oy, ox = draw_augment_params(rng, resize_size, crop_size)
+    if use_native is None or use_native:
+        from cyclegan_tpu.data import native
+
+        if native.available():
+            return native.preprocess_one(img, resize_size, flip, oy, ox, crop_size)
+        if use_native:
+            raise RuntimeError("native preprocessing requested but unavailable")
+    if flip:
+        img = img[:, ::-1]
+    img = resize_bilinear(img.astype(np.float32), resize_size, resize_size)
     img = img[oy : oy + crop_size, ox : ox + crop_size]
     return normalize_image(img)
 

@@ -1,0 +1,124 @@
+"""ctypes binding for the native (C++) preprocessing library.
+
+Lazily builds `cyclegan_tpu/native/libcgdata.so` with g++ on first use
+(no pybind11 — plain C ABI + ctypes) and exposes the fused threaded
+batch preprocess. Falls back cleanly when no compiler is available:
+`load()` returns None and the pipeline uses the numpy path
+(data/augment.py), which implements the identical algorithm.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "cgdata.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libcgdata.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    # Compile to a private temp file, then atomically rename into place so
+    # concurrent builders/loaders never see a partially-written .so.
+    tmp = f"{_SO}.build.{os.getpid()}"
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
+        "-o", tmp, _SRC,
+    ]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if r.returncode != 0 or not os.path.exists(tmp):
+            return False
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library, or None."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        lib.cg_preprocess.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, f32p,
+        ]
+        lib.cg_preprocess_batch.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, i32p, i32p, i32p, ctypes.c_int, f32p, ctypes.c_int,
+        ]
+        lib.cg_version.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def preprocess_one(
+    img: np.ndarray, resize: int, flip: bool, oy: int, ox: int, crop: int
+) -> np.ndarray:
+    """Fused flip->resize->crop->normalize of one uint8 [H, W, 3] image."""
+    lib = load()
+    assert lib is not None, "native library unavailable"
+    img = np.ascontiguousarray(img, np.uint8)
+    out = np.empty((crop, crop, 3), np.float32)
+    lib.cg_preprocess(
+        img, img.shape[0], img.shape[1], resize, resize,
+        int(flip), int(oy), int(ox), crop, out,
+    )
+    return out
+
+
+def preprocess_batch(
+    imgs: np.ndarray,
+    resize: int,
+    flips: np.ndarray,
+    oys: np.ndarray,
+    oxs: np.ndarray,
+    crop: int,
+    n_threads: int = 0,
+) -> np.ndarray:
+    """Threaded fused preprocess of a same-sized uint8 batch [N, H, W, 3]."""
+    lib = load()
+    assert lib is not None, "native library unavailable"
+    imgs = np.ascontiguousarray(imgs, np.uint8)
+    n, h, w, _ = imgs.shape
+    out = np.empty((n, crop, crop, 3), np.float32)
+    lib.cg_preprocess_batch(
+        imgs, n, h, w, resize, resize,
+        np.ascontiguousarray(flips, np.int32),
+        np.ascontiguousarray(oys, np.int32),
+        np.ascontiguousarray(oxs, np.int32),
+        crop, out, n_threads,
+    )
+    return out

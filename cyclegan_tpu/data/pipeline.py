@@ -115,17 +115,50 @@ class CycleGANData:
             preprocess_test(self.source.load(split, i), c.crop_size) for i in range(n)
         ]
 
+    def _sample_rng(self, split: str, epoch: int, i: int) -> np.random.Generator:
+        """The one RNG stream per (seed, split, epoch, sample) — shared by
+        the numpy and native paths so they are decision-identical,
+        reproducible across restarts, and identical on every host."""
+        return np.random.default_rng((self.seed, split_tag(split), epoch, i))
+
     def _augment_one(self, split: str, epoch: int, i: int) -> np.ndarray:
-        """Deterministic per-(seed, split, epoch, sample) augmentation —
-        identical on every host, reproducible across restarts."""
         c = self.config.data
-        rng = np.random.default_rng((self.seed, split_tag(split), epoch, i))
         return preprocess_train(
-            self.source.load(split, int(i)), rng, c.resize_size, c.crop_size
+            self.source.load(split, int(i)),
+            self._sample_rng(split, epoch, int(i)),
+            c.resize_size,
+            c.crop_size,
         )
 
     def _prep_train(self, split: str, epoch: int) -> List[np.ndarray]:
-        return [self._augment_one(split, epoch, i) for i in range(self.n_train)]
+        c = self.config.data
+        from cyclegan_tpu.data import native
+        from cyclegan_tpu.data.augment import draw_augment_params
+
+        if not native.available():
+            return [self._augment_one(split, epoch, i) for i in range(self.n_train)]
+        raws = [self.source.load(split, i) for i in range(self.n_train)]
+        if len({r.shape for r in raws}) == 1:
+            # Same-sized source (TFDS cycle_gan/*, synthetic): fused
+            # threaded C++ batch path.
+            flips, oys, oxs = [], [], []
+            for i in range(self.n_train):
+                rng = self._sample_rng(split, epoch, i)
+                f, oy, ox = draw_augment_params(rng, c.resize_size, c.crop_size)
+                flips.append(int(f)); oys.append(oy); oxs.append(ox)
+            out = native.preprocess_batch(
+                np.stack(raws), c.resize_size,
+                np.asarray(flips, np.int32), np.asarray(oys, np.int32),
+                np.asarray(oxs, np.int32), c.crop_size,
+            )
+            return list(out)
+        # Mixed-size source: per-image native path, reusing the decoded raws.
+        return [
+            preprocess_train(
+                raws[i], self._sample_rng(split, epoch, i), c.resize_size, c.crop_size
+            )
+            for i in range(self.n_train)
+        ]
 
     # -- iteration -------------------------------------------------------
 

@@ -21,7 +21,7 @@ import numpy as np
 from cyclegan_tpu.utils.platform import ensure_platform_from_env
 
 
-def evaluate_fid(config, state, data, feature_extractor, batch_size: int = 8) -> Dict[str, float]:
+def evaluate_fid(config, state, data, feature_extractor) -> Dict[str, float]:
     from cyclegan_tpu.eval.fid import FIDAccumulator, fid_from_accumulators
     from cyclegan_tpu.train.state import build_models
 
@@ -88,7 +88,7 @@ def main(args: argparse.Namespace) -> None:
         print(f"WARNING: no checkpoint under {args.output_dir}; evaluating init weights")
 
     fx = build_feature_extractor(args.features, args.feature_weights)
-    scores = evaluate_fid(config, state, data, fx, batch_size=args.batch_size)
+    scores = evaluate_fid(config, state, data, fx)
     print(json.dumps({k: round(v, 4) for k, v in scores.items()}))
 
 

@@ -40,20 +40,35 @@ def matrix_sqrt_newton_schulz(a: jnp.ndarray, iters: int = 30) -> jnp.ndarray:
     return y * jnp.sqrt(norm)
 
 
+@jax.jit
 def frechet_distance(
     mu_a: jnp.ndarray, sigma_a: jnp.ndarray, mu_b: jnp.ndarray, sigma_b: jnp.ndarray
 ) -> jnp.ndarray:
-    """FID from Gaussian moments. Uses sqrt(S_A) S_B sqrt(S_A) — same
-    spectrum as S_A S_B but symmetric PSD, which Newton-Schulz handles
-    robustly."""
+    """FID from Gaussian moments.
+
+    Uses sqrt(S_A) S_B sqrt(S_A) — same spectrum as S_A S_B but symmetric
+    PSD. Both square roots go through eigh (XLA-native on TPU/CPU), which
+    stays accurate for the rank-deficient high-dim covariances real FID
+    produces (n_images << 2048); negative round-off eigenvalues clamp to
+    zero. Newton-Schulz (`matrix_sqrt_newton_schulz`) remains available
+    as the pure-matmul variant but is not accurate enough at 2048-dim
+    near-singular scale to define the metric.
+    """
     diff = mu_a - mu_b
     eps = 1e-6 * jnp.eye(sigma_a.shape[0], dtype=sigma_a.dtype)
     sa = sigma_a + eps
     sb = sigma_b + eps
-    sqrt_a = matrix_sqrt_newton_schulz(sa)
+
+    def psd_sqrt(m):
+        w, v = jnp.linalg.eigh(m)
+        w = jnp.maximum(w, 0.0)
+        return (v * jnp.sqrt(w)[None, :]) @ v.T
+
+    sqrt_a = psd_sqrt(sa)
     inner = sqrt_a @ sb @ sqrt_a
-    covmean = matrix_sqrt_newton_schulz(0.5 * (inner + inner.T))
-    return jnp.sum(diff * diff) + jnp.trace(sa) + jnp.trace(sb) - 2.0 * jnp.trace(covmean)
+    w_inner = jnp.maximum(jnp.linalg.eigvalsh(0.5 * (inner + inner.T)), 0.0)
+    tr_covmean = jnp.sum(jnp.sqrt(w_inner))
+    return jnp.sum(diff * diff) + jnp.trace(sa) + jnp.trace(sb) - 2.0 * tr_covmean
 
 
 class FIDAccumulator:

@@ -91,7 +91,7 @@ def test_evaluate_fid_end_to_end(tiny_config):
     data = build_data(cfg, global_batch_size=2)
     state = create_state(cfg, jax.random.PRNGKey(0))
     fx = RandomConvFeatures()
-    scores = evaluate_fid(cfg, state, data, fx, batch_size=2)
+    scores = evaluate_fid(cfg, state, data, fx)
     assert len(scores) == 2
     for k, v in scores.items():
         assert np.isfinite(v) and v >= 0, k
