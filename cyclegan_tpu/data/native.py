@@ -57,8 +57,16 @@ def load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-            if not _build():
+        # Rebuild when the source is newer than the .so; a prebuilt .so
+        # without the source (packaged install) is used as-is.
+        have_src = os.path.exists(_SRC)
+        stale = (
+            have_src
+            and os.path.exists(_SO)
+            and os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        )
+        if not os.path.exists(_SO) or stale:
+            if not have_src or not _build():
                 return None
         try:
             lib = ctypes.CDLL(_SO)

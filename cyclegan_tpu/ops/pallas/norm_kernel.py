@@ -63,8 +63,8 @@ def _fwd_kernel(x_ref, scale_ref, bias_ref, y_ref, mean_ref, inv_ref, *, eps):
     bias = bias_ref[0].astype(jnp.float32)
     y = centered * inv * scale[None, :] + bias[None, :]
     y_ref[0] = y.astype(y_ref.dtype)
-    mean_ref[0] = mean[0]
-    inv_ref[0] = inv[0]
+    mean_ref[0] = mean
+    inv_ref[0] = inv
 
 
 def _forward(x4, scale, bias, eps, interpret):
@@ -81,19 +81,23 @@ def _forward(x4, scale, bias, eps, interpret):
             pl.BlockSpec((1, c_blk), lambda i, j: (0, j)),
             pl.BlockSpec((1, c_blk), lambda i, j: (0, j)),
         ],
+        # Stats are [N, 1, C] (not [N, C]): a [N, C] output with block
+        # (1, C_BLK) violates the TPU (8, 128) block-tiling rule whenever
+        # N > 1; with the singleton axis the block's last-two dims are
+        # (1, C_BLK), legal for any N.
         out_specs=[
             pl.BlockSpec((1, hw, c_blk), lambda i, j: (i, 0, j)),
-            pl.BlockSpec((1, c_blk), lambda i, j: (i, j)),
-            pl.BlockSpec((1, c_blk), lambda i, j: (i, j)),
+            pl.BlockSpec((1, 1, c_blk), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, c_blk), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n, hw, c), x.dtype),
-            jax.ShapeDtypeStruct((n, c), jnp.float32),
-            jax.ShapeDtypeStruct((n, c), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1, c), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1, c), jnp.float32),
         ],
         interpret=interpret,
     )(x, scale.reshape(1, c), bias.reshape(1, c))
-    return y.reshape(n, h, w, c), mean, inv
+    return y.reshape(n, h, w, c), mean.reshape(n, c), inv.reshape(n, c)
 
 
 @functools.lru_cache(maxsize=None)

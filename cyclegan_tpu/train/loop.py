@@ -46,13 +46,17 @@ def train_epoch(
     state: CycleGANState,
     summary: Summary,
     epoch: int,
+    tracer=None,
 ) -> CycleGANState:
-    """One training pass (reference main.py:332-341)."""
+    """One training pass (reference main.py:332-341). `tracer` is an
+    optional utils.profiler.TraceCapture stepped once per train step."""
     results: Dict[str, list] = {}
     it = _progress(
         data.train_epoch(epoch), data.train_steps, "Train", config.train.verbose
     )
     for x, y, w in it:
+        if tracer is not None:
+            tracer.step()  # before dispatch: full steps land in the window
         xs, ys, ws = shard_batch(plan, x, y, w)
         state, metrics = step_fn(state, xs, ys, ws)
         append_dict(results, jax.device_get(metrics))

@@ -1,7 +1,14 @@
 """Observability and IO: TensorBoard summaries, cycle plots, checkpoints."""
 
 from cyclegan_tpu.utils.dicts import append_dict, mean_dict
-from cyclegan_tpu.utils.summary import Summary
+from cyclegan_tpu.utils.summary import NullSummary, Summary, make_summary
 from cyclegan_tpu.utils.plotting import plot_cycle
 
-__all__ = ["append_dict", "mean_dict", "Summary", "plot_cycle"]
+__all__ = [
+    "append_dict",
+    "mean_dict",
+    "Summary",
+    "NullSummary",
+    "make_summary",
+    "plot_cycle",
+]

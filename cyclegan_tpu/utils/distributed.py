@@ -1,0 +1,96 @@
+"""Multi-host orchestration helpers.
+
+The reference is single-host only (`MirroredStrategy`, SURVEY.md §2.3) —
+multi-host is a capability this framework ADDS. JAX multi-host keeps
+single-program semantics: every process runs the same script over its
+local devices, global arrays span hosts, and collectives ride ICI within
+a slice / DCN across slices. These helpers cover the process-level glue:
+
+- `maybe_initialize()`: call `jax.distributed.initialize()` when a
+  multi-host environment is detected (TPU pod env vars or an explicit
+  coordinator address), before any device query.
+- `is_primary()`: host-0 gate for filesystem side effects (TensorBoard
+  events, console prints, cycle plots) — the analog of the reference
+  writing summaries from its single process (main.py:376).
+- `sync_flag()`: agree on a boolean across hosts (max-reduce), used by
+  the preemption guard so all processes checkpoint-and-exit together.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def maybe_initialize() -> bool:
+    """Initialize jax.distributed iff a multi-host env is detected.
+
+    Detection: explicit JAX_COORDINATOR_ADDRESS (with JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID), or Cloud TPU pod metadata env (TPU_WORKER_HOSTNAMES
+    with more than one worker). Single-host runs (including tests and the
+    one-chip bench) skip initialization entirely. Returns True if
+    initialize() was called.
+    """
+    import jax
+
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    tpu_hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    multi = bool(coord) or len([h for h in tpu_hosts.split(",") if h]) > 1
+    if not multi:
+        return False
+    try:
+        jax.distributed.initialize()  # reads coordinator/process env itself
+        return True
+    except RuntimeError as e:
+        # Tolerate only double-initialization; anything else (coordinator
+        # unreachable, port clash) must fail loudly — silently degrading
+        # to N independent "primary" processes would have every host
+        # clobber the same output_dir/checkpoints.
+        if "already initialized" in str(e).lower():
+            return False
+        raise
+
+
+def process_index() -> int:
+    import jax
+
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def process_count() -> int:
+    import jax
+
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def is_primary() -> bool:
+    """True on the process that owns filesystem side effects."""
+    return process_index() == 0
+
+
+def barrier(name: str) -> None:
+    """Block until every process reaches this point (no-op single-host).
+    Used to order host-0 filesystem mutations (rmtree of output_dir)
+    before other hosts read the same paths."""
+    if process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def sync_flag(local_flag: bool) -> bool:
+    """True iff ANY host's flag is set. All hosts must call this at the
+    same program point (it is a collective when process_count > 1)."""
+    if process_count() == 1:
+        return bool(local_flag)
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(jnp.asarray(int(bool(local_flag))))
+    return bool(int(flags.max()))

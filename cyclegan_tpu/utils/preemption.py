@@ -1,0 +1,57 @@
+"""Preemption-aware graceful shutdown.
+
+The reference has NO failure handling (SURVEY.md §5): a preempted run
+loses up to 10 epochs (its checkpoint cadence, main.py:400) and relies on
+manual restart for auto-resume. This guard closes that gap the TPU-native
+way: TPU VMs deliver SIGTERM on maintenance events / preemption, so we
+trap it, finish the in-flight epoch, checkpoint, and exit cleanly —
+auto-resume (utils/checkpoint.py) then continues from the NEXT epoch
+instead of replaying up to ten.
+
+Multi-host: the signal may land on any subset of hosts, so the epoch-end
+check all-reduces the flag (utils/distributed.sync_flag) — every process
+agrees to stop at the same epoch boundary, keeping the collective
+schedule identical across hosts.
+"""
+
+from __future__ import annotations
+
+import signal
+from types import FrameType
+from typing import Iterable, Optional
+
+from cyclegan_tpu.utils import distributed
+
+
+class PreemptionGuard:
+    """Installs handlers for `signals` (default SIGTERM) that record a
+    stop request; `should_stop()` is the cross-host epoch-boundary check.
+    """
+
+    def __init__(self, signals: Iterable[int] = (signal.SIGTERM,), install: bool = True):
+        self._requested = False
+        self._prev = {}
+        if install:
+            for sig in signals:
+                self._prev[sig] = signal.signal(sig, self._handle)
+
+    def _handle(self, signum: int, frame: Optional[FrameType]) -> None:
+        self._requested = True
+
+    def request_stop(self) -> None:
+        """Programmatic stop request (used by tests and host callers)."""
+        self._requested = True
+
+    @property
+    def requested_locally(self) -> bool:
+        return self._requested
+
+    def should_stop(self) -> bool:
+        """Cross-host agreement: True iff any host was signalled. Call at
+        the same point on every process (epoch boundary)."""
+        return distributed.sync_flag(self._requested)
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev = {}

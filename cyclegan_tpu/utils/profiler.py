@@ -1,0 +1,72 @@
+"""Profiling / trace capture.
+
+The reference's only instrumentation is wall-clock per-epoch timing
+(/root/reference/main.py:388-392, the `elapse` scalar) and tqdm bars.
+SURVEY.md §5 calls for the TPU framework to add real tracing on top:
+this module captures a `jax.profiler` device trace (viewable in
+TensorBoard's profile plugin or Perfetto) for a bounded window of
+training steps, so kernel fusion / HBM stalls / host gaps are
+inspectable without instrumenting the loop by hand.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class TraceCapture:
+    """Capture a jax.profiler trace of `num_steps` full train steps.
+
+    Usage: construct once, call `.step()` immediately BEFORE dispatching
+    every train step. The first step (which includes XLA compilation) is
+    excluded; the trace covers steps 2..num_steps+1, each fully inside
+    the window. `stop()` is idempotent and safe in a `finally:` block.
+    """
+
+    def __init__(self, output_dir: str, num_steps: int = 10, enabled: bool = True):
+        self.trace_dir = os.path.join(output_dir, "traces")
+        self.num_steps = int(num_steps)
+        self.enabled = bool(enabled) and self.num_steps > 0
+        self._seen = 0
+        self._active = False
+
+    def _start(self) -> None:
+        import jax
+
+        os.makedirs(self.trace_dir, exist_ok=True)
+        jax.profiler.start_trace(self.trace_dir)
+        self._active = True
+
+    def step(self) -> None:
+        if not self.enabled:
+            return
+        self._seen += 1
+        if not self._active and self._seen == 2:
+            self._start()  # skip step 1: compile + warmup
+        elif self._active and self._seen - 2 >= self.num_steps:
+            self.stop()
+
+    def stop(self) -> None:
+        if not self._active:
+            return
+        import jax
+
+        # Block so async dispatch from the traced window lands in the trace.
+        jax.effects_barrier()
+        jax.profiler.stop_trace()
+        self._active = False
+        self.enabled = False
+
+
+def annotate(name: str):
+    """Named trace span for host-side phases (shows up in the profiler
+    timeline alongside device streams)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def maybe_trace(output_dir: str, num_steps: Optional[int]) -> TraceCapture:
+    """Build a TraceCapture that is a no-op when num_steps is falsy."""
+    return TraceCapture(output_dir, num_steps or 0, enabled=bool(num_steps))

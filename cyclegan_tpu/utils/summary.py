@@ -95,3 +95,35 @@ class Summary:
     def close(self) -> None:
         for w in self._writers:
             w.close()
+
+
+class NullSummary(Summary):
+    """No-op writer for non-primary hosts in multi-host runs: every
+    process runs the same loop (collectives stay aligned) but only host 0
+    touches the event files (utils/distributed.is_primary)."""
+
+    def __init__(self, output_dir: str = ""):
+        self.output_dir = output_dir
+        self._writers = []
+
+    def scalar(self, tag, value, step, training=True):
+        pass
+
+    def image(self, tag, image, step, training=True):
+        pass
+
+    def figure(self, tag, figure, step, training=True, close=True):
+        if close:
+            import matplotlib.pyplot as plt
+
+            plt.close(figure)
+
+    def image_cycle(self, tag, images, titles=None, step=0, training=False):
+        pass
+
+    def close(self):
+        pass
+
+
+def make_summary(output_dir: str, primary: bool) -> Summary:
+    return Summary(output_dir) if primary else NullSummary(output_dir)

@@ -38,11 +38,23 @@ def main(args: argparse.Namespace) -> None:
     from cyclegan_tpu.parallel import make_mesh_plan, shard_test_step, shard_train_step
     from cyclegan_tpu.train import create_state, make_cycle_step, make_test_step, make_train_step
     from cyclegan_tpu.train import loop
-    from cyclegan_tpu.utils import Summary, plot_cycle
+    from cyclegan_tpu.utils import make_summary, plot_cycle
+    from cyclegan_tpu.utils import distributed
     from cyclegan_tpu.utils.checkpoint import Checkpointer
+    from cyclegan_tpu.utils.preemption import PreemptionGuard
+    from cyclegan_tpu.utils.profiler import maybe_trace
 
-    if args.clear_output_dir and os.path.exists(args.output_dir):
+    # Multi-host pods: one process per host, global arrays, DCN-aware
+    # collectives. No-op on single-host (SURVEY.md §2.3 — the capability
+    # the reference lacks).
+    distributed.maybe_initialize()
+    primary = distributed.is_primary()
+
+    if primary and args.clear_output_dir and os.path.exists(args.output_dir):
         rmtree(args.output_dir)
+    # Order host-0's rmtree before any host probes the checkpoint slot —
+    # without this, hosts could disagree on resume state and diverge.
+    distributed.barrier("output_dir_ready")
     os.makedirs(args.output_dir, exist_ok=True)
 
     config = Config(
@@ -76,46 +88,70 @@ def main(args: argparse.Namespace) -> None:
     # Device mesh — replaces MirroredStrategy (reference main.py:370-373).
     plan = make_mesh_plan(config.parallel)
     global_batch_size = plan.n_data * config.train.batch_size
-    print(f"Devices: {plan.n_devices} ({plan.n_data} data x {plan.n_spatial} spatial), "
-          f"global batch size: {global_batch_size}")
+    if primary:
+        print(f"Devices: {plan.n_devices} ({plan.n_data} data x {plan.n_spatial} spatial), "
+              f"global batch size: {global_batch_size}")
 
-    summary = Summary(config.train.output_dir)
+    summary = make_summary(config.train.output_dir, primary)
     data = build_data(config, global_batch_size)
-    print(f"Dataset {data.source.name}: {data.n_train} train / {data.n_test} test pairs, "
-          f"{data.train_steps} train steps, {data.test_steps} test steps per epoch")
+    if primary:
+        print(f"Dataset {data.source.name}: {data.n_train} train / {data.n_test} test pairs, "
+              f"{data.train_steps} train steps, {data.test_steps} test steps per epoch")
 
     state = create_state(config, jax.random.PRNGKey(config.train.seed))
 
     # Auto-resume from the single checkpoint slot (reference main.py:383).
     ckpt = Checkpointer(config.train.output_dir)
     state, start_epoch, resumed = ckpt.restore_if_exists(state)
-    if resumed:
+    if resumed and primary:
         print(f"Resumed from {ckpt.slot} at epoch {start_epoch}")
 
     train_step = shard_train_step(plan, make_train_step(config, global_batch_size))
     test_step = shard_test_step(plan, make_test_step(config, global_batch_size))
     cycle_step = jax.jit(make_cycle_step(config))
 
-    for epoch in range(start_epoch, config.train.epochs):
-        print(f"Epoch {epoch + 1:03d}/{config.train.epochs:03d}")
-        start = time()
-        state = loop.train_epoch(config, data, plan, train_step, state, summary, epoch)
-        results = loop.test_epoch(config, data, plan, test_step, state, summary, epoch)
-        elapse = time() - start
-        summary.scalar("elapse", elapse, step=epoch)
-        summary.scalar(
-            "images_per_sec",
-            loop.images_per_sec(2 * data.n_train, elapse),
-            step=epoch,
-        )
-        loop.print_epoch_summary(results, elapse)
+    # Preemption (SIGTERM on TPU maintenance events): finish the epoch,
+    # checkpoint, exit; auto-resume continues from the next epoch.
+    guard = PreemptionGuard()
+    tracer = maybe_trace(config.train.output_dir, args.trace if primary else 0)
 
-        if epoch % config.train.checkpoint_every == 0 or epoch == config.train.epochs - 1:
-            ckpt.save(state, epoch)
-            print(f"saved checkpoint to {ckpt.slot}")
-            plot_cycle(data.plot_pairs(), cycle_step, state, summary, epoch)
+    try:
+        for epoch in range(start_epoch, config.train.epochs):
+            if primary:
+                print(f"Epoch {epoch + 1:03d}/{config.train.epochs:03d}")
+            start = time()
+            state = loop.train_epoch(
+                config, data, plan, train_step, state, summary, epoch, tracer=tracer
+            )
+            results = loop.test_epoch(config, data, plan, test_step, state, summary, epoch)
+            elapse = time() - start
+            summary.scalar("elapse", elapse, step=epoch)
+            summary.scalar(
+                "images_per_sec",
+                loop.images_per_sec(2 * data.n_train, elapse),
+                step=epoch,
+            )
+            if primary:
+                loop.print_epoch_summary(results, elapse)
 
-    summary.close()
+            preempted = guard.should_stop()
+            last = epoch == config.train.epochs - 1
+            if preempted or last or epoch % config.train.checkpoint_every == 0:
+                ckpt.save(state, epoch)
+                if primary:
+                    print(f"saved checkpoint to {ckpt.slot}")
+                # Every host must run the jitted cycle inference (state is
+                # a global array); only host 0's summary writes anything.
+                plot_cycle(data.plot_pairs(), cycle_step, state, summary, epoch)
+            if preempted:
+                if primary:
+                    print("preemption requested: checkpointed, exiting cleanly")
+                break
+    finally:
+        # Flush the in-flight trace even when an epoch raises — profiling
+        # data from a crashed run is the data you want most.
+        tracer.stop()
+        summary.close()
 
 
 if __name__ == "__main__":
@@ -141,6 +177,10 @@ if __name__ == "__main__":
                         help="rematerialize residual blocks (512^2 HBM relief)")
     parser.add_argument("--spatial_parallelism", default=1, type=int,
                         help="shard the image H axis over this many mesh columns")
+    parser.add_argument("--trace", default=0, type=int, metavar="N",
+                        help="capture a jax.profiler trace of N train steps "
+                             "(steps 2..N+1 — step 1 is compile) to "
+                             "<output_dir>/traces")
     parser.add_argument("--fresh_augment", action="store_true",
                         help="re-augment every epoch instead of reproducing the "
                              "reference's cache-after-augment behavior")

@@ -1,0 +1,86 @@
+"""Auxiliary subsystems: trace capture, multi-host helpers, preemption.
+
+The reference has none of these (SURVEY.md §5 — tracing limited to a
+wall-clock scalar, no failure handling, single-host only); these are the
+TPU-framework additions, so the tests define their contracts.
+"""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cyclegan_tpu.utils import distributed
+from cyclegan_tpu.utils.preemption import PreemptionGuard
+from cyclegan_tpu.utils.profiler import TraceCapture, maybe_trace
+from cyclegan_tpu.utils.summary import NullSummary, make_summary
+
+
+def test_trace_capture_writes_trace(tmp_path):
+    tracer = TraceCapture(str(tmp_path), num_steps=3)
+    f = jax.jit(lambda x: x * 2 + 1)
+    x = jnp.ones((8, 8))
+    for _ in range(5):
+        f(x).block_until_ready()
+        tracer.step()
+    assert not tracer.enabled  # stopped itself after num_steps
+    trace_dir = tmp_path / "traces"
+    assert trace_dir.is_dir()
+    # jax writes plugins/profile/<ts>/*.trace.json.gz (or .pb) files
+    found = [
+        os.path.join(dp, fn)
+        for dp, _, fns in os.walk(trace_dir)
+        for fn in fns
+    ]
+    assert found, "no trace files produced"
+
+
+def test_maybe_trace_disabled_is_noop(tmp_path):
+    tracer = maybe_trace(str(tmp_path), 0)
+    for _ in range(3):
+        tracer.step()
+    tracer.stop()
+    assert not (tmp_path / "traces").exists()
+
+
+def test_distributed_single_host_helpers():
+    assert distributed.process_count() == 1
+    assert distributed.process_index() == 0
+    assert distributed.is_primary()
+    assert distributed.sync_flag(True) is True
+    assert distributed.sync_flag(False) is False
+    # no multi-host env vars -> no-op
+    assert distributed.maybe_initialize() is False
+
+
+def test_preemption_guard_signal_and_programmatic():
+    guard = PreemptionGuard(signals=(signal.SIGUSR1,))
+    try:
+        assert not guard.should_stop()
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert guard.requested_locally
+        assert guard.should_stop()
+    finally:
+        guard.uninstall()
+
+    guard2 = PreemptionGuard(install=False)
+    assert not guard2.should_stop()
+    guard2.request_stop()
+    assert guard2.should_stop()
+
+
+def test_null_summary_noops(tmp_path):
+    s = make_summary(str(tmp_path / "x"), primary=False)
+    assert isinstance(s, NullSummary)
+    s.scalar("a", 1.0, step=0)
+    s.image("b", np.zeros((4, 4, 3), np.uint8), step=0)
+    s.image_cycle("c", np.zeros((1, 3, 4, 4, 3), np.uint8), step=0)
+    s.close()
+    assert not (tmp_path / "x").exists()  # never touched the filesystem
+
+    s2 = make_summary(str(tmp_path / "y"), primary=True)
+    s2.scalar("a", 1.0, step=0)
+    s2.close()
+    assert any(f.startswith("events") for f in os.listdir(tmp_path / "y"))

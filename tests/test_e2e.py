@@ -35,11 +35,13 @@ def run_main(out_dir, extra=()):
 @pytest.mark.slow
 def test_main_end_to_end_and_resume(tmp_path):
     out = tmp_path / "run"
-    r = run_main(out)
+    r = run_main(out, extra=("--trace", "2"))
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     # TB event files for train and test writers (utils.py:21-24 parity)
     assert any(f.startswith("events") for f in os.listdir(out))
     assert any(f.startswith("events") for f in os.listdir(out / "test"))
+    # --trace N captured a profiler trace (SURVEY.md §5 tracing subsystem)
+    assert (out / "traces").is_dir() and any((out / "traces").rglob("*"))
     # single checkpoint slot written (main.py:400-401 parity)
     assert (out / "checkpoints" / "checkpoint").is_dir()
     assert "MAE(X, F(G(X)))" in r.stdout

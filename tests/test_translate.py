@@ -1,0 +1,62 @@
+"""Inference CLI: translate a folder of images with a trained checkpoint
+(framework extension — the reference has no inference entry point)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_translate_cli(tmp_path):
+    from PIL import Image
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+
+    # 1) Train one tiny epoch to produce a checkpoint.
+    run_dir = tmp_path / "run"
+    r = subprocess.run(
+        [sys.executable, "main.py", "--output_dir", str(run_dir),
+         "--epochs", "1", "--batch_size", "2", "--verbose", "0",
+         "--data_source", "synthetic", "--image_size", "32",
+         "--synthetic_train_size", "2", "--synthetic_test_size", "2"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert r.returncode == 0, r.stderr
+
+    # 2) Translate a 3-image folder (batch 2 -> exercises ragged padding).
+    src = tmp_path / "in"
+    src.mkdir()
+    rng = np.random.RandomState(0)
+    for i in range(3):
+        Image.fromarray(rng.randint(0, 255, (40, 48, 3), np.uint8)).save(
+            src / f"im{i}.jpg"
+        )
+    out = tmp_path / "out"
+    r2 = subprocess.run(
+        [sys.executable, "translate.py", "--output_dir", str(run_dir),
+         "--input", str(src), "--output", str(out), "--image_size", "32",
+         "--batch_size", "2", "--panels"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert r2.returncode == 0, f"stdout:\n{r2.stdout}\nstderr:\n{r2.stderr}"
+    for i in range(3):
+        im = Image.open(out / f"im{i}.png")
+        assert im.size == (32, 32)
+        panel = Image.open(out / f"im{i}_panel.png")
+        assert panel.size == (96, 32)  # [input | translated | cycled]
+
+    # 3) Missing checkpoint -> clean error.
+    r3 = subprocess.run(
+        [sys.executable, "translate.py", "--output_dir", str(tmp_path / "none"),
+         "--input", str(src), "--output", str(out), "--image_size", "32"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert r3.returncode != 0
+    assert "no checkpoint" in r3.stderr

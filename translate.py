@@ -1,0 +1,120 @@
+"""Batch image translation with a trained CycleGAN checkpoint.
+
+Inference companion to main.py (the reference offers only the in-training
+cycle plots, /root/reference/cyclegan/utils.py:112-145 — it has no way to
+run a trained model over new images). Loads the single checkpoint slot
+from --output_dir, maps every image in --input through the chosen
+generator (G: X->Y by default, F: Y->X with --direction BtoA), and writes
+PNGs to --output. Optionally emits [input, translated, cycled] panels
+like the training-time plots (--panels).
+
+Usage:
+  python translate.py --output_dir runs --input path/to/images \
+      --output translated/ [--direction BtoA] [--image_size 256] [--panels]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+from cyclegan_tpu.utils.platform import ensure_platform_from_env
+
+IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".webp")
+
+
+def load_image(path: str, size: int) -> np.ndarray:
+    """Decode, bilinear-resize to size^2, scale to [-1, 1] — the test-time
+    preprocessing of the reference (main.py:47-50)."""
+    from PIL import Image
+
+    im = Image.open(path).convert("RGB").resize((size, size), Image.BILINEAR)
+    return np.asarray(im, np.float32) / 127.5 - 1.0
+
+
+def save_image(path: str, x: np.ndarray) -> None:
+    from PIL import Image
+
+    from cyclegan_tpu.utils.plotting import to_uint8
+
+    Image.fromarray(to_uint8(x)).save(path)
+
+
+def main(args: argparse.Namespace) -> None:
+    ensure_platform_from_env()
+    import jax
+
+    from cyclegan_tpu.config import Config, ModelConfig, TrainConfig
+    from cyclegan_tpu.train import create_state
+    from cyclegan_tpu.train.state import build_models
+    from cyclegan_tpu.utils.checkpoint import Checkpointer
+
+    config = Config(
+        model=ModelConfig(image_size=args.image_size),
+        train=TrainConfig(output_dir=args.output_dir),
+    )
+    state = create_state(config, jax.random.PRNGKey(config.train.seed))
+    ckpt = Checkpointer(args.output_dir)
+    state, _, resumed = ckpt.restore_if_exists(state)
+    if not resumed:
+        raise SystemExit(f"no checkpoint under {args.output_dir}/checkpoints")
+
+    gen, _ = build_models(config)
+    # AtoB: translate with G, cycle back with F; BtoA: the reverse.
+    fwd_params, bwd_params = (
+        (state.g_params, state.f_params)
+        if args.direction == "AtoB"
+        else (state.f_params, state.g_params)
+    )
+
+    @jax.jit
+    def translate(x):
+        fake = gen.apply(fwd_params, x)
+        cycled = gen.apply(bwd_params, fake)
+        return fake, cycled
+
+    if os.path.isdir(args.input):
+        names = sorted(
+            f for f in os.listdir(args.input)
+            if f.lower().endswith(IMAGE_EXTS)
+        )
+        paths = [os.path.join(args.input, f) for f in names]
+    else:
+        paths = [args.input]
+        names = [os.path.basename(args.input)]
+    if not paths:
+        raise SystemExit(f"no images found in {args.input}")
+
+    os.makedirs(args.output, exist_ok=True)
+    bs = args.batch_size
+    for lo in range(0, len(paths), bs):
+        chunk = paths[lo : lo + bs]
+        batch = np.stack([load_image(p, args.image_size) for p in chunk])
+        # Pad the final chunk so there is exactly one compiled program.
+        pad = bs - len(chunk)
+        if pad:
+            batch = np.concatenate([batch, np.zeros((pad,) + batch.shape[1:], np.float32)])
+        fake, cycled = (np.asarray(a) for a in translate(batch))
+        for j, name in enumerate(names[lo : lo + bs]):
+            stem = os.path.splitext(name)[0]
+            save_image(os.path.join(args.output, f"{stem}.png"), fake[j])
+            if args.panels:
+                panel = np.concatenate([batch[j], fake[j], cycled[j]], axis=1)
+                save_image(os.path.join(args.output, f"{stem}_panel.png"), panel)
+    print(f"translated {len(paths)} images -> {args.output}")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--output_dir", default="runs",
+                   help="training output dir holding checkpoints/")
+    p.add_argument("--input", required=True, help="image file or directory")
+    p.add_argument("--output", required=True, help="directory for translated PNGs")
+    p.add_argument("--direction", default="AtoB", choices=["AtoB", "BtoA"])
+    p.add_argument("--image_size", default=256, type=int)
+    p.add_argument("--batch_size", default=8, type=int)
+    p.add_argument("--panels", action="store_true",
+                   help="also save [input | translated | cycled] panels")
+    main(p.parse_args())
