@@ -1,0 +1,6 @@
+"""Native (C++) preprocessing sources, built lazily by data/native.py.
+
+This __init__ exists so setuptools discovers the directory as a package
+and ships cgdata.cpp (pyproject [tool.setuptools.package-data]) — without
+it, packaged installs would silently lose the native path.
+"""

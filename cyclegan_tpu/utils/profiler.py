@@ -51,9 +51,15 @@ class TraceCapture:
         if not self._active:
             return
         import jax
+        import jax.numpy as jnp
 
-        # Block so async dispatch from the traced window lands in the trace.
-        jax.effects_barrier()
+        # Fence: devices execute programs in dispatch order, so fetching
+        # the result of a trivial program dispatched NOW guarantees every
+        # previously dispatched (pure) train step has finished on device.
+        # (jax.effects_barrier only waits on effectful computations and
+        # would return immediately for pure steps.)
+        for d in jax.local_devices():
+            jax.device_get(jax.device_put(jnp.zeros(()), d) + 1)
         jax.profiler.stop_trace()
         self._active = False
         self.enabled = False

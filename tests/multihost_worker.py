@@ -1,0 +1,68 @@
+"""Worker process for the two-process multi-host test (test_multihost.py).
+
+Each process owns 2 virtual CPU devices (4 global). The worker initializes
+jax.distributed, builds the 4-device data mesh, assembles its half of a
+fixed global batch via shard_batch's make_array_from_process_local_data
+path, runs two fused train steps, and prints the metrics as JSON — which
+must be identical on every process and equal to a single-process run of
+the same global batch.
+"""
+
+import json
+import os
+import sys
+
+import jax
+
+# The image's sitecustomize force-overrides jax_platforms at interpreter
+# start; re-assert CPU before any backend/distributed initialization.
+jax.config.update("jax_platforms", "cpu")
+
+jax.distributed.initialize(
+    coordinator_address=os.environ["TEST_COORD"],
+    num_processes=int(os.environ["TEST_NPROC"]),
+    process_id=int(os.environ["TEST_PID"]),
+)
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cyclegan_tpu.config import tiny_test_config  # noqa: E402
+from cyclegan_tpu.parallel import make_mesh_plan, shard_batch, shard_train_step  # noqa: E402
+from cyclegan_tpu.parallel.mesh import replicated  # noqa: E402
+from cyclegan_tpu.train import create_state, make_train_step  # noqa: E402
+
+
+def main():
+    assert jax.process_count() == int(os.environ["TEST_NPROC"])
+    assert len(jax.devices()) == 4  # 2 local x 2 processes
+
+    config = tiny_test_config()
+    plan = make_mesh_plan(config.parallel)
+    assert plan.n_data == 4
+    global_batch = 4
+
+    state = create_state(config, jax.random.PRNGKey(0))
+    state = jax.device_put(state, replicated(plan))
+    step = shard_train_step(plan, make_train_step(config, global_batch))
+
+    s = config.model.image_size
+    rng = np.random.RandomState(0)  # same stream on every process
+    for i in range(2):
+        x = rng.rand(global_batch, s, s, 3).astype(np.float32) * 2 - 1
+        y = rng.rand(global_batch, s, s, 3).astype(np.float32) * 2 - 1
+        w = np.ones((global_batch,), np.float32)
+        # Each process passes only ITS slice; shard_batch assembles the
+        # global arrays from process-local data (the DCN input story).
+        per = global_batch // jax.process_count()
+        lo = jax.process_index() * per
+        xs, ys, ws = shard_batch(plan, x[lo:lo + per], y[lo:lo + per], w[lo:lo + per])
+        state, metrics = step(state, xs, ys, ws)
+
+    out = {k: float(v) for k, v in jax.device_get(metrics).items()}
+    print("METRICS " + json.dumps(out, sort_keys=True), flush=True)
+
+
+if __name__ == "__main__":
+    main()

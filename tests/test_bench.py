@@ -1,0 +1,37 @@
+"""bench.py emission contract: exactly one JSON line on stdout, even when
+configs fail or the driver kills the process mid-run."""
+
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+import bench  # noqa: E402
+
+
+def _last_json(capsys):
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, f"expected one JSON line, got {out}"
+    return json.loads(out[0])
+
+
+def test_emit_empty(capsys):
+    bench._emit({}, done=True)
+    d = _last_json(capsys)
+    assert d["value"] == 0.0 and "error" in d
+    assert d["metric"] == "cyclegan_256_train_images_per_sec_1chip"
+
+
+def test_emit_best_and_partial(capsys):
+    bench._emit({"steps/float32/b1": 25.0, "scan/bfloat16/b8": 81.7}, done=False)
+    d = _last_json(capsys)
+    assert d["value"] == 81.7
+    assert d["config"] == "scan/bfloat16/b8"
+    assert d["vs_baseline"] == round(81.7 / 15.0, 3)
+    assert d["partial"] is True
+    assert set(d["all"]) == {"steps/float32/b1", "scan/bfloat16/b8"}
+
+
+def test_emit_done_has_no_partial_flag(capsys):
+    bench._emit({"k": 1.0}, done=True)
+    assert "partial" not in _last_json(capsys)

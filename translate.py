@@ -26,12 +26,16 @@ IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".webp")
 
 
 def load_image(path: str, size: int) -> np.ndarray:
-    """Decode, bilinear-resize to size^2, scale to [-1, 1] — the test-time
-    preprocessing of the reference (main.py:47-50)."""
+    """Decode, then apply the SAME test-time preprocessing the model was
+    trained/evaluated with (data/augment.py preprocess_test: half-pixel-
+    center bilinear resize + [-1, 1] normalize — reference main.py:47-50).
+    PIL only decodes; the resize must not diverge from the pipeline's."""
     from PIL import Image
 
-    im = Image.open(path).convert("RGB").resize((size, size), Image.BILINEAR)
-    return np.asarray(im, np.float32) / 127.5 - 1.0
+    from cyclegan_tpu.data.augment import preprocess_test
+
+    raw = np.asarray(Image.open(path).convert("RGB"), np.uint8)
+    return preprocess_test(raw, size)
 
 
 def save_image(path: str, x: np.ndarray) -> None:
@@ -86,6 +90,14 @@ def main(args: argparse.Namespace) -> None:
         names = [os.path.basename(args.input)]
     if not paths:
         raise SystemExit(f"no images found in {args.input}")
+    # Output stems: strip the extension unless two inputs share a stem
+    # (a.jpg + a.png), in which case keep the full name so neither output
+    # silently overwrites the other.
+    from collections import Counter
+
+    bare = [os.path.splitext(n)[0] for n in names]
+    counts = Counter(bare)
+    stems = [b if counts[b] == 1 else n for n, b in zip(names, bare)]
 
     os.makedirs(args.output, exist_ok=True)
     bs = args.batch_size
@@ -97,8 +109,7 @@ def main(args: argparse.Namespace) -> None:
         if pad:
             batch = np.concatenate([batch, np.zeros((pad,) + batch.shape[1:], np.float32)])
         fake, cycled = (np.asarray(a) for a in translate(batch))
-        for j, name in enumerate(names[lo : lo + bs]):
-            stem = os.path.splitext(name)[0]
+        for j, stem in enumerate(stems[lo : lo + bs]):
             save_image(os.path.join(args.output, f"{stem}.png"), fake[j])
             if args.panels:
                 panel = np.concatenate([batch[j], fake[j], cycled[j]], axis=1)
