@@ -27,6 +27,8 @@ import signal
 import sys
 import time
 
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -63,6 +65,8 @@ def _build(compute_dtype: str, batch: int, image: int, norm_impl: str):
         train=TrainConfig(batch_size=batch),
     )
     state = create_state(cfg, jax.random.PRNGKey(0))
+    global _PLATFORM
+    _PLATFORM = jax.default_backend()  # backend is up once state exists
     step = make_train_step(cfg, batch)
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.rand(batch, image, image, 3).astype(np.float32) * 2 - 1)
@@ -124,11 +128,23 @@ def bench_scan(compute_dtype: str, batch: int, image: int = 256,
     return 2 * batch * k * iters / dt
 
 
+# Cached by the first successful _build; the emit path must NEVER call
+# jax.default_backend() itself — against a dead TPU transport that call
+# blocks indefinitely, which would wedge the watchdog/signal emitters.
+_PLATFORM = "unknown (backend never initialized)"
+
+
+def _backend() -> str:
+    return _PLATFORM
+
+
 def _emit(results, done: bool) -> None:
+    results = dict(results)  # snapshot: emitters race the config loop
     if not results:
         print(json.dumps({"metric": "cyclegan_256_train_images_per_sec_1chip",
                           "value": 0.0, "unit": "images/sec",
-                          "vs_baseline": 0.0, "error": "no config completed"}),
+                          "vs_baseline": 0.0, "error": "no config completed",
+                          "platform": _backend()}),
               flush=True)
         return
     best_key = max(results, key=results.get)
@@ -139,6 +155,9 @@ def _emit(results, done: bool) -> None:
         "unit": "images/sec",
         "vs_baseline": round(best / 15.0, 3),
         "config": best_key,
+        # Honest labeling: if the TPU backend was unavailable and JAX fell
+        # back to CPU, the numbers must not read as chip numbers.
+        "platform": _backend(),
         "all": {k: round(v, 2) for k, v in results.items()},
     }
     if not done:
@@ -149,18 +168,31 @@ def _emit(results, done: bool) -> None:
 def main():
     results = {}
     t_start = time.perf_counter()
+    finished = threading.Event()  # set before the final emit: disarms
+    # every late emitter (watchdog thread, pending signals)
 
     def on_kill(signum, frame):
+        if finished.is_set():
+            return
         _emit(results, done=False)
         os._exit(0)
 
     signal.signal(signal.SIGTERM, on_kill)
     signal.signal(signal.SIGALRM, on_kill)
-    # Hard deadline: a wedged remote compile can hang a config past any
-    # between-config budget check; the alarm guarantees the JSON line
-    # still gets printed (with whatever completed) before the driver
-    # would have to SIGKILL us.
-    signal.alarm(int(TIME_BUDGET_S) + 240)
+    signal.alarm(max(0, int(TIME_BUDGET_S) + 240))
+    # Hard deadline. Signals alone are NOT enough: when the main thread
+    # is wedged inside a C call (e.g. backend init against a dead TPU
+    # transport), Python signal handlers never run — observed in
+    # practice. A daemon thread can still print the JSON line and
+    # _exit the process from outside the stuck call.
+    def watchdog():
+        time.sleep(max(5.0, TIME_BUDGET_S + 270))
+        if finished.is_set():
+            return
+        _emit(results, done=False)
+        os._exit(0)
+
+    threading.Thread(target=watchdog, daemon=True).start()
 
     # Two configs only: each compile through a remote-TPU tunnel can take
     # minutes, and the driver's bench window is bounded.
@@ -184,8 +216,9 @@ def main():
         except Exception as e:
             print(f"[bench] {key}: FAILED {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
-    # Disarm the kill handlers before the final emit so a late SIGTERM
-    # can't print a second JSON line over this one.
+    # Disarm every late emitter (watchdog thread, pending/incoming
+    # signals) before the final emit so exactly one JSON line prints.
+    finished.set()
     signal.signal(signal.SIGTERM, signal.SIG_IGN)
     signal.signal(signal.SIGALRM, signal.SIG_IGN)
     _emit(results, done=True)

@@ -1,0 +1,150 @@
+"""Weak-scaling benchmark over the visible device mesh.
+
+BASELINE.md's scaling target: >=90% weak-scaling efficiency at global
+batch 256 on a v4-32 pod. This harness measures it on whatever devices
+are visible: per-device batch is held fixed while the mesh grows from 1
+device to all of them, so ideal scaling doubles images/sec with device
+count. Efficiency = (ips_N / N) / ips_1.
+
+The reference cannot express this measurement at all — MirroredStrategy
+publishes no scaling counters; its only timer is the per-epoch `elapse`
+scalar (/root/reference/main.py:388-392).
+
+Run on a TPU slice:   python bench_scaling.py --batch 8 --dtype bfloat16
+Smoke-run on CPU:     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+                        python bench_scaling.py --image 32 --tiny
+
+Prints ONE JSON line: {"metric": "weak_scaling_efficiency", ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from cyclegan_tpu.utils.platform import ensure_platform_from_env
+
+
+def measure(n_devices: int, args) -> float:
+    """images/sec on the first n_devices devices, scan-mode."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cyclegan_tpu.config import (
+        Config,
+        DiscriminatorConfig,
+        GeneratorConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cyclegan_tpu.parallel import make_mesh_plan
+    from cyclegan_tpu.parallel.mesh import replicated
+    from cyclegan_tpu.train import create_state, make_train_step
+
+    gen_cfg = (
+        GeneratorConfig(filters=8, num_residual_blocks=2)
+        if args.tiny
+        else GeneratorConfig()
+    )
+    disc_cfg = DiscriminatorConfig(filters=8) if args.tiny else DiscriminatorConfig()
+    cfg = Config(
+        model=ModelConfig(
+            generator=gen_cfg,
+            discriminator=disc_cfg,
+            compute_dtype=args.dtype,
+            image_size=args.image,
+        ),
+        train=TrainConfig(batch_size=args.batch),
+    )
+    plan = make_mesh_plan(cfg.parallel, jax.devices()[:n_devices])
+    global_batch = n_devices * args.batch
+
+    state = jax.device_put(
+        create_state(cfg, jax.random.PRNGKey(0)), replicated(plan)
+    )
+    step_fn = make_train_step(cfg, global_batch)
+    rep = replicated(plan)
+    # Stacked inputs are [k, batch, ...]: the scan axis k leads, so the
+    # batch shard spec moves to dim 1.
+    bs = NamedSharding(plan.mesh, P(None, plan.data_axis))
+    ws = NamedSharding(plan.mesh, P(None, plan.data_axis))
+
+    k = args.scan_steps
+
+    def multi_step(state, xs, ys, wts):
+        def body(st, inp):
+            st, m = step_fn(st, *inp)
+            return st, m["loss_G/total"]
+        state, losses = jax.lax.scan(body, state, (xs, ys, wts))
+        return state, losses[-1]
+
+    step = jax.jit(
+        multi_step,
+        in_shardings=(rep, bs, bs, ws),
+        out_shardings=(rep, rep),
+        donate_argnums=(0,),
+    )
+
+    rng = np.random.RandomState(0)
+    s = args.image
+    xs = jnp.asarray(rng.rand(k, global_batch, s, s, 3).astype(np.float32) * 2 - 1)
+    ys = jnp.asarray(rng.rand(k, global_batch, s, s, 3).astype(np.float32) * 2 - 1)
+    wts = jnp.ones((k, global_batch), jnp.float32)
+
+    state, last = step(state, xs, ys, wts)
+    float(jax.device_get(last))  # execution fence (not block_until_ready)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        state, last = step(state, xs, ys, wts)
+    float(jax.device_get(last))
+    dt = time.perf_counter() - t0
+    return 2 * global_batch * k * args.iters / dt
+
+
+def main(args) -> None:
+    ensure_platform_from_env()
+    import jax
+
+    n_all = len(jax.devices())
+    sizes = [1]
+    n = 2
+    while n < n_all:
+        sizes.append(n)
+        n *= 2
+    if n_all not in sizes:
+        sizes.append(n_all)
+
+    results = {}
+    for n in sizes:
+        ips = measure(n, args)
+        results[n] = ips
+        print(f"[scaling] {n} device(s): {ips:.2f} images/sec "
+              f"({ips / n:.2f}/device)", file=sys.stderr, flush=True)
+
+    eff = (results[n_all] / n_all) / results[1] if n_all > 1 else 1.0
+    print(json.dumps({
+        "metric": "weak_scaling_efficiency",
+        "value": round(eff, 4),
+        "unit": "fraction",
+        "vs_baseline": round(eff / 0.90, 3),  # target: >=90%
+        "devices": n_all,
+        "per_device_batch": args.batch,
+        "images_per_sec": {str(k): round(v, 2) for k, v in results.items()},
+    }))
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch", default=8, type=int, help="per-device batch")
+    p.add_argument("--dtype", default="bfloat16", choices=["float32", "bfloat16"])
+    p.add_argument("--image", default=256, type=int)
+    p.add_argument("--scan_steps", default=4, type=int)
+    p.add_argument("--iters", default=2, type=int)
+    p.add_argument("--tiny", action="store_true",
+                   help="tiny model (CPU smoke runs)")
+    main(p.parse_args())

@@ -2,9 +2,10 @@
 configs fail or the driver kills the process mid-run."""
 
 import json
+import os
 import sys
 
-sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench  # noqa: E402
 

@@ -1,0 +1,33 @@
+"""Weak-scaling harness mechanics (bench_scaling.py): runs over the
+8-device virtual mesh and emits one well-formed JSON line. Efficiency
+values are meaningless on virtual CPU devices (they share host cores);
+only the measurement machinery is under test."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_scaling_harness_emits_json():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable, "bench_scaling.py", "--image", "32", "--batch", "2",
+         "--tiny", "--scan_steps", "2", "--iters", "1"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert r.returncode == 0, r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert len(lines) == 1
+    d = json.loads(lines[0])
+    assert d["metric"] == "weak_scaling_efficiency"
+    assert d["devices"] == 8
+    assert set(d["images_per_sec"]) == {"1", "2", "4", "8"}
+    assert all(v > 0 for v in d["images_per_sec"].values())

@@ -1,0 +1,55 @@
+"""Bitwise run-to-run determinism.
+
+The reference's only reproducibility mechanism is seeding np/tf once
+(/root/reference/main.py:366-367) — actual run-to-run determinism is not
+guaranteed under tf.data's threaded shuffle. Here determinism is a
+contract: same seed => identical init, identical per-epoch data order and
+augmentations, identical metrics, bit for bit.
+"""
+
+import jax
+import numpy as np
+
+from cyclegan_tpu.data import build_data
+from cyclegan_tpu.parallel import make_mesh_plan, shard_batch, shard_train_step
+from cyclegan_tpu.parallel.mesh import replicated
+from cyclegan_tpu.train import create_state, make_train_step
+
+
+def _run_two_steps(tiny_config, devices):
+    config = tiny_config
+    plan = make_mesh_plan(config.parallel, devices[:4])
+    global_batch = 4
+    data = build_data(config, global_batch)
+    state = create_state(config, jax.random.PRNGKey(config.train.seed))
+    state = jax.device_put(state, replicated(plan))
+    step = shard_train_step(plan, make_train_step(config, global_batch))
+    out = []
+    for i, (x, y, w) in enumerate(data.train_epoch(0, prefetch=False)):
+        xs, ys, ws = shard_batch(plan, x, y, w)
+        state, metrics = step(state, xs, ys, ws)
+        out.append({k: float(v) for k, v in jax.device_get(metrics).items()})
+        if i == 1:
+            break
+    return out
+
+
+def test_same_seed_bitwise_identical(tiny_config, devices):
+    a = _run_two_steps(tiny_config, devices)
+    b = _run_two_steps(tiny_config, devices)
+    assert a == b  # exact float equality, not allclose
+
+
+def test_data_order_is_seeded_per_epoch(tiny_config):
+    data = build_data(tiny_config, 4)
+    e0 = list(data.train_epoch(0, prefetch=False))
+    e0b = list(data.train_epoch(0, prefetch=False))
+    e1 = list(data.train_epoch(1, prefetch=False))
+    for (x0, y0, w0), (x0b, y0b, w0b) in zip(e0, e0b):
+        np.testing.assert_array_equal(x0, x0b)
+        np.testing.assert_array_equal(y0, y0b)
+        np.testing.assert_array_equal(w0, w0b)
+    # different epoch => different order (full permutation reshuffles)
+    assert any(
+        not np.array_equal(x0, x1) for (x0, _, _), (x1, _, _) in zip(e0, e1)
+    )

@@ -68,12 +68,19 @@ def test_two_process_training_matches_single_process(tmp_path):
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         ))
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=600)
-        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
-        line = [l for l in out.splitlines() if l.startswith("METRICS ")]
-        assert line, f"no METRICS line in:\n{out}"
-        outs.append(json.loads(line[0][len("METRICS "):]))
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+            line = [l for l in out.splitlines() if l.startswith("METRICS ")]
+            assert line, f"no METRICS line in:\n{out}"
+            outs.append(json.loads(line[0][len("METRICS "):]))
+    finally:
+        # Never leak a live worker (it holds the coordinator port and two
+        # JAX runtimes) when the other worker fails or times out.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
     # Both processes agree exactly (metrics are replicated global scalars).
     assert outs[0] == outs[1]

@@ -90,14 +90,22 @@ def main(args: argparse.Namespace) -> None:
         names = [os.path.basename(args.input)]
     if not paths:
         raise SystemExit(f"no images found in {args.input}")
-    # Output stems: strip the extension unless two inputs share a stem
-    # (a.jpg + a.png), in which case keep the full name so neither output
-    # silently overwrites the other.
+    # Output stems: strip the extension unless that would collide
+    # (a.jpg + a.png), then uniquify whatever still collides (a.jpg +
+    # a.png + a.jpg.png) so no translation silently overwrites another.
     from collections import Counter
 
     bare = [os.path.splitext(n)[0] for n in names]
     counts = Counter(bare)
-    stems = [b if counts[b] == 1 else n for n, b in zip(names, bare)]
+    used, stems = set(), []
+    for n, b in zip(names, bare):
+        s = b if counts[b] == 1 else n
+        cand, i = s, 1
+        while cand in used:
+            cand = f"{s}__{i}"
+            i += 1
+        used.add(cand)
+        stems.append(cand)
 
     os.makedirs(args.output, exist_ok=True)
     bs = args.batch_size
