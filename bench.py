@@ -168,14 +168,24 @@ def _emit(results, done: bool) -> None:
 def main():
     results = {}
     t_start = time.perf_counter()
-    finished = threading.Event()  # set before the final emit: disarms
-    # every late emitter (watchdog thread, pending signals)
+
+    # Exactly-one-emit: every emitter (signal handler, watchdog thread,
+    # the normal exit path) must win this test-and-set first. A plain
+    # Event check is not atomic — two emitters could both pass it.
+    emit_lock = threading.Lock()
+    emitted = [False]
+
+    def emit_once(done: bool) -> bool:
+        with emit_lock:
+            if emitted[0]:
+                return False
+            emitted[0] = True
+        _emit(results, done=done)
+        return True
 
     def on_kill(signum, frame):
-        if finished.is_set():
-            return
-        _emit(results, done=False)
-        os._exit(0)
+        if emit_once(done=False):
+            os._exit(0)
 
     signal.signal(signal.SIGTERM, on_kill)
     signal.signal(signal.SIGALRM, on_kill)
@@ -187,10 +197,8 @@ def main():
     # _exit the process from outside the stuck call.
     def watchdog():
         time.sleep(max(5.0, TIME_BUDGET_S + 270))
-        if finished.is_set():
-            return
-        _emit(results, done=False)
-        os._exit(0)
+        if emit_once(done=False):
+            os._exit(0)
 
     threading.Thread(target=watchdog, daemon=True).start()
 
@@ -216,12 +224,11 @@ def main():
         except Exception as e:
             print(f"[bench] {key}: FAILED {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
-    # Disarm every late emitter (watchdog thread, pending/incoming
-    # signals) before the final emit so exactly one JSON line prints.
-    finished.set()
+    # Disarm signals BEFORE taking the emit lock: a handler firing while
+    # the main thread holds the (non-reentrant) lock would deadlock.
     signal.signal(signal.SIGTERM, signal.SIG_IGN)
     signal.signal(signal.SIGALRM, signal.SIG_IGN)
-    _emit(results, done=True)
+    emit_once(done=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,206 @@
+"""Independent torch re-implementation of the reference's training
+semantics, used as a cross-framework oracle by test_torch_parity.py.
+
+This mirrors the REFERENCE procedure (/root/reference/main.py:207-262)
+literally: all losses computed from pre-update weights on one retained
+graph, then four `torch.autograd.grad` pulls — each loss w.r.t. its own
+network's parameters only — exactly what the persistent GradientTape +
+per-net `minimize(var_list=...)` does. Comparing against our fused
+single-backward JAX step (cyclegan_tpu/train/steps.py) proves the
+stop_gradient placement there reproduces the tape semantics.
+
+Weight conventions (flax -> torch):
+- Conv kernel (kh, kw, cin, cout) -> conv2d weight (cout, cin, kh, kw).
+- flax ConvTranspose(SAME) kernel -> conv_transpose2d weight
+  (cin, cout, kh, kw) with a SPATIAL FLIP, full output cropped at the
+  origin (flax's lax.conv_transpose applies the kernel unflipped — a
+  reparameterization of Keras/torch's gradient-based transpose; verified
+  exact in test_torch_parity.py).
+- SAME padding reproduces TF's asymmetric rule (extra pad at the end).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+EPS_IN = 1e-3  # InstanceNorm epsilon (tfa default; ops/norm.py)
+
+
+def tf_same_pad(x: torch.Tensor, k: int, s: int) -> torch.Tensor:
+    """TF 'SAME' for an NCHW tensor: total = (ceil(in/s)-1)*s + k - in,
+    beg = total//2 (extra at the end)."""
+    h, w = x.shape[-2], x.shape[-1]
+
+    def amts(n):
+        out = -(-n // s)
+        total = max((out - 1) * s + k - n, 0)
+        beg = total // 2
+        return beg, total - beg
+
+    t, b = amts(h)
+    l, r = amts(w)
+    return F.pad(x, (l, r, t, b))
+
+
+def conv(x, kernel, bias, stride=1, same=True):
+    """flax-kernel conv. kernel: torch tensor shaped (kh,kw,cin,cout)."""
+    w = kernel.permute(3, 2, 0, 1)
+    if same:
+        x = tf_same_pad(x, kernel.shape[0], stride)
+    return F.conv2d(x, w, bias, stride=stride)
+
+
+def conv_transpose_same2(x, kernel):
+    """flax ConvTranspose(SAME, stride 2, no bias): flip + crop at origin."""
+    w = torch.flip(kernel, dims=(0, 1)).permute(2, 3, 0, 1)
+    full = F.conv_transpose2d(x, w, stride=2)
+    out_h, out_w = 2 * x.shape[-2], 2 * x.shape[-1]
+    return full[:, :, :out_h, :out_w]
+
+
+def instance_norm(x, scale, bias):
+    """Per-(N,C) moments over HW, biased variance, f32 (ops/norm.py)."""
+    mean = x.mean(dim=(2, 3), keepdim=True)
+    var = ((x - mean) ** 2).mean(dim=(2, 3), keepdim=True)
+    y = (x - mean) * torch.rsqrt(var + EPS_IN)
+    return y * scale[None, :, None, None] + bias[None, :, None, None]
+
+
+def reflect_pad(x, p):
+    return F.pad(x, (p, p, p, p), mode="reflect")
+
+
+def to_torch_tree(params) -> Dict:
+    """flax FrozenDict/dict -> nested dict of requires_grad torch leaves."""
+    def rec(node):
+        if hasattr(node, "items"):
+            return {k: rec(v) for k, v in node.items()}
+        t = torch.tensor(np.asarray(node), dtype=torch.float32)
+        t.requires_grad_(True)
+        return t
+
+    return rec(params)
+
+
+def leaves(tree) -> List[torch.Tensor]:
+    """Flatten in sorted-key order (matches jax.tree flattening order)."""
+    out = []
+    for k in sorted(tree.keys()):
+        v = tree[k]
+        if isinstance(v, dict):
+            out.extend(leaves(v))
+        else:
+            out.append(v)
+    return out
+
+
+def generator_forward(p: Dict, x: torch.Tensor, gen_cfg) -> torch.Tensor:
+    """Mirror of models/generator.py ResNetGenerator for any config."""
+    m = p["params"]
+    y = reflect_pad(x, 3)
+    y = conv(y, m["Conv_0"]["kernel"], None, stride=1, same=False)
+    y = instance_norm(y, m["InstanceNorm_0"]["scale"], m["InstanceNorm_0"]["bias"])
+    y = F.relu(y)
+    for i in range(gen_cfg.num_downsampling_blocks):
+        d = m[f"Downsample_{i}"]
+        y = conv(y, d["Conv_0"]["kernel"], None, stride=2, same=True)
+        y = instance_norm(y, d["InstanceNorm_0"]["scale"], d["InstanceNorm_0"]["bias"])
+        y = F.relu(y)
+    for i in range(gen_cfg.num_residual_blocks):
+        r = m[f"ResidualBlock_{i}"]
+        z = reflect_pad(y, 1)
+        z = conv(z, r["Conv_0"]["kernel"], None, stride=1, same=False)
+        z = instance_norm(z, r["InstanceNorm_0"]["scale"], r["InstanceNorm_0"]["bias"])
+        z = F.relu(z)
+        z = reflect_pad(z, 1)
+        z = conv(z, r["Conv_1"]["kernel"], None, stride=1, same=False)
+        z = instance_norm(z, r["InstanceNorm_1"]["scale"], r["InstanceNorm_1"]["bias"])
+        y = y + z
+    for i in range(gen_cfg.num_upsample_blocks):
+        u = m[f"Upsample_{i}"]
+        y = conv_transpose_same2(y, u["ConvTranspose_0"]["kernel"])
+        y = instance_norm(y, u["InstanceNorm_0"]["scale"], u["InstanceNorm_0"]["bias"])
+        y = F.relu(y)
+    y = reflect_pad(y, 3)
+    y = conv(y, m["Conv_1"]["kernel"], m["Conv_1"]["bias"], stride=1, same=False)
+    return torch.tanh(y)
+
+
+def discriminator_forward(p: Dict, x: torch.Tensor, disc_cfg) -> torch.Tensor:
+    """Mirror of models/discriminator.py PatchGANDiscriminator."""
+    m = p["params"]
+    y = conv(x, m["Conv_0"]["kernel"], m["Conv_0"]["bias"], stride=2, same=True)
+    y = F.leaky_relu(y, 0.2)
+    for i in range(disc_cfg.num_downsampling):
+        d = m[f"Downsample_{i}"]
+        stride = 2 if i < 2 else 1
+        y = conv(y, d["Conv_0"]["kernel"], None, stride=stride, same=True)
+        y = instance_norm(y, d["InstanceNorm_0"]["scale"], d["InstanceNorm_0"]["bias"])
+        y = F.leaky_relu(y, 0.2)
+    return conv(y, m["Conv_1"]["kernel"], m["Conv_1"]["bias"], stride=1, same=True)
+
+
+def per_sample_mean(x: torch.Tensor) -> torch.Tensor:
+    return x.mean(dim=tuple(range(1, x.ndim)))
+
+
+def scaled(per_sample: torch.Tensor, gbs: float) -> torch.Tensor:
+    return per_sample.sum() / gbs
+
+
+def reference_losses(config, tg, tf_, tdx, tdy, x, y, gbs):
+    """All ten training losses from pre-update weights (main.py:207-247).
+    NO detach anywhere — the reference's tape keeps the full graph; the
+    per-net gradient restriction happens in the autograd.grad pulls."""
+    gen_cfg = config.model.generator
+    disc_cfg = config.model.discriminator
+    lam_c = config.loss.lambda_cycle
+    lam_i = config.loss.lambda_identity
+
+    G = lambda p, a: generator_forward(p, a, gen_cfg)
+    D = lambda p, a: discriminator_forward(p, a, disc_cfg)
+
+    fake_y = G(tg, x)
+    fake_x = G(tf_, y)
+
+    mse1 = lambda t: per_sample_mean((1.0 - t) ** 2)
+    mse0 = lambda t: per_sample_mean(t ** 2)
+    mae = lambda a, b: per_sample_mean((a - b).abs())
+
+    g_adv = scaled(mse1(D(tdy, fake_y)), gbs)
+    f_adv = scaled(mse1(D(tdx, fake_x)), gbs)
+    g_cycle = lam_c * scaled(mae(y, G(tg, fake_x)), gbs)
+    f_cycle = lam_c * scaled(mae(x, G(tf_, fake_y)), gbs)
+    g_id = lam_i * scaled(mae(y, G(tg, y)), gbs)
+    f_id = lam_i * scaled(mae(x, G(tf_, x)), gbs)
+    g_total = g_adv + g_cycle + g_id
+    f_total = f_adv + f_cycle + f_id
+    x_loss = scaled(0.5 * (mse1(D(tdx, x)) + mse0(D(tdx, fake_x))), gbs)
+    y_loss = scaled(0.5 * (mse1(D(tdy, y)) + mse0(D(tdy, fake_y))), gbs)
+    return {
+        "loss_G/loss": g_adv, "loss_G/cycle": g_cycle, "loss_G/identity": g_id,
+        "loss_G/total": g_total,
+        "loss_F/loss": f_adv, "loss_F/cycle": f_cycle, "loss_F/identity": f_id,
+        "loss_F/total": f_total,
+        "loss_X/loss": x_loss, "loss_Y/loss": y_loss,
+    }
+
+
+def reference_grads(config, tg, tf_, tdx, tdy, x, y, gbs):
+    """The four per-network gradient pulls of main.py:249-260."""
+    L = reference_losses(config, tg, tf_, tdx, tdy, x, y, gbs)
+    pulls = [
+        (L["loss_G/total"], leaves(tg)),
+        (L["loss_F/total"], leaves(tf_)),
+        (L["loss_X/loss"], leaves(tdx)),
+        (L["loss_Y/loss"], leaves(tdy)),
+    ]
+    grads = [
+        torch.autograd.grad(loss, ps, retain_graph=True, allow_unused=False)
+        for loss, ps in pulls
+    ]
+    return L, grads
