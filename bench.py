@@ -33,18 +33,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from cyclegan_tpu.utils.platform import enable_compilation_cache
+
 # Persistent compilation cache: compiles of the bench programs can take
 # minutes each (remote-TPU transports especially); cache them so repeat
 # runs — including the driver's — start hot.
-try:
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                       os.path.expanduser("~/.cache/jax_comp_cache")),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-except Exception:
-    pass
+enable_compilation_cache()
 
 # Leave headroom for the slow remote compiles: skip configs that would
 # start after the budget is spent, and emit the JSON line from a SIGTERM/
@@ -184,6 +178,11 @@ def main():
         return True
 
     def on_kill(signum, frame):
+        # Disarm BOTH signals first: a nested delivery (SIGALRM landing
+        # inside the SIGTERM handler) would deadlock on the non-reentrant
+        # emit lock, since both handlers run on the main thread.
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        signal.signal(signal.SIGALRM, signal.SIG_IGN)
         if emit_once(done=False):
             os._exit(0)
 

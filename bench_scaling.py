@@ -108,11 +108,13 @@ def measure(n_devices: int, args) -> float:
 def _emit(results, n_all, args) -> None:
     results = dict(results)
     max_n = max(results) if results else 0
-    eff = (
-        (results[max_n] / max_n) / results[1]
-        if results and max_n > 1 and 1 in results
-        else (1.0 if results else 0.0)
-    )
+    scaled = max_n > 1 and 1 in results
+    if scaled:
+        eff = (results[max_n] / max_n) / results[1]
+    elif results and n_all == 1:
+        eff = 1.0  # single-device platform: nothing to scale over
+    else:
+        eff = 0.0  # multi-device platform but no scaling was measured
     line = {
         "metric": "weak_scaling_efficiency",
         "value": round(eff, 4),
@@ -125,17 +127,23 @@ def _emit(results, n_all, args) -> None:
     }
     if not results:
         line["error"] = "no mesh size completed"
+    elif not scaled and n_all > 1:
+        line["error"] = "only the 1-device size completed; no scaling measured"
     print(json.dumps(line), flush=True)
 
 
 def main(args) -> None:
     ensure_platform_from_env()
+    from cyclegan_tpu.utils.platform import enable_compilation_cache
+
+    enable_compilation_cache()
 
     results = {}
 
-    # Same hang protection as bench.py: one compile wedging must not
-    # swallow the sizes that already completed.
+    # Same hang/kill protection as bench.py: one compile wedging — or the
+    # driver's SIGTERM — must not swallow the sizes that already completed.
     import os
+    import signal
     import threading
 
     budget = float(os.environ.get("BENCH_TIME_BUDGET_S", "480"))
@@ -150,6 +158,18 @@ def main(args) -> None:
             emitted[0] = True
         _emit(results, n_all_box[0], args)
         return True
+
+    def on_kill(signum, frame):
+        # Disarm both first: nested delivery would deadlock the
+        # non-reentrant emit lock on the main thread.
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        signal.signal(signal.SIGALRM, signal.SIG_IGN)
+        if emit_once():
+            os._exit(0)
+
+    signal.signal(signal.SIGTERM, on_kill)
+    signal.signal(signal.SIGALRM, on_kill)
+    signal.alarm(max(0, int(budget) + 240))
 
     def watchdog():
         time.sleep(max(5.0, budget + 270))

@@ -22,3 +22,22 @@ def ensure_platform_from_env() -> None:
         jax.config.update("jax_platforms", plat)
     except Exception:
         pass
+
+
+def enable_compilation_cache() -> None:
+    """Persistent XLA compilation cache (JAX_COMPILATION_CACHE_DIR or
+    ~/.cache/jax_comp_cache). Programs here compile in minutes on
+    remote-TPU transports; the cache makes restarts/resumes start hot."""
+    import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get(
+                "JAX_COMPILATION_CACHE_DIR",
+                os.path.expanduser("~/.cache/jax_comp_cache"),
+            ),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass

@@ -22,11 +22,15 @@ from time import time
 import jax
 import numpy as np
 
-from cyclegan_tpu.utils.platform import ensure_platform_from_env
+from cyclegan_tpu.utils.platform import (
+    enable_compilation_cache,
+    ensure_platform_from_env,
+)
 
 
 def main(args: argparse.Namespace) -> None:
     ensure_platform_from_env()
+    enable_compilation_cache()
     from cyclegan_tpu.config import (
         Config,
         DataConfig,

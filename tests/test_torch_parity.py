@@ -28,9 +28,11 @@ from cyclegan_tpu.models import PatchGANDiscriminator, ResNetGenerator
 from cyclegan_tpu.train import create_state
 from cyclegan_tpu.train.steps import make_grad_fn
 
-tr = pytest.importorskip(
-    "torch_reference"  # tests/ is on sys.path under pytest rootdir
-)
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import torch_reference as tr  # noqa: E402  (first-party: fail loudly)
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,52 @@
+"""Training dynamics smoke test: the system actually optimizes.
+
+Gradient-structure tests (test_steps, test_torch_parity) prove the step
+computes the right gradients; this proves the assembled system — data,
+losses, four Adams at the reference's lr=2e-4/b1=0.5/b2=0.9 — moves the
+networks in the right direction. The DISCRIMINATOR objective is the
+probe: separating real images from the near-constant outputs of freshly
+initialized generators is easy, so `loss_X + loss_Y` must fall fast
+(measured: 1.00 -> ~0.62 in 120 steps). Reconstruction losses are NOT
+asserted: with the reference's IN-gamma ~ N(0, 0.02) init the signal
+path is crushed and cycle/identity improvement takes thousands of steps
+— far beyond a test budget. Deterministic (fixed seed, CPU), so not
+flaky.
+"""
+
+import jax
+import numpy as np
+
+from cyclegan_tpu.train import create_state, make_train_step
+
+
+def test_discriminator_losses_decrease(tiny_config):
+    config = tiny_config
+    batch = 4
+    step = jax.jit(make_train_step(config, batch))
+    state = create_state(config, jax.random.PRNGKey(3))
+
+    rng = np.random.RandomState(3)
+    s = config.model.image_size
+    # Fixed small dataset of 2 batches, cycled.
+    data = [
+        (
+            (rng.rand(batch, s, s, 3).astype(np.float32) * 2 - 1),
+            (rng.rand(batch, s, s, 3).astype(np.float32) * 2 - 1),
+        )
+        for _ in range(2)
+    ]
+    w = np.ones((batch,), np.float32)
+
+    history = []
+    for i in range(120):
+        x, y = data[i % len(data)]
+        state, metrics = step(state, x, y, w)
+        m = jax.device_get(metrics)
+        history.append(float(m["loss_X/loss"]) + float(m["loss_Y/loss"]))
+
+    early = np.mean(history[:5])
+    late = np.mean(history[-5:])
+    assert np.isfinite(history).all()
+    assert late < 0.8 * early, (
+        f"discriminator losses did not improve: early {early:.4f} -> late {late:.4f}"
+    )
