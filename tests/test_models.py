@@ -105,3 +105,26 @@ def test_init_statistics_match_reference():
             zeros_ok &= (arr == 0).all()
     assert zeros_ok
     assert 0.015 < np.mean(kernel_stds) < 0.025
+
+
+def test_remat_is_semantically_identical():
+    """remat=True (jax.checkpoint around residual blocks, the 512^2 HBM
+    relief) must not change values or gradients — only the memory/compute
+    trade."""
+    cfg = GeneratorConfig(filters=4, num_residual_blocks=2)
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 16, 16, 3), jnp.float32)
+    plain = ResNetGenerator(config=cfg, remat=False)
+    ckpt = ResNetGenerator(config=cfg, remat=True)
+    params = plain.init(jax.random.PRNGKey(0), x)
+
+    np.testing.assert_array_equal(
+        np.asarray(plain.apply(params, x)), np.asarray(ckpt.apply(params, x))
+    )
+
+    def loss(m, p):
+        return jnp.sum(m.apply(p, x) ** 2)
+
+    g_plain = jax.grad(lambda p: loss(plain, p))(params)
+    g_ckpt = jax.grad(lambda p: loss(ckpt, p))(params)
+    for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_ckpt)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
