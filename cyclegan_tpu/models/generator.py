@@ -65,12 +65,18 @@ class ResNetGenerator(nn.Module):
             filters *= 2
             y = Downsample(filters, dtype=self.dtype, norm_impl=self.norm_impl)(y)
 
-        # Residual trunk (model.py:155-156)
+        # Residual trunk (model.py:155-156). Blocks are named explicitly so
+        # remat=True (nn.remat auto-names modules "CheckpointResidualBlock_N")
+        # keeps the same param-tree paths as remat=False.
         block_cls = ResidualBlock
         if self.remat:
             block_cls = nn.remat(ResidualBlock)
-        for _ in range(cfg.num_residual_blocks):
-            y = block_cls(dtype=self.dtype, norm_impl=self.norm_impl)(y)
+        for i in range(cfg.num_residual_blocks):
+            y = block_cls(
+                dtype=self.dtype,
+                norm_impl=self.norm_impl,
+                name=f"ResidualBlock_{i}",
+            )(y)
 
         # Upsampling (model.py:159-161)
         for _ in range(cfg.num_upsample_blocks):
