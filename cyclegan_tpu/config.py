@@ -50,6 +50,7 @@ class ModelConfig:
     # TPU knobs (no reference counterpart):
     compute_dtype: str = "float32"  # "bfloat16" for MXU-friendly mixed precision
     remat: bool = False  # jax.checkpoint residual blocks (512^2 HBM relief)
+    scan_blocks: bool = False  # lax.scan the residual trunk (smaller HLO, faster compiles)
     instance_norm_impl: str = "auto"  # "xla" | "pallas" | "auto"
 
     @property

@@ -9,7 +9,11 @@ from cyclegan_tpu.models.modules import (
     Downsample,
     Upsample,
 )
-from cyclegan_tpu.models.generator import ResNetGenerator
+from cyclegan_tpu.models.generator import (
+    ResNetGenerator,
+    stack_trunk_params,
+    unstack_trunk_params,
+)
 from cyclegan_tpu.models.discriminator import PatchGANDiscriminator
 
 __all__ = [
@@ -19,4 +23,6 @@ __all__ = [
     "Upsample",
     "ResNetGenerator",
     "PatchGANDiscriminator",
+    "stack_trunk_params",
+    "unstack_trunk_params",
 ]

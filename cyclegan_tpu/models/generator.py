@@ -30,11 +30,28 @@ from cyclegan_tpu.models.modules import (
 )
 
 
+class _TrunkBody(nn.Module):
+    """One residual block in (carry, _) -> (carry, None) form for nn.scan."""
+
+    dtype: Optional[Any] = None
+    norm_impl: str = "auto"
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, carry, _):
+        block_cls = nn.remat(ResidualBlock) if self.remat else ResidualBlock
+        y = block_cls(
+            dtype=self.dtype, norm_impl=self.norm_impl, name="ResidualBlock_0"
+        )(carry)
+        return y, None
+
+
 class ResNetGenerator(nn.Module):
     config: GeneratorConfig = GeneratorConfig()
     out_channels: int = 3
     dtype: Optional[Any] = None
     remat: bool = False
+    scan_blocks: bool = False
     norm_impl: str = "auto"
 
     @nn.compact
@@ -68,15 +85,35 @@ class ResNetGenerator(nn.Module):
         # Residual trunk (model.py:155-156). Blocks are named explicitly so
         # remat=True (nn.remat auto-names modules "CheckpointResidualBlock_N")
         # keeps the same param-tree paths as remat=False.
-        block_cls = ResidualBlock
-        if self.remat:
-            block_cls = nn.remat(ResidualBlock)
-        for i in range(cfg.num_residual_blocks):
-            y = block_cls(
+        #
+        # scan_blocks=True rolls the 9 identical blocks into one lax.scan
+        # iteration (params stacked on a leading axis under "ScannedTrunk"):
+        # ~9x less trunk HLO, much faster XLA compiles — the
+        # compiler-friendly-control-flow trade. Convert checkpoints between
+        # layouts with stack_trunk_params/unstack_trunk_params.
+        if self.scan_blocks:
+            trunk = nn.scan(
+                _TrunkBody,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_residual_blocks,
+            )(
                 dtype=self.dtype,
                 norm_impl=self.norm_impl,
-                name=f"ResidualBlock_{i}",
-            )(y)
+                remat=self.remat,
+                name="ScannedTrunk",
+            )
+            y, _ = trunk(y, None)
+        else:
+            block_cls = ResidualBlock
+            if self.remat:
+                block_cls = nn.remat(ResidualBlock)
+            for i in range(cfg.num_residual_blocks):
+                y = block_cls(
+                    dtype=self.dtype,
+                    norm_impl=self.norm_impl,
+                    name=f"ResidualBlock_{i}",
+                )(y)
 
         # Upsampling (model.py:159-161)
         for _ in range(cfg.num_upsample_blocks):
@@ -95,3 +132,33 @@ class ResNetGenerator(nn.Module):
         )(y)
         y = jnp.tanh(y)
         return y.astype(in_dtype)
+
+
+def stack_trunk_params(params, num_blocks: int):
+    """Convert an unrolled-trunk param tree (ResidualBlock_0..N-1) to the
+    scan_blocks=True layout (leaves stacked on a leading axis under
+    ScannedTrunk/ResidualBlock_0). Enables loading a checkpoint trained
+    without --scan_blocks into a scanned generator."""
+    import jax
+
+    inner = dict(params["params"])
+    blocks = [inner.pop(f"ResidualBlock_{i}") for i in range(num_blocks)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    inner["ScannedTrunk"] = {"ResidualBlock_0": stacked}
+    return {**params, "params": inner}
+
+
+def unstack_trunk_params(params, num_blocks: int):
+    """Inverse of `stack_trunk_params`."""
+    import jax
+
+    inner = dict(params["params"])
+    trunk = dict(inner.pop("ScannedTrunk"))
+    stacked = trunk.pop("ResidualBlock_0")
+    if trunk:
+        raise ValueError(
+            f"unexpected entries under ScannedTrunk: {sorted(trunk)}"
+        )
+    for i in range(num_blocks):
+        inner[f"ResidualBlock_{i}"] = jax.tree.map(lambda x: x[i], stacked)
+    return {**params, "params": inner}

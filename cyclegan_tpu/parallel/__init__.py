@@ -18,6 +18,7 @@ from cyclegan_tpu.parallel.dp import (
     shard_batch,
     pad_to_global_batch,
 )
+from cyclegan_tpu.parallel.halo import halo_exchange, sharded_conv
 
 __all__ = [
     "MeshPlan",
@@ -28,4 +29,6 @@ __all__ = [
     "shard_test_step",
     "shard_batch",
     "pad_to_global_batch",
+    "halo_exchange",
+    "sharded_conv",
 ]

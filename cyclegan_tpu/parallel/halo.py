@@ -1,0 +1,124 @@
+"""Explicit halo exchange for spatially-sharded convolutions.
+
+The default spatial path (parallel/dp.py) shards the image H axis under
+`jit` and lets XLA's SPMD partitioner insert the halo exchanges for every
+convolution. This module is the explicit backend — the image-model analog
+of ring sequence parallelism: each shard owns a contiguous band of rows
+and trades `halo` boundary rows with its ring neighbors over ICI via
+`lax.ppermute`, exactly the communication pattern XLA synthesizes, but
+stated in user code where it can be profiled, tested, and reused.
+
+The reference has no spatial sharding at all (SURVEY.md §2.3 — its only
+strategy is single-host data parallelism over NCCL); this component
+exists for the 512^2 HBM-relief config of BASELINE.md.
+
+tests/test_halo.py asserts: ring-exchanged sharded conv == unsharded
+reflect-pad/zero-pad conv, bit-for-bit, on an 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def halo_exchange(
+    x: jnp.ndarray, halo: int, axis_name: str, mode: str = "reflect"
+) -> jnp.ndarray:
+    """Extend a row-sharded [N, H_local, W, C] block with `halo` boundary
+    rows from each ring neighbor.
+
+    Must be called inside `shard_map` with the H axis sharded over
+    `axis_name`. Interior shards receive real neighbor rows; the first and
+    last shards synthesize their outer halo locally:
+
+      - mode="reflect": mirror rows (tf.pad REFLECT semantics, border
+        pixel not repeated — reference model.py:23-33), so a stride-1
+        VALID conv over the result equals a reflect-padded global conv.
+      - mode="zero": zero rows, matching a 'SAME'-padded global conv.
+
+    Returns [N, H_local + 2*halo, W, C].
+    """
+    if mode not in ("reflect", "zero"):
+        raise ValueError(f"unknown halo mode: {mode!r}")
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    if x.shape[1] < halo + 1:
+        raise ValueError(
+            f"H_local={x.shape[1]} too small for halo={halo} (need >= halo+1)"
+        )
+
+    # Ring shifts: each shard sends its bottom rows down and its top rows
+    # up; wrap-around values land on the boundary shards and are replaced
+    # below, so a single ring permutation serves all shards.
+    ring_down = [(i, (i + 1) % n) for i in range(n)]
+    ring_up = [(i, (i - 1) % n) for i in range(n)]
+    top = lax.ppermute(x[:, -halo:], axis_name, ring_down)
+    bottom = lax.ppermute(x[:, :halo], axis_name, ring_up)
+
+    if mode == "reflect":
+        outer_top = x[:, 1 : halo + 1][:, ::-1]
+        outer_bottom = x[:, -halo - 1 : -1][:, ::-1]
+    else:
+        outer_top = jnp.zeros_like(x[:, :halo])
+        outer_bottom = jnp.zeros_like(x[:, :halo])
+
+    top = jnp.where(idx == 0, outer_top, top)
+    bottom = jnp.where(idx == n - 1, outer_bottom, bottom)
+    return jnp.concatenate([top, x, bottom], axis=1)
+
+
+def sharded_conv(
+    x: jnp.ndarray,
+    kernel: jnp.ndarray,
+    axis_name: str,
+    mode: str = "reflect",
+    bias: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Stride-1 convolution over a row-sharded NHWC tensor.
+
+    H halos come from ring neighbors (`halo_exchange`); the unsharded W
+    axis is padded locally with the same mode. With an odd HWIO kernel
+    this reproduces the reference's reflect-pad->VALID-conv residual
+    blocks (model.py:36-74) and 'SAME' convs shard-by-shard.
+    """
+    kh, kw = kernel.shape[0], kernel.shape[1]
+    if kh % 2 == 0 or kw % 2 == 0:
+        raise ValueError(f"sharded_conv needs odd kernel sizes, got {(kh, kw)}")
+    ph, pw = kh // 2, kw // 2
+    y = halo_exchange(x, ph, axis_name, mode=mode) if ph else x
+    if pw:
+        wmode = "reflect" if mode == "reflect" else "constant"
+        y = jnp.pad(y, ((0, 0), (0, 0), (pw, pw), (0, 0)), mode=wmode)
+    out = lax.conv_general_dilated(
+        y,
+        kernel,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def make_sharded_conv(plan, mode: str = "reflect"):
+    """Wrap `sharded_conv` in shard_map over the plan's spatial axis,
+    batch over its data axis — a standalone, jittable building block.
+    Returns fn(x, kernel): x row-sharded NHWC, kernel replicated HWIO."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(plan.data_axis, plan.spatial_axis, None, None)
+
+    def fn(x, k):
+        return sharded_conv(x, k, plan.spatial_axis, mode=mode)
+
+    return jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=plan.mesh,
+            in_specs=(spec, P()),
+            out_specs=spec,
+        )
+    )

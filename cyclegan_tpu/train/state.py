@@ -63,6 +63,7 @@ def build_models(
         out_channels=m.channels,
         dtype=dtype,
         remat=m.remat,
+        scan_blocks=m.scan_blocks,
         norm_impl=m.instance_norm_impl,
     )
     disc = PatchGANDiscriminator(

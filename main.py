@@ -65,6 +65,7 @@ def main(args: argparse.Namespace) -> None:
         model=ModelConfig(
             compute_dtype="bfloat16" if args.bf16 else "float32",
             remat=args.remat,
+            scan_blocks=args.scan_blocks,
             image_size=args.image_size,
         ),
         data=DataConfig(
@@ -179,6 +180,10 @@ if __name__ == "__main__":
                         help="bfloat16 compute (fp32 params/optimizer)")
     parser.add_argument("--remat", action="store_true",
                         help="rematerialize residual blocks (512^2 HBM relief)")
+    parser.add_argument("--scan_blocks", action="store_true",
+                        help="lax.scan the residual trunk: ~9x less trunk HLO, "
+                             "faster XLA compiles; checkpoints use a stacked "
+                             "param layout (convert with models.stack_trunk_params)")
     parser.add_argument("--spatial_parallelism", default=1, type=int,
                         help="shard the image H axis over this many mesh columns")
     parser.add_argument("--trace", default=0, type=int, metavar="N",

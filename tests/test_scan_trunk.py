@@ -1,0 +1,104 @@
+"""scan_blocks=True (lax.scan residual trunk) is a pure layout/compile
+trade: same function, same parameter count, stacked param layout.
+
+The unrolled trunk is the reference semantics (model.py:155-156, nine
+sequential blocks); the scanned trunk must be numerically identical given
+converted params, and the layout converters must round-trip.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cyclegan_tpu.config import GeneratorConfig
+from cyclegan_tpu.models import (
+    ResNetGenerator,
+    stack_trunk_params,
+    unstack_trunk_params,
+)
+
+CFG = GeneratorConfig(filters=4, num_residual_blocks=3)
+
+
+def _x(seed=0, n=2, s=16):
+    return jnp.asarray(np.random.RandomState(seed).rand(n, s, s, 3), jnp.float32)
+
+
+def test_scan_matches_unrolled_given_converted_params():
+    x = _x()
+    plain = ResNetGenerator(config=CFG, scan_blocks=False)
+    scanned = ResNetGenerator(config=CFG, scan_blocks=True)
+    params = plain.init(jax.random.PRNGKey(0), x)
+    sparams = stack_trunk_params(params, CFG.num_residual_blocks)
+    np.testing.assert_allclose(
+        np.asarray(plain.apply(params, x)),
+        np.asarray(scanned.apply(sparams, x)),
+        rtol=1e-6,
+        atol=1e-6,
+    )
+
+
+def test_roundtrip_and_param_count():
+    x = _x()
+    plain = ResNetGenerator(config=CFG, scan_blocks=False)
+    scanned = ResNetGenerator(config=CFG, scan_blocks=True)
+    params = plain.init(jax.random.PRNGKey(1), x)
+    sparams = scanned.init(jax.random.PRNGKey(1), x)
+
+    n = lambda p: sum(a.size for a in jax.tree.leaves(p))
+    assert n(params) == n(sparams)
+
+    back = unstack_trunk_params(
+        stack_trunk_params(params, CFG.num_residual_blocks), CFG.num_residual_blocks
+    )
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_scan_gradients_match_unrolled(remat):
+    """One backward through the scanned trunk == unrolled gradients
+    (stacked back to the unrolled layout for comparison)."""
+    x = _x(2)
+    plain = ResNetGenerator(config=CFG, scan_blocks=False)
+    scanned = ResNetGenerator(config=CFG, scan_blocks=True, remat=remat)
+    params = plain.init(jax.random.PRNGKey(2), x)
+    sparams = stack_trunk_params(params, CFG.num_residual_blocks)
+
+    g_plain = jax.grad(lambda p: jnp.sum(plain.apply(p, x) ** 2))(params)
+    g_scan = jax.grad(lambda p: jnp.sum(scanned.apply(p, x) ** 2))(sparams)
+    g_scan_unrolled = unstack_trunk_params(g_scan, CFG.num_residual_blocks)
+
+    flat_a = jax.tree_util.tree_flatten_with_path(g_plain)[0]
+    flat_b = dict(
+        (jax.tree_util.keystr(k), v)
+        for k, v in jax.tree_util.tree_flatten_with_path(g_scan_unrolled)[0]
+    )
+    assert len(flat_a) == len(flat_b)
+    for key, a in flat_a:
+        np.testing.assert_allclose(
+            np.asarray(a),
+            np.asarray(flat_b[jax.tree_util.keystr(key)]),
+            rtol=1e-5,
+            atol=1e-6,
+            err_msg=jax.tree_util.keystr(key),
+        )
+
+
+def test_scanned_hlo_is_smaller():
+    """The point of scan_blocks: the trunk compiles to one loop body, not
+    nine inlined copies — the lowered HLO text must shrink."""
+    cfg = GeneratorConfig(filters=4, num_residual_blocks=9)
+    x = _x(0, 1, 16)
+    plain = ResNetGenerator(config=cfg, scan_blocks=False)
+    scanned = ResNetGenerator(config=cfg, scan_blocks=True)
+    p = plain.init(jax.random.PRNGKey(0), x)
+    sp = scanned.init(jax.random.PRNGKey(0), x)
+    hlo_plain = jax.jit(plain.apply).lower(p, x).as_text()
+    hlo_scan = jax.jit(scanned.apply).lower(sp, x).as_text()
+    # At tiny test sizes the fixed stem/head HLO dominates, so the whole-
+    # program shrink is modest; the trunk itself collapses 9x.
+    assert len(hlo_scan) < 0.8 * len(hlo_plain), (
+        f"scan HLO {len(hlo_scan)}B not <80% of unrolled {len(hlo_plain)}B"
+    )

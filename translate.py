@@ -56,7 +56,7 @@ def main(args: argparse.Namespace) -> None:
     from cyclegan_tpu.utils.checkpoint import Checkpointer
 
     config = Config(
-        model=ModelConfig(image_size=args.image_size),
+        model=ModelConfig(image_size=args.image_size, scan_blocks=args.scan_blocks),
         train=TrainConfig(output_dir=args.output_dir),
     )
     state = create_state(config, jax.random.PRNGKey(config.train.seed))
@@ -133,6 +133,8 @@ if __name__ == "__main__":
     p.add_argument("--output", required=True, help="directory for translated PNGs")
     p.add_argument("--direction", default="AtoB", choices=["AtoB", "BtoA"])
     p.add_argument("--image_size", default=256, type=int)
+    p.add_argument("--scan_blocks", action="store_true",
+                   help="checkpoint was trained with --scan_blocks (stacked trunk)")
     p.add_argument("--batch_size", default=8, type=int)
     p.add_argument("--panels", action="store_true",
                    help="also save [input | translated | cycled] panels")
