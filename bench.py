@@ -206,7 +206,9 @@ def main():
     configs = [
         # (mode, dtype, batch)
         ("steps", "float32", 1),   # reference default: per-replica batch 1
-        ("scan", "bfloat16", 8),   # device-resident sustained, MXU dtype
+        # Device-resident sustained, MXU dtype. b16 measured best on the
+        # chip: 88.6 img/s vs 83.1 (b8) and 79.2 (b32).
+        ("scan", "bfloat16", 16),
     ]
     for mode, dtype, batch in configs:
         key = f"{mode}/{dtype}/b{batch}"

@@ -18,7 +18,11 @@ from cyclegan_tpu.parallel.dp import (
     shard_batch,
     pad_to_global_batch,
 )
-from cyclegan_tpu.parallel.halo import halo_exchange, sharded_conv
+from cyclegan_tpu.parallel.halo import (
+    halo_exchange,
+    make_sharded_conv,
+    sharded_conv,
+)
 
 __all__ = [
     "MeshPlan",
@@ -30,5 +34,6 @@ __all__ = [
     "shard_batch",
     "pad_to_global_batch",
     "halo_exchange",
+    "make_sharded_conv",
     "sharded_conv",
 ]

@@ -44,9 +44,14 @@ def halo_exchange(
         raise ValueError(f"unknown halo mode: {mode!r}")
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
-    if x.shape[1] < halo + 1:
+    # Zero mode only needs `halo` neighbor rows; reflect additionally
+    # mirrors halo rows past the border row on the boundary shards, which
+    # takes halo+1 local rows (and is computed on every shard under SPMD).
+    min_rows = halo + 1 if mode == "reflect" else halo
+    if x.shape[1] < min_rows:
         raise ValueError(
-            f"H_local={x.shape[1]} too small for halo={halo} (need >= halo+1)"
+            f"H_local={x.shape[1]} too small for halo={halo} "
+            f"(need >= {min_rows} for mode={mode!r})"
         )
 
     # Ring shifts: each shard sends its bottom rows down and its top rows
@@ -74,7 +79,6 @@ def sharded_conv(
     kernel: jnp.ndarray,
     axis_name: str,
     mode: str = "reflect",
-    bias: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Stride-1 convolution over a row-sharded NHWC tensor.
 
@@ -91,16 +95,13 @@ def sharded_conv(
     if pw:
         wmode = "reflect" if mode == "reflect" else "constant"
         y = jnp.pad(y, ((0, 0), (0, 0), (pw, pw), (0, 0)), mode=wmode)
-    out = lax.conv_general_dilated(
+    return lax.conv_general_dilated(
         y,
         kernel,
         window_strides=(1, 1),
         padding="VALID",
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
-    if bias is not None:
-        out = out + bias
-    return out
 
 
 def make_sharded_conv(plan, mode: str = "reflect"):

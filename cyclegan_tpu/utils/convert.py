@@ -1,0 +1,84 @@
+"""Convert checkpoints between the unrolled and scanned trunk layouts.
+
+`--scan_blocks` (lax.scan residual trunk) stores generator params stacked
+on a leading axis under ScannedTrunk/ResidualBlock_0 instead of nine
+ResidualBlock_i subtrees. This tool rewrites a saved training state —
+generator params AND their Adam mu/nu mirrors — so a checkpoint trained
+in one layout can resume in the other. Discriminator trees are untouched.
+
+Usage:
+  python -m cyclegan_tpu.utils.convert --output_dir runs --to scanned
+  python -m cyclegan_tpu.utils.convert --output_dir runs --to unrolled
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from cyclegan_tpu.models.generator import stack_trunk_params, unstack_trunk_params
+from cyclegan_tpu.train.state import CycleGANState
+
+
+def _convert_adam(opt_state, convert):
+    """Apply `convert` to the mu/nu param mirrors inside an optax Adam
+    state (a (ScaleByAdamState, ...) tuple; mu/nu share the param-tree
+    structure, so the same layout converters apply)."""
+    adam, *rest = opt_state
+    return (adam._replace(mu=convert(adam.mu), nu=convert(adam.nu)), *rest)
+
+
+def convert_state_trunk(
+    state: CycleGANState, num_blocks: int, to: str
+) -> CycleGANState:
+    """Rewrite both generators' param trees and Adam moments to the
+    `to` layout ("scanned" | "unrolled")."""
+    if to == "scanned":
+        convert = lambda p: stack_trunk_params(p, num_blocks)
+    elif to == "unrolled":
+        convert = lambda p: unstack_trunk_params(p, num_blocks)
+    else:
+        raise ValueError(f"--to must be 'scanned' or 'unrolled', got {to!r}")
+    return state.replace(
+        g_params=convert(state.g_params),
+        f_params=convert(state.f_params),
+        g_opt=_convert_adam(state.g_opt, convert),
+        f_opt=_convert_adam(state.f_opt, convert),
+    )
+
+
+def main(args: argparse.Namespace) -> None:
+    from cyclegan_tpu.utils.platform import ensure_platform_from_env
+
+    ensure_platform_from_env()
+    import jax
+
+    from cyclegan_tpu.config import Config, ModelConfig, TrainConfig
+    from cyclegan_tpu.train import create_state
+    from cyclegan_tpu.utils.checkpoint import Checkpointer
+
+    # The checkpoint on disk is in the SOURCE layout; build the template
+    # accordingly, convert in memory, save back in the target layout.
+    src_scanned = args.to == "unrolled"
+    config = Config(
+        model=ModelConfig(image_size=args.image_size, scan_blocks=src_scanned),
+        train=TrainConfig(output_dir=args.output_dir),
+    )
+    ckpt = Checkpointer(args.output_dir)
+    if not ckpt.exists():
+        raise SystemExit(f"no checkpoint under {args.output_dir}/checkpoints")
+    template = create_state(config, jax.random.PRNGKey(config.train.seed))
+    state, next_epoch = ckpt.restore(template)
+
+    n = config.model.generator.num_residual_blocks
+    state = convert_state_trunk(state, n, args.to)
+    ckpt.save(state, next_epoch - 1)
+    ckpt.close()
+    print(f"converted {ckpt.slot} to {args.to} trunk layout")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--output_dir", default="runs")
+    p.add_argument("--to", required=True, choices=["scanned", "unrolled"])
+    p.add_argument("--image_size", default=256, type=int)
+    main(p.parse_args())

@@ -57,6 +57,19 @@ def test_halo_needs_enough_rows(devices):
         make_sharded_conv(plan)(x, k)
 
 
+def test_zero_mode_allows_one_row_per_shard(devices):
+    """Zero mode needs only `halo` local rows (no boundary mirror): a
+    3x3 'SAME' conv with exactly one row per shard must work."""
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(1, 8, 8, 2), jnp.float32)
+    k = jnp.asarray(rng.randn(3, 3, 2, 2) * 0.1, jnp.float32)
+    plan = make_mesh_plan(ParallelConfig(spatial_parallelism=8), devices=devices)
+    np.testing.assert_array_equal(
+        np.asarray(make_sharded_conv(plan, mode="zero")(x, k)),
+        np.asarray(_reference_conv(x, k, "zero")),
+    )
+
+
 def test_even_kernel_rejected(devices):
     with pytest.raises(ValueError, match="odd kernel"):
         sharded_conv(jnp.zeros((1, 8, 8, 1)), jnp.zeros((4, 4, 1, 1)), "spatial")
