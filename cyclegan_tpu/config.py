@@ -115,6 +115,11 @@ class TrainConfig:
     seed: int = 1234  # main.py:366-367
     checkpoint_every: int = 10  # main.py:400
     plot_samples: int = 5  # main.py:77
+    # TPU knob (no reference counterpart): train steps fused into one
+    # lax.scan dispatch; hides host->device dispatch latency. 1 = the
+    # reference's per-step host loop. Epoch remainders (< K full batches)
+    # run through the single-step program for exact semantics.
+    steps_per_dispatch: int = 1
 
 
 @dataclasses.dataclass(frozen=True)

@@ -16,6 +16,8 @@ from cyclegan_tpu.parallel.dp import (
     shard_train_step,
     shard_test_step,
     shard_batch,
+    shard_stacked_batch,
+    shard_multi_train_step,
     pad_to_global_batch,
 )
 from cyclegan_tpu.parallel.halo import (
@@ -32,6 +34,8 @@ __all__ = [
     "shard_train_step",
     "shard_test_step",
     "shard_batch",
+    "shard_stacked_batch",
+    "shard_multi_train_step",
     "pad_to_global_batch",
     "halo_exchange",
     "make_sharded_conv",

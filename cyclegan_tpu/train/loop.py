@@ -21,7 +21,7 @@ import numpy as np
 from cyclegan_tpu.config import Config
 from cyclegan_tpu.data.pipeline import CycleGANData
 from cyclegan_tpu.parallel.mesh import MeshPlan
-from cyclegan_tpu.parallel.dp import shard_batch
+from cyclegan_tpu.parallel.dp import shard_batch, shard_stacked_batch
 from cyclegan_tpu.train.state import CycleGANState
 from cyclegan_tpu.utils.dicts import append_dict, mean_dict
 from cyclegan_tpu.utils.summary import Summary
@@ -47,19 +47,63 @@ def train_epoch(
     summary: Summary,
     epoch: int,
     tracer=None,
+    multi_step_fn: Callable = None,
 ) -> CycleGANState:
     """One training pass (reference main.py:332-341). `tracer` is an
-    optional utils.profiler.TraceCapture stepped once per train step."""
+    optional utils.profiler.TraceCapture stepped once per train step.
+
+    With steps_per_dispatch K > 1 (`multi_step_fn` from
+    shard_multi_train_step), K full batches at a time run as one fused
+    lax.scan dispatch; the epoch remainder uses the per-step program, so
+    the update sequence is identical to K=1. The tracer's unit becomes
+    one fused DISPATCH (containing K steps): stepping it K times before a
+    single dispatch would open and close the capture window before any
+    device work ran.
+    """
+    k = config.train.steps_per_dispatch
     results: Dict[str, list] = {}
     it = _progress(
         data.train_epoch(epoch), data.train_steps, "Train", config.train.verbose
     )
+
+    def append_metrics(metrics, steps: int = 1):
+        host = jax.device_get(metrics)
+        if steps == 1:
+            append_dict(results, host)
+        else:
+            for i in range(steps):
+                append_dict(results, {key: v[i] for key, v in host.items()})
+
+    buf = []
     for x, y, w in it:
+        if multi_step_fn is not None and k > 1:
+            buf.append((x, y, w))
+            if len(buf) == k:
+                if tracer is not None:
+                    tracer.step()  # one trace unit = one fused dispatch
+                xs, ys, ws = shard_stacked_batch(
+                    plan,
+                    np.stack([b[0] for b in buf]),
+                    np.stack([b[1] for b in buf]),
+                    np.stack([b[2] for b in buf]),
+                )
+                state, metrics = multi_step_fn(state, xs, ys, ws)
+                append_metrics(metrics, steps=k)
+                buf = []
+            continue
         if tracer is not None:
             tracer.step()  # before dispatch: full steps land in the window
         xs, ys, ws = shard_batch(plan, x, y, w)
         state, metrics = step_fn(state, xs, ys, ws)
-        append_dict(results, jax.device_get(metrics))
+        append_metrics(metrics)
+    # Remainder: fewer than K batches left — per-step program, exact
+    # semantics (a zero-weight padded step would still decay Adam moments).
+    for x, y, w in buf:
+        if tracer is not None:
+            tracer.step()
+        xs, ys, ws = shard_batch(plan, x, y, w)
+        state, metrics = step_fn(state, xs, ys, ws)
+        append_metrics(metrics)
     for key, value in mean_dict(results).items():
         summary.scalar(key, value, step=epoch, training=True)
     return state

@@ -85,6 +85,7 @@ def main(args: argparse.Namespace) -> None:
             batch_size=args.batch_size,
             verbose=args.verbose,
             clear_output_dir=args.clear_output_dir,
+            steps_per_dispatch=args.steps_per_dispatch,
         ),
     )
 
@@ -111,7 +112,17 @@ def main(args: argparse.Namespace) -> None:
     if resumed and primary:
         print(f"Resumed from {ckpt.slot} at epoch {start_epoch}")
 
-    train_step = shard_train_step(plan, make_train_step(config, global_batch_size))
+    step = make_train_step(config, global_batch_size)
+    train_step = shard_train_step(plan, step)
+    multi_step = None
+    if config.train.steps_per_dispatch > 1:
+        from cyclegan_tpu.parallel.dp import shard_multi_train_step
+
+        # Same step closure for both wrappers: the K-scanned == K-dispatched
+        # guarantee is structural, not coincidental.
+        multi_step = shard_multi_train_step(
+            plan, step, config.train.steps_per_dispatch
+        )
     test_step = shard_test_step(plan, make_test_step(config, global_batch_size))
     cycle_step = jax.jit(make_cycle_step(config))
 
@@ -126,7 +137,8 @@ def main(args: argparse.Namespace) -> None:
                 print(f"Epoch {epoch + 1:03d}/{config.train.epochs:03d}")
             start = time()
             state = loop.train_epoch(
-                config, data, plan, train_step, state, summary, epoch, tracer=tracer
+                config, data, plan, train_step, state, summary, epoch,
+                tracer=tracer, multi_step_fn=multi_step,
             )
             results = loop.test_epoch(config, data, plan, test_step, state, summary, epoch)
             elapse = time() - start
@@ -186,10 +198,15 @@ if __name__ == "__main__":
                              "param layout (convert with models.stack_trunk_params)")
     parser.add_argument("--spatial_parallelism", default=1, type=int,
                         help="shard the image H axis over this many mesh columns")
+    parser.add_argument("--steps_per_dispatch", default=1, type=int,
+                        help="fuse this many train steps into one lax.scan "
+                             "dispatch (amortizes host->device latency; "
+                             "identical update sequence to 1)")
     parser.add_argument("--trace", default=0, type=int, metavar="N",
                         help="capture a jax.profiler trace of N train steps "
                              "(steps 2..N+1 — step 1 is compile) to "
-                             "<output_dir>/traces")
+                             "<output_dir>/traces; with --steps_per_dispatch K "
+                             "the trace unit is one fused dispatch of K steps")
     parser.add_argument("--fresh_augment", action="store_true",
                         help="re-augment every epoch instead of reproducing the "
                              "reference's cache-after-augment behavior")
