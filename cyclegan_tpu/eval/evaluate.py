@@ -21,10 +21,26 @@ import numpy as np
 from cyclegan_tpu.utils.platform import ensure_platform_from_env
 
 
-def evaluate_fid(config, state, data, feature_extractor) -> Dict[str, float]:
+def make_fid_evaluator(config, data, feature_extractor):
+    """Build a reusable `evaluate(state) -> {fid scalars}` closure.
+
+    The translation forward is jitted ONCE (exposed as
+    `evaluate.translate` so tests can assert the compile-cache size), and
+    the real-domain feature statistics — fixed for a fixed test split —
+    are accumulated on the first call only; later calls re-extract only
+    the fake-domain features. Single-process only: mixing a mesh-global
+    train state with per-host-different test batches under plain jit is
+    undefined across processes, so multi-host callers must gate this to
+    an explicit single-host evaluation.
+    """
     from cyclegan_tpu.eval.fid import FIDAccumulator, fid_from_accumulators
     from cyclegan_tpu.train.state import build_models
 
+    if jax.process_count() > 1:
+        raise ValueError(
+            "make_fid_evaluator is single-process only; run FID evaluation "
+            "out-of-band (python -m cyclegan_tpu.eval.evaluate) on one host"
+        )
     if data.n_test < 2:
         raise ValueError(
             f"FID needs at least 2 test pairs per domain; got {data.n_test}"
@@ -37,25 +53,41 @@ def evaluate_fid(config, state, data, feature_extractor) -> Dict[str, float]:
         # cycle step — the reconstructions would be discarded).
         return gen.apply(state.f_params, y), gen.apply(state.g_params, x)
 
-    acc = {k: FIDAccumulator(feature_extractor.dim) for k in
-           ["real_a", "real_b", "fake_a", "fake_b"]}
+    real = {}
 
-    for x, y, w in data.test_epoch(prefetch=False):
-        fake_x, fake_y = translate(state, x, y)
-        keep = np.asarray(w) > 0  # drop zero-padded rows of the final batch
-        acc["real_a"].update(np.asarray(feature_extractor(x))[keep])
-        acc["real_b"].update(np.asarray(feature_extractor(y))[keep])
-        acc["fake_a"].update(np.asarray(feature_extractor(fake_x))[keep])
-        acc["fake_b"].update(np.asarray(feature_extractor(fake_y))[keep])
+    def evaluate(state) -> Dict[str, float]:
+        first = not real
+        if first:
+            real["a"] = FIDAccumulator(feature_extractor.dim)
+            real["b"] = FIDAccumulator(feature_extractor.dim)
+        fake_a = FIDAccumulator(feature_extractor.dim)
+        fake_b = FIDAccumulator(feature_extractor.dim)
 
-    return {
-        f"fid/{feature_extractor.name}/G(A)_vs_B": fid_from_accumulators(
-            acc["fake_b"], acc["real_b"]
-        ),
-        f"fid/{feature_extractor.name}/F(B)_vs_A": fid_from_accumulators(
-            acc["fake_a"], acc["real_a"]
-        ),
-    }
+        for x, y, w in data.test_epoch(prefetch=False):
+            fake_x, fake_y = translate(state, x, y)
+            keep = np.asarray(w) > 0  # drop zero-padded rows of the final batch
+            if first:
+                real["a"].update(np.asarray(feature_extractor(x))[keep])
+                real["b"].update(np.asarray(feature_extractor(y))[keep])
+            fake_a.update(np.asarray(feature_extractor(fake_x))[keep])
+            fake_b.update(np.asarray(feature_extractor(fake_y))[keep])
+
+        return {
+            f"fid/{feature_extractor.name}/G(A)_vs_B": fid_from_accumulators(
+                fake_b, real["b"]
+            ),
+            f"fid/{feature_extractor.name}/F(B)_vs_A": fid_from_accumulators(
+                fake_a, real["a"]
+            ),
+        }
+
+    evaluate.translate = translate
+    return evaluate
+
+
+def evaluate_fid(config, state, data, feature_extractor) -> Dict[str, float]:
+    """One-shot FID of a state (the CLI path)."""
+    return make_fid_evaluator(config, data, feature_extractor)(state)
 
 
 def main(args: argparse.Namespace) -> None:

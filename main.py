@@ -126,6 +126,25 @@ def main(args: argparse.Namespace) -> None:
     test_step = shard_test_step(plan, make_test_step(config, global_batch_size))
     cycle_step = jax.jit(make_cycle_step(config))
 
+    # Periodic FID (the north-star quality metric — BASELINE.md; the
+    # reference computes no quality metric at all, SURVEY.md §6).
+    fid_eval = None
+    if args.fid_every > 0:
+        if jax.process_count() > 1:
+            if primary:
+                print("WARNING: --fid_every is single-host only; disabled. "
+                      "Evaluate checkpoints with python -m "
+                      "cyclegan_tpu.eval.evaluate instead.")
+        else:
+            from cyclegan_tpu.eval.evaluate import make_fid_evaluator
+            from cyclegan_tpu.eval.features import build_feature_extractor
+
+            fid_eval = make_fid_evaluator(
+                config,
+                data,
+                build_feature_extractor(args.fid_features, args.fid_feature_weights),
+            )
+
     # Preemption (SIGTERM on TPU maintenance events): finish the epoch,
     # checkpoint, exit; auto-resume continues from the next epoch.
     guard = PreemptionGuard()
@@ -153,6 +172,15 @@ def main(args: argparse.Namespace) -> None:
 
             preempted = guard.should_stop()
             last = epoch == config.train.epochs - 1
+            # Skip FID when preempted: the SIGTERM grace window belongs to
+            # the checkpoint save, not a test-split sweep.
+            if fid_eval is not None and not preempted and (
+                last or (epoch + 1) % args.fid_every == 0
+            ):
+                for key, value in fid_eval(state).items():
+                    summary.scalar(key, value, step=epoch, training=False)
+                    if primary:
+                        print(f"{key}: {value:.4f}")
             if preempted or last or epoch % config.train.checkpoint_every == 0:
                 ckpt.save(state, epoch)
                 if primary:
@@ -207,6 +235,17 @@ if __name__ == "__main__":
                              "(steps 2..N+1 — step 1 is compile) to "
                              "<output_dir>/traces; with --steps_per_dispatch K "
                              "the trace unit is one fused dispatch of K steps")
+    parser.add_argument("--fid_every", default=0, type=int, metavar="N",
+                        help="compute FID on the test split every N epochs "
+                             "(and at the last) and log fid/* scalars; "
+                             "0 disables. Offline images use deterministic "
+                             "random-conv features (not Inception-comparable)")
+    parser.add_argument("--fid_features", default="auto",
+                        choices=["auto", "random", "inception"])
+    parser.add_argument("--fid_feature_weights", default=None, metavar="NPZ",
+                        help="InceptionV3 weights file for --fid_features "
+                             "auto/inception (without it, auto falls back to "
+                             "random-conv features)")
     parser.add_argument("--fresh_augment", action="store_true",
                         help="re-augment every epoch instead of reproducing the "
                              "reference's cache-after-augment behavior")

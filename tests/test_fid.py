@@ -95,3 +95,31 @@ def test_evaluate_fid_end_to_end(tiny_config):
     assert len(scores) == 2
     for k, v in scores.items():
         assert np.isfinite(v) and v >= 0, k
+
+
+@pytest.mark.slow
+def test_fid_evaluator_is_reusable(tiny_config):
+    """make_fid_evaluator (the --fid_every path) jits its translate fn
+    once; repeated calls on evolving states must not retrace and must
+    track the state (identical state -> identical score)."""
+    from cyclegan_tpu.data import build_data
+    from cyclegan_tpu.eval.evaluate import make_fid_evaluator
+    from cyclegan_tpu.train import create_state
+
+    cfg = tiny_config
+    data = build_data(cfg, global_batch_size=2)
+    fx = RandomConvFeatures()
+    evaluate = make_fid_evaluator(cfg, data, fx)
+
+    s0 = create_state(cfg, jax.random.PRNGKey(0))
+    s1 = create_state(cfg, jax.random.PRNGKey(7))
+    a = evaluate(s0)
+    b = evaluate(s1)
+    c = evaluate(s0)
+    assert a.keys() == b.keys()
+    for k in a:
+        assert np.isfinite(b[k])
+        np.testing.assert_allclose(a[k], c[k], rtol=1e-6)
+    assert any(abs(a[k] - b[k]) > 1e-9 for k in a), "scores ignore the state"
+    # The no-retrace property itself: one compiled program serves all calls.
+    assert evaluate.translate._cache_size() == 1
