@@ -44,26 +44,103 @@ class Checkpointer:
     def exists(self) -> bool:
         return os.path.isdir(self.slot)
 
-    def restore(self, template: CycleGANState) -> Tuple[CycleGANState, int]:
+    def restore(
+        self, template: CycleGANState, partial: bool = False
+    ) -> Tuple[CycleGANState, int]:
         """Restore into the template's structure/shardings; returns
-        (state, next_epoch)."""
-        abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
-            template,
-        )
-        state = self._ckptr.restore(self.slot, abstract)
+        (state, next_epoch).
+
+        partial=True is the analog of the reference's `expect_partial`
+        load option (main.py:165-169): leaves whose path AND shape/dtype
+        match the saved tree are restored; everything else keeps the
+        template's (freshly initialized) value — so a checkpoint survives
+        architecture tweaks instead of hard-failing.
+        """
+        if partial:
+            state = self._restore_partial(template)
+        else:
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
+                template,
+            )
+            state = self._ckptr.restore(self.slot, abstract)
         epoch = 0
         if os.path.exists(self.meta_path):
             with open(self.meta_path) as f:
                 epoch = int(json.load(f).get("epoch", -1)) + 1
         return state, epoch
 
+    @staticmethod
+    def _path_key(path) -> str:
+        """Structure-insensitive path string: the raw (target-less) orbax
+        restore yields dicts where the live state has dataclass attrs and
+        optax namedtuples, so GetAttrKey/DictKey/SequenceKey must compare
+        by their underlying name."""
+        parts = []
+        for e in path:
+            for attr in ("name", "key", "idx"):
+                if hasattr(e, attr):
+                    parts.append(str(getattr(e, attr)))
+                    break
+            else:
+                parts.append(str(e))
+        return "/".join(parts)
+
+    def _restore_partial(self, template: CycleGANState) -> CycleGANState:
+        import numpy as np
+
+        raw = self._ckptr.restore(self.slot)  # as-saved (no target tree)
+        saved = {
+            self._path_key(path): leaf
+            for path, leaf in jax.tree_util.tree_flatten_with_path(raw)[0]
+        }
+        grafted = grafted_arrays = total_arrays = skipped = 0
+
+        def merge(path, leaf):
+            nonlocal grafted, grafted_arrays, total_arrays, skipped
+            total_arrays += int(leaf.ndim > 0)
+            key = self._path_key(path)
+            value = saved.get(key)
+            # .shape/.dtype attributes only: np.asarray here would
+            # materialize (and on multi-host, crash on) every saved leaf
+            # just to compare metadata.
+            if (
+                value is not None
+                and getattr(value, "shape", None) == leaf.shape
+                and getattr(value, "dtype", None) == leaf.dtype
+            ):
+                grafted += 1
+                grafted_arrays += int(leaf.ndim > 0)
+                sharding = getattr(leaf, "sharding", None)
+                return jax.device_put(value, sharding) if sharding else value
+            skipped += 1
+            return leaf
+
+        state = jax.tree_util.tree_map_with_path(merge, template)
+        # Shape-() counters (step, Adam counts) and tiny output-layer
+        # biases match almost ANY checkpoint of this state class. If
+        # under 10% of parameter arrays grafted, this is a foreign
+        # checkpoint being mistaken for a resume — refuse rather than
+        # silently "resume" untrained networks at a late epoch.
+        if grafted_arrays < max(1, total_arrays // 10):
+            raise ValueError(
+                f"partial restore matched only {grafted_arrays}/{total_arrays} "
+                f"parameter arrays in {self.slot}; wrong checkpoint for this "
+                "model?"
+            )
+        if skipped and jax.process_index() == 0:
+            print(
+                f"partial restore: {grafted} leaves restored, "
+                f"{skipped} kept from init"
+            )
+        return state
+
     def restore_if_exists(
-        self, template: CycleGANState
+        self, template: CycleGANState, partial: bool = False
     ) -> Tuple[CycleGANState, int, bool]:
         """Auto-resume gate (reference main.py:162-170, call at 383)."""
         if self.exists():
-            state, epoch = self.restore(template)
+            state, epoch = self.restore(template, partial=partial)
             return state, epoch, True
         return template, 0, False
 

@@ -108,7 +108,9 @@ def main(args: argparse.Namespace) -> None:
 
     # Auto-resume from the single checkpoint slot (reference main.py:383).
     ckpt = Checkpointer(config.train.output_dir)
-    state, start_epoch, resumed = ckpt.restore_if_exists(state)
+    state, start_epoch, resumed = ckpt.restore_if_exists(
+        state, partial=args.expect_partial
+    )
     if resumed and primary:
         print(f"Resumed from {ckpt.slot} at epoch {start_epoch}")
 
@@ -181,6 +183,9 @@ def main(args: argparse.Namespace) -> None:
                     summary.scalar(key, value, step=epoch, training=False)
                     if primary:
                         print(f"{key}: {value:.4f}")
+                # The FID sweep takes minutes at full size — a SIGTERM
+                # landing during it must still checkpoint below.
+                preempted = preempted or guard.should_stop()
             if preempted or last or epoch % config.train.checkpoint_every == 0:
                 ckpt.save(state, epoch)
                 if primary:
@@ -246,6 +251,11 @@ if __name__ == "__main__":
                         help="InceptionV3 weights file for --fid_features "
                              "auto/inception (without it, auto falls back to "
                              "random-conv features)")
+    parser.add_argument("--expect_partial", action="store_true",
+                        help="tolerate checkpoint/model mismatches on resume: "
+                             "restore matching leaves, keep fresh init for the "
+                             "rest (reference load_checkpoint expect_partial, "
+                             "main.py:165-169)")
     parser.add_argument("--fresh_augment", action="store_true",
                         help="re-augment every epoch instead of reproducing the "
                              "reference's cache-after-augment behavior")

@@ -56,3 +56,76 @@ def test_single_slot_overwrite(tiny_config, tmp_path):
     assert int(restored.step) == 1
     assert not _tree_equal(state.g_params, restored.g_params)
     assert _tree_equal(state2.g_params, restored.g_params)
+
+
+def test_partial_restore_grafts_matching_leaves(tiny_config, tmp_path):
+    """partial=True (reference expect_partial, main.py:165-169): after an
+    architecture tweak, matching leaves restore and mismatched ones keep
+    their fresh init instead of the whole restore failing."""
+    import dataclasses
+
+    import pytest
+
+    cfg = tiny_config
+    state = create_state(cfg, jax.random.PRNGKey(0))
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(state, epoch=3)
+
+    # Same generators, wider discriminators: disc shapes no longer match.
+    cfg2 = dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(
+            cfg.model,
+            discriminator=dataclasses.replace(
+                cfg.model.discriminator,
+                filters=cfg.model.discriminator.filters * 2,
+            ),
+        ),
+    )
+    template = create_state(cfg2, jax.random.PRNGKey(9))
+
+    # Strict restore must fail on the shape mismatch...
+    with pytest.raises(Exception):
+        ckpt.restore(template)
+
+    # ...partial restore grafts generators + epoch, keeps fresh discs.
+    restored, next_epoch = ckpt.restore(template, partial=True)
+    assert next_epoch == 4
+    assert _tree_equal(restored.g_params, state.g_params)
+    assert _tree_equal(restored.f_params, state.f_params)
+    assert _tree_equal(restored.dx_params, template.dx_params)
+    assert not _tree_equal(restored.dx_params, state.dx_params)
+
+    # With a fully matching template, partial == strict.
+    same = create_state(cfg, jax.random.PRNGKey(9))
+    full, _ = ckpt.restore(same, partial=True)
+    assert _tree_equal(full, state)
+
+
+def test_partial_restore_rejects_total_param_mismatch(tiny_config, tmp_path):
+    """When no parameter array matches (every net resized), only shape-()
+    counters could graft — that's a wrong checkpoint, not a resume: raise
+    instead of silently 'resuming' with untrained networks at a late epoch."""
+    import dataclasses
+
+    import pytest
+
+    cfg = tiny_config
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(create_state(cfg, jax.random.PRNGKey(0)), epoch=0)
+
+    g = cfg.model.generator
+    cfg2 = dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(
+            cfg.model,
+            generator=dataclasses.replace(g, filters=g.filters * 2),
+            discriminator=dataclasses.replace(
+                cfg.model.discriminator,
+                filters=cfg.model.discriminator.filters * 2,
+            ),
+        ),
+    )
+    template = create_state(cfg2, jax.random.PRNGKey(1))
+    with pytest.raises(ValueError, match="wrong checkpoint"):
+        ckpt.restore(template, partial=True)
