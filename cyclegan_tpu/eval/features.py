@@ -16,6 +16,7 @@ not shippable in this offline image, so the extractor is pluggable:
 from __future__ import annotations
 
 from typing import Callable, Optional
+from zipfile import BadZipFile
 
 import flax.linen as nn
 import jax
@@ -57,23 +58,45 @@ class RandomConvFeatures:
 
 
 class InceptionFeatures:
-    """InceptionV3 pool3 features from an .npz weight file.
+    """InceptionV3 pool3 features (canonical FID) from an .npz weight file.
 
-    Expected file: flax-style flattened param dict saved via
-    `np.savez(path, **{'/'.join(k): v for k, v in flat_params})` for an
-    InceptionV3 port. The port itself is not implemented yet (no weights
-    are obtainable in this offline image), so construction always raises
-    NotImplementedError.
+    The architecture is fully implemented in eval/inception.py; only the
+    pretrained weights are absent from this offline image. The expected
+    file is a flat npz in the `inception.flatten_params` key convention
+    (nested '/'-joined paths, e.g. 'params/ConvBN_0/Conv_0/kernel');
+    loading validates every leaf's presence and shape. Inputs in [-1, 1] are bilinearly resized to the 299x299
+    Inception geometry.
     """
 
     name = "inception_v3_pool3"
     dim = 2048
 
     def __init__(self, weights_path: str):
-        raise NotImplementedError(
-            "InceptionV3 FID requires a weights file; this offline image has "
-            "none. Use RandomConvFeatures or provide weights in a later round."
+        from cyclegan_tpu.eval.inception import InceptionV3Pool3, load_params_npz
+
+        if not weights_path:
+            raise NotImplementedError(
+                "InceptionV3 FID requires a weights file (--fid_feature_weights); "
+                "this offline image ships none. Use RandomConvFeatures instead."
+            )
+        net = InceptionV3Pool3()
+        template = jax.eval_shape(
+            lambda: net.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
         )
+        params = load_params_npz(weights_path, template)
+
+        @jax.jit
+        def apply(images):
+            x = jax.image.resize(
+                images, (images.shape[0], 299, 299, images.shape[-1]), "bilinear"
+            )
+            return net.apply(params, x)
+
+        self._apply = apply
+
+    def __call__(self, images: jnp.ndarray) -> jnp.ndarray:
+        """images: [N, H, W, 3] in [-1, 1] -> [N, 2048]."""
+        return self._apply(images)
 
 
 def build_feature_extractor(kind: str = "auto", weights_path: Optional[str] = None):
@@ -83,7 +106,7 @@ def build_feature_extractor(kind: str = "auto", weights_path: Optional[str] = No
         if kind == "auto" and weights_path:
             try:
                 return InceptionFeatures(weights_path)
-            except (NotImplementedError, FileNotFoundError) as e:
+            except (NotImplementedError, OSError, ValueError, BadZipFile) as e:
                 print(
                     f"WARNING: requested Inception weights unusable ({e}); "
                     "falling back to random-conv features — scores are NOT "

@@ -1,0 +1,228 @@
+"""Flax InceptionV3 (pool3 features) for canonical FID.
+
+The standard FID statistic is computed over InceptionV3's 2048-d global-
+average-pooled "pool3" activations. This is a from-scratch Flax port of
+that architecture (TF-slim variant: conv + BatchNorm(eps=1e-3, no scale)
++ ReLU everywhere, VALID-padded stem, SAME-padded inception blocks), so
+the framework's FID harness (eval/fid.py) can produce Inception-FID
+numbers the moment a weights file is supplied — this offline image ships
+none, so `features.InceptionFeatures` stays gated on the .npz path.
+
+Weight file convention: a flat npz whose keys are the '/'-joined param
+paths of this module's (nested) variable tree, e.g.
+  params/ConvBN_0/Conv_0/kernel
+  params/MixedA_0/ConvBN_2/BatchNorm_0/bias
+  batch_stats/MixedB_1/ConvBN_4/BatchNorm_0/mean
+(`flatten_params` / `load_params_npz` below define the exact mapping; a
+converter from public TF/torch releases maps source tensors onto these
+keys, transposing conv kernels to HWIO).
+
+Inference-only: BatchNorm runs on its stored moving statistics
+(use_running_average=True), which arrive as part of the weights.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ConvBN(nn.Module):
+    """Conv(no bias) -> frozen BatchNorm(eps=1e-3, no scale) -> ReLU."""
+
+    features: int
+    kernel: Sequence[int] = (3, 3)
+    strides: Sequence[int] = (1, 1)
+    padding: str = "SAME"
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(
+            self.features,
+            tuple(self.kernel),
+            strides=tuple(self.strides),
+            padding=self.padding,
+            use_bias=False,
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=True,
+            use_scale=False,
+            use_bias=True,
+            epsilon=1e-3,
+        )(x)
+        return nn.relu(x)
+
+
+def _max_pool(x, window=3, stride=2, padding="VALID"):
+    return nn.max_pool(x, (window, window), strides=(stride, stride), padding=padding)
+
+
+def _avg_pool3(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class MixedA(nn.Module):
+    """35x35 block (Mixed_5b/5c/5d): 1x1 / 5x5 / double-3x3 / pool."""
+
+    pool_features: int
+
+    @nn.compact
+    def __call__(self, x):
+        b0 = ConvBN(64, (1, 1))(x)
+        b1 = ConvBN(48, (1, 1))(x)
+        b1 = ConvBN(64, (5, 5))(b1)
+        b2 = ConvBN(64, (1, 1))(x)
+        b2 = ConvBN(96, (3, 3))(b2)
+        b2 = ConvBN(96, (3, 3))(b2)
+        b3 = ConvBN(self.pool_features, (1, 1))(_avg_pool3(x))
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class ReductionA(nn.Module):
+    """35x35 -> 17x17 (Mixed_6a)."""
+
+    @nn.compact
+    def __call__(self, x):
+        b0 = ConvBN(384, (3, 3), strides=(2, 2), padding="VALID")(x)
+        b1 = ConvBN(64, (1, 1))(x)
+        b1 = ConvBN(96, (3, 3))(b1)
+        b1 = ConvBN(96, (3, 3), strides=(2, 2), padding="VALID")(b1)
+        b2 = _max_pool(x)
+        return jnp.concatenate([b0, b1, b2], axis=-1)
+
+
+class MixedB(nn.Module):
+    """17x17 block (Mixed_6b..6e): factorized 7x7 branches."""
+
+    channels_7x7: int
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.channels_7x7
+        b0 = ConvBN(192, (1, 1))(x)
+        b1 = ConvBN(c, (1, 1))(x)
+        b1 = ConvBN(c, (1, 7))(b1)
+        b1 = ConvBN(192, (7, 1))(b1)
+        b2 = ConvBN(c, (1, 1))(x)
+        b2 = ConvBN(c, (7, 1))(b2)
+        b2 = ConvBN(c, (1, 7))(b2)
+        b2 = ConvBN(c, (7, 1))(b2)
+        b2 = ConvBN(192, (1, 7))(b2)
+        b3 = ConvBN(192, (1, 1))(_avg_pool3(x))
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class ReductionB(nn.Module):
+    """17x17 -> 8x8 (Mixed_7a)."""
+
+    @nn.compact
+    def __call__(self, x):
+        b0 = ConvBN(192, (1, 1))(x)
+        b0 = ConvBN(320, (3, 3), strides=(2, 2), padding="VALID")(b0)
+        b1 = ConvBN(192, (1, 1))(x)
+        b1 = ConvBN(192, (1, 7))(b1)
+        b1 = ConvBN(192, (7, 1))(b1)
+        b1 = ConvBN(192, (3, 3), strides=(2, 2), padding="VALID")(b1)
+        b2 = _max_pool(x)
+        return jnp.concatenate([b0, b1, b2], axis=-1)
+
+
+class MixedC(nn.Module):
+    """8x8 block (Mixed_7b/7c): expanded-filter-bank branches."""
+
+    @nn.compact
+    def __call__(self, x):
+        b0 = ConvBN(320, (1, 1))(x)
+        b1 = ConvBN(384, (1, 1))(x)
+        b1 = jnp.concatenate(
+            [ConvBN(384, (1, 3))(b1), ConvBN(384, (3, 1))(b1)], axis=-1
+        )
+        b2 = ConvBN(448, (1, 1))(x)
+        b2 = ConvBN(384, (3, 3))(b2)
+        b2 = jnp.concatenate(
+            [ConvBN(384, (1, 3))(b2), ConvBN(384, (3, 1))(b2)], axis=-1
+        )
+        b3 = ConvBN(192, (1, 1))(_avg_pool3(x))
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class InceptionV3Pool3(nn.Module):
+    """InceptionV3 trunk up to the 2048-d pool3 feature vector.
+
+    Input: [N, 299, 299, 3] in [-1, 1] (the TF Inception input scaling —
+    conveniently the CycleGAN pipeline's native range). Output: [N, 2048].
+    """
+
+    @nn.compact
+    def __call__(self, x):
+        # Stem (299 -> 35x35x192)
+        x = ConvBN(32, (3, 3), strides=(2, 2), padding="VALID")(x)
+        x = ConvBN(32, (3, 3), padding="VALID")(x)
+        x = ConvBN(64, (3, 3))(x)
+        x = _max_pool(x)
+        x = ConvBN(80, (1, 1), padding="VALID")(x)
+        x = ConvBN(192, (3, 3), padding="VALID")(x)
+        x = _max_pool(x)
+        # 35x35
+        x = MixedA(pool_features=32)(x)
+        x = MixedA(pool_features=64)(x)
+        x = MixedA(pool_features=64)(x)
+        x = ReductionA()(x)
+        # 17x17
+        x = MixedB(channels_7x7=128)(x)
+        x = MixedB(channels_7x7=160)(x)
+        x = MixedB(channels_7x7=160)(x)
+        x = MixedB(channels_7x7=192)(x)
+        x = ReductionB()(x)
+        # 8x8
+        x = MixedC()(x)
+        x = MixedC()(x)
+        return jnp.mean(x, axis=(1, 2))  # pool3: [N, 2048]
+
+
+def _path_key(path) -> str:
+    """Tree path -> the on-disk '/'-joined key (DictKey/GetAttrKey/
+    SequenceKey all compare by their underlying name)."""
+    parts = []
+    for e in path:
+        for attr in ("name", "key", "idx"):
+            if hasattr(e, attr):
+                parts.append(str(getattr(e, attr)))
+                break
+    return "/".join(parts)
+
+
+def flatten_params(variables) -> dict:
+    """Variable tree -> flat {'collection/.../leaf': np.ndarray} dict
+    (the on-disk npz key convention; see module docstring for examples)."""
+    return {
+        _path_key(path): np.asarray(leaf)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(variables)[0]
+    }
+
+
+def load_params_npz(path: str, template):
+    """Load an npz in the `flatten_params` key convention into the
+    structure of `template`, validating every leaf's presence and shape."""
+    with np.load(path) as f:
+        saved = {k: f[k] for k in f.files}
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for p, leaf in flat:
+        key = _path_key(p)
+        if key not in saved:
+            raise ValueError(f"weights file {path} is missing {key}")
+        value = saved[key]
+        if value.shape != leaf.shape:
+            raise ValueError(
+                f"{key}: weights shape {value.shape} != expected {leaf.shape}"
+            )
+        leaves.append(jnp.asarray(value, leaf.dtype))
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(template), leaves
+    )

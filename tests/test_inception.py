@@ -1,0 +1,104 @@
+"""InceptionV3 pool3 port (eval/inception.py).
+
+No pretrained weights exist in this image, so these tests pin the
+architecture (feature dim, stage geometry, parameter budget) and the npz
+weight-loading contract — the parts a later weights drop depends on.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cyclegan_tpu.eval.inception import (
+    InceptionV3Pool3,
+    flatten_params,
+    load_params_npz,
+)
+
+
+def _tiny_batch(n=1, s=299):
+    return jnp.asarray(np.random.RandomState(0).rand(n, s, s, 3) * 2 - 1, jnp.float32)
+
+
+def test_pool3_shape_and_param_budget():
+    net = InceptionV3Pool3()
+    x = _tiny_batch()
+    variables = net.init(jax.random.PRNGKey(0), x)
+    out = net.apply(variables, x)
+    assert out.shape == (1, 2048)
+    n_params = sum(
+        a.size for a in jax.tree.leaves(variables["params"])
+    )
+    # InceptionV3 trunk (no logits/aux head) is ~21.8M params; BN moving
+    # stats live in batch_stats, not params.
+    assert 21_000_000 < n_params < 23_000_000, n_params
+    assert "batch_stats" in variables
+
+
+def test_npz_roundtrip_through_inception_features(tmp_path):
+    """flatten_params -> npz -> InceptionFeatures reproduces the direct
+    apply (including the 299 resize being a no-op at 299 input)."""
+    from cyclegan_tpu.eval.features import InceptionFeatures
+
+    net = InceptionV3Pool3()
+    x = _tiny_batch()
+    variables = net.init(jax.random.PRNGKey(1), x)
+    path = str(tmp_path / "w.npz")
+    np.savez(path, **flatten_params(variables))
+
+    fx = InceptionFeatures(path)
+    assert fx.dim == 2048
+    np.testing.assert_allclose(
+        np.asarray(fx(x)),
+        np.asarray(net.apply(variables, x)),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_npz_validation_errors(tmp_path):
+    net = InceptionV3Pool3()
+    variables = jax.eval_shape(
+        lambda: net.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
+    )
+    flat = {
+        k: np.zeros(v.shape, v.dtype)
+        for k, v in flatten_params(
+            jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), variables)
+        ).items()
+    }
+
+    missing = dict(flat)
+    missing.pop(sorted(missing)[0])
+    p1 = str(tmp_path / "missing.npz")
+    np.savez(p1, **missing)
+    with pytest.raises(ValueError, match="missing"):
+        load_params_npz(p1, variables)
+
+    key = sorted(flat)[0]
+    bad = dict(flat)
+    bad[key] = np.zeros((1, 2, 3), np.float32)
+    p2 = str(tmp_path / "bad.npz")
+    np.savez(p2, **bad)
+    with pytest.raises(ValueError, match="shape"):
+        load_params_npz(p2, variables)
+
+
+def test_auto_falls_back_on_unusable_weights(tmp_path):
+    """build_feature_extractor('auto', bad_path) must warn and fall back
+    to random features, never crash the training run."""
+    from cyclegan_tpu.eval.features import build_feature_extractor
+
+    p = str(tmp_path / "garbage.npz")
+    np.savez(p, foo=np.zeros(3))
+    fx = build_feature_extractor("auto", p)
+    assert fx.name == "random_conv_2048"
+
+    # A truncated/corrupt zip (np.load raises BadZipFile, not ValueError)
+    # must also fall back, not abort training at startup.
+    p2 = str(tmp_path / "truncated.npz")
+    with open(p2, "wb") as f:
+        f.write(b"PK\x03\x04corrupt")
+    fx = build_feature_extractor("auto", p2)
+    assert fx.name == "random_conv_2048"
