@@ -2,11 +2,13 @@
 
 The standard FID statistic is computed over InceptionV3's 2048-d global-
 average-pooled "pool3" activations. This is a from-scratch Flax port of
-that architecture (TF-slim variant: conv + BatchNorm(eps=1e-3, no scale)
-+ ReLU everywhere, VALID-padded stem, SAME-padded inception blocks), so
-the framework's FID harness (eval/fid.py) can produce Inception-FID
-numbers the moment a weights file is supplied — this offline image ships
-none, so `features.InceptionFeatures` stays gated on the .npz path.
+that architecture (conv + frozen affine BatchNorm(eps=1e-3) + ReLU
+everywhere, VALID-padded stem, SAME-padded inception blocks), so the
+framework's FID harness (eval/fid.py) can produce Inception-FID numbers
+the moment a weights file is supplied — this offline image ships none,
+so `features.InceptionFeatures` stays gated on the .npz path.
+`tools/convert_inception_weights.py` maps a torch-style state dict onto
+the npz convention.
 
 Weight file convention: a flat npz whose keys are the '/'-joined param
 paths of this module's (nested) variable tree, e.g.
@@ -32,7 +34,12 @@ import numpy as np
 
 
 class ConvBN(nn.Module):
-    """Conv(no bias) -> frozen BatchNorm(eps=1e-3, no scale) -> ReLU."""
+    """Conv(no bias) -> frozen affine BatchNorm(eps=1e-3) -> ReLU.
+
+    The BN carries a scale (gamma): the realistic public weight sources
+    (torch-style releases) are affine, and a scale-free BN cannot absorb
+    their gamma exactly through the epsilon term.
+    """
 
     features: int
     kernel: Sequence[int] = (3, 3)
@@ -50,7 +57,7 @@ class ConvBN(nn.Module):
         )(x)
         x = nn.BatchNorm(
             use_running_average=True,
-            use_scale=False,
+            use_scale=True,
             use_bias=True,
             epsilon=1e-3,
         )(x)
@@ -62,7 +69,12 @@ def _max_pool(x, window=3, stride=2, padding="VALID"):
 
 
 def _avg_pool3(x):
-    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+    # count_include_pad=False matches the FID-standard Inception port
+    # (pt_inception-2015-12-05 / pytorch-fid's FIDInception blocks):
+    # border pixels average over the VALID window only.
+    return nn.avg_pool(
+        x, (3, 3), strides=(1, 1), padding="SAME", count_include_pad=False
+    )
 
 
 class MixedA(nn.Module):
@@ -132,7 +144,14 @@ class ReductionB(nn.Module):
 
 
 class MixedC(nn.Module):
-    """8x8 block (Mixed_7b/7c): expanded-filter-bank branches."""
+    """8x8 block (Mixed_7b/7c): expanded-filter-bank branches.
+
+    pool="max" reproduces the FID-standard port's Mixed_7c quirk
+    (pytorch-fid FIDInceptionE_2): the original TF FID graph uses a MAX
+    pool in that block's pool branch where stock InceptionV3 averages.
+    """
+
+    pool: str = "avg"
 
     @nn.compact
     def __call__(self, x):
@@ -146,7 +165,12 @@ class MixedC(nn.Module):
         b2 = jnp.concatenate(
             [ConvBN(384, (1, 3))(b2), ConvBN(384, (3, 1))(b2)], axis=-1
         )
-        b3 = ConvBN(192, (1, 1))(_avg_pool3(x))
+        pooled = (
+            _max_pool(x, window=3, stride=1, padding="SAME")
+            if self.pool == "max"
+            else _avg_pool3(x)
+        )
+        b3 = ConvBN(192, (1, 1))(pooled)
         return jnp.concatenate([b0, b1, b2, b3], axis=-1)
 
 
@@ -178,9 +202,9 @@ class InceptionV3Pool3(nn.Module):
         x = MixedB(channels_7x7=160)(x)
         x = MixedB(channels_7x7=192)(x)
         x = ReductionB()(x)
-        # 8x8
+        # 8x8 (Mixed_7c uses the FID-graph max-pool branch — see MixedC)
         x = MixedC()(x)
-        x = MixedC()(x)
+        x = MixedC(pool="max")(x)
         return jnp.mean(x, axis=(1, 2))  # pool3: [N, 2048]
 
 

@@ -1,0 +1,102 @@
+"""Weight-converter mapping (tools/convert_inception_weights.py).
+
+torchvision isn't installed in this image, so the converter is pinned
+against a MOCK state dict carrying the exact torchvision inception_v3
+tensor names with shapes derived (inversely) from our own module tree:
+completeness in both directions, OIHW->HWIO transposition, and
+end-to-end loadability are all asserted without the real weights.
+"""
+
+import sys
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1] / "tools"))
+
+from convert_inception_weights import conv_bn_pairs, convert_state_dict  # noqa: E402
+
+from cyclegan_tpu.eval.inception import (  # noqa: E402
+    InceptionV3Pool3,
+    flatten_params,
+    load_params_npz,
+)
+
+
+def _template():
+    net = InceptionV3Pool3()
+    return net, jax.eval_shape(
+        lambda: net.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
+    )
+
+
+def _net_template_shapes():
+    """(net, template, {flat key: shape}) — shared by every test here."""
+    net, template = _template()
+    shapes = {
+        k: tuple(v.shape)
+        for k, v in flatten_params(
+            jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), template)
+        ).items()
+    }
+    return net, template, shapes
+
+
+def _mock_state_dict(flat_shapes, seed=0):
+    """torchvision-named state dict with shapes inverse-derived from our
+    flat key shapes."""
+    rng = np.random.RandomState(seed)
+    sd = {}
+    for ours, theirs in conv_bn_pairs():
+        kh, kw, cin, cout = flat_shapes[f"params/{ours}/Conv_0/kernel"]
+        # Fan-in scaling: unit-variance weights overflow float32 through
+        # 94 stacked conv layers.
+        scale = 1.0 / np.sqrt(kh * kw * cin)
+        sd[f"{theirs}.conv.weight"] = (
+            rng.randn(cout, cin, kh, kw).astype(np.float32) * scale
+        )
+        (c,) = flat_shapes[f"params/{ours}/BatchNorm_0/scale"]
+        sd[f"{theirs}.bn.weight"] = rng.rand(c).astype(np.float32) + 0.5
+        sd[f"{theirs}.bn.bias"] = rng.randn(c).astype(np.float32) * 0.1
+        sd[f"{theirs}.bn.running_mean"] = rng.randn(c).astype(np.float32) * 0.1
+        sd[f"{theirs}.bn.running_var"] = rng.rand(c).astype(np.float32) + 0.5
+    return sd
+
+
+def test_mapping_is_complete_and_loads(tmp_path):
+    net, template, flat_shapes = _net_template_shapes()
+
+    out = convert_state_dict(_mock_state_dict(flat_shapes))
+    # Exactly our key set: nothing missing, nothing extra.
+    assert set(out) == set(flat_shapes)
+    for k, v in out.items():
+        assert v.shape == flat_shapes[k], k
+
+    path = str(tmp_path / "converted.npz")
+    np.savez(path, **out)
+    variables = load_params_npz(path, template)
+    feats = net.apply(variables, jnp.zeros((1, 299, 299, 3)))
+    assert feats.shape == (1, 2048)
+    assert np.isfinite(np.asarray(feats)).all()
+
+
+def test_kernel_transposition():
+    """A marked torch OIHW kernel must land HWIO under the right key."""
+    _, _, flat_shapes = _net_template_shapes()
+    sd = _mock_state_dict(flat_shapes)
+    marked = np.asarray(sd["Conv2d_1a_3x3.conv.weight"])  # [32, 3, 3, 3]
+    out = convert_state_dict(sd)
+    got = out["params/ConvBN_0/Conv_0/kernel"]  # [3, 3, 3, 32] HWIO
+    np.testing.assert_array_equal(got, np.transpose(marked, (2, 3, 1, 0)))
+
+
+def test_missing_tensor_is_loud():
+    import pytest
+
+    _, _, flat_shapes = _net_template_shapes()
+    sd = _mock_state_dict(flat_shapes)
+    del sd["Mixed_6b.branch7x7_2.conv.weight"]
+    with pytest.raises(KeyError, match="Mixed_6b.branch7x7_2"):
+        convert_state_dict(sd)

@@ -1,0 +1,145 @@
+"""Convert a torch-style InceptionV3 state dict to the framework's npz.
+
+Maps the torchvision `inception_v3` module naming onto the
+`cyclegan_tpu.eval.inception` npz key convention, transposing conv
+kernels OIHW -> HWIO. The weights to use for literature-comparable FID
+are the pytorch-fid release `pt_inception-2015-12-05.pth` (the TF FID
+graph port — its state-dict keys match the torchvision names this
+converter expects, and eval/inception.py reproduces that graph's
+pooling quirks: count_include_pad=False averages, Mixed_7c max pool).
+Plain torchvision IMAGENET1K_V1 weights also load, but FID numbers from
+them are NOT comparable to published values.
+
+The mapping is positional per block and pinned by
+tests/test_inception_convert.py against a mock state dict with the
+exact torchvision names and shapes — no network or torchvision needed.
+
+Usage (with a .pt/.pth file readable by torch, or an npz of the raw
+state dict):
+  python tools/convert_inception_weights.py --input pt_inception.pth \
+      --output inception_fid.npz
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+import numpy as np
+
+# Running as `python tools/convert_inception_weights.py` puts tools/ on
+# sys.path, not the repo root where cyclegan_tpu lives.
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+# Our ConvBN module prefix -> torchvision BasicConv2d prefix, in the
+# forward order both implementations share (see eval/inception.py and
+# torchvision.models.inception).
+_STEM = [
+    ("ConvBN_0", "Conv2d_1a_3x3"),
+    ("ConvBN_1", "Conv2d_2a_3x3"),
+    ("ConvBN_2", "Conv2d_2b_3x3"),
+    ("ConvBN_3", "Conv2d_3b_1x1"),
+    ("ConvBN_4", "Conv2d_4a_3x3"),
+]
+
+_MIXED_A = ["branch1x1", "branch5x5_1", "branch5x5_2",
+            "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3", "branch_pool"]
+_REDUCTION_A = ["branch3x3", "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3"]
+_MIXED_B = ["branch1x1", "branch7x7_1", "branch7x7_2", "branch7x7_3",
+            "branch7x7dbl_1", "branch7x7dbl_2", "branch7x7dbl_3",
+            "branch7x7dbl_4", "branch7x7dbl_5", "branch_pool"]
+_REDUCTION_B = ["branch3x3_1", "branch3x3_2",
+                "branch7x7x3_1", "branch7x7x3_2", "branch7x7x3_3", "branch7x7x3_4"]
+_MIXED_C = ["branch1x1", "branch3x3_1", "branch3x3_2a", "branch3x3_2b",
+            "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3a",
+            "branch3x3dbl_3b", "branch_pool"]
+
+_BLOCKS = (
+    [("MixedA_0", "Mixed_5b", _MIXED_A),
+     ("MixedA_1", "Mixed_5c", _MIXED_A),
+     ("MixedA_2", "Mixed_5d", _MIXED_A),
+     ("ReductionA_0", "Mixed_6a", _REDUCTION_A),
+     ("MixedB_0", "Mixed_6b", _MIXED_B),
+     ("MixedB_1", "Mixed_6c", _MIXED_B),
+     ("MixedB_2", "Mixed_6d", _MIXED_B),
+     ("MixedB_3", "Mixed_6e", _MIXED_B),
+     ("ReductionB_0", "Mixed_7a", _REDUCTION_B),
+     ("MixedC_0", "Mixed_7b", _MIXED_C),
+     ("MixedC_1", "Mixed_7c", _MIXED_C)]
+)
+
+
+def conv_bn_pairs():
+    """Yield (our_prefix, torch_prefix) for every ConvBN in the net."""
+    for ours, torch_name in _STEM:
+        yield ours, torch_name
+    for block_ours, block_torch, branches in _BLOCKS:
+        for i, branch in enumerate(branches):
+            yield f"{block_ours}/ConvBN_{i}", f"{block_torch}.{branch}"
+
+
+def convert_state_dict(sd: dict) -> dict:
+    """torch-style {name: np.ndarray} -> flat npz dict in the
+    eval/inception key convention. Raises KeyError on missing tensors."""
+    out = {}
+    for ours, theirs in conv_bn_pairs():
+        w = np.asarray(sd[f"{theirs}.conv.weight"])  # OIHW
+        out[f"params/{ours}/Conv_0/kernel"] = np.transpose(w, (2, 3, 1, 0))
+        out[f"params/{ours}/BatchNorm_0/scale"] = np.asarray(sd[f"{theirs}.bn.weight"])
+        out[f"params/{ours}/BatchNorm_0/bias"] = np.asarray(sd[f"{theirs}.bn.bias"])
+        out[f"batch_stats/{ours}/BatchNorm_0/mean"] = np.asarray(
+            sd[f"{theirs}.bn.running_mean"]
+        )
+        out[f"batch_stats/{ours}/BatchNorm_0/var"] = np.asarray(
+            sd[f"{theirs}.bn.running_var"]
+        )
+    return out
+
+
+def main(args: argparse.Namespace) -> None:
+    if args.input.endswith(".npz"):
+        with np.load(args.input) as f:
+            sd = {k: f[k] for k in f.files}
+    else:
+        import torch
+
+        raw = torch.load(args.input, map_location="cpu", weights_only=True)
+        if hasattr(raw, "state_dict"):
+            raw = raw.state_dict()
+        sd = {k: v.numpy() for k, v in raw.items()}
+
+    out = convert_state_dict(sd)
+
+    # Validate against the actual module tree BEFORE the destination file
+    # exists: a failed conversion must not leave a bad npz behind.
+    from cyclegan_tpu.utils.platform import ensure_platform_from_env
+
+    ensure_platform_from_env()  # honor JAX_PLATFORMS over the axon plugin
+    import os
+    import jax
+    import jax.numpy as jnp
+
+    from cyclegan_tpu.eval.inception import InceptionV3Pool3, load_params_npz
+
+    net = InceptionV3Pool3()
+    template = jax.eval_shape(
+        lambda: net.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
+    )
+    tmp = args.output + ".tmp.npz"
+    np.savez(tmp, **out)
+    try:
+        load_params_npz(tmp, template)
+    except Exception:
+        os.unlink(tmp)
+        raise
+    os.replace(tmp, args.output)
+    print(f"wrote {len(out)} tensors -> {args.output} (validated)")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--input", required=True,
+                   help=".pth/.pt torch state dict, or an npz of it")
+    p.add_argument("--output", required=True, help="destination npz")
+    main(p.parse_args())
