@@ -28,19 +28,22 @@ def make_fid_evaluator(config, data, feature_extractor):
     `evaluate.translate` so tests can assert the compile-cache size), and
     the real-domain feature statistics — fixed for a fixed test split —
     are accumulated on the first call only; later calls re-extract only
-    the fake-domain features. Single-process only: mixing a mesh-global
-    train state with per-host-different test batches under plain jit is
-    undefined across processes, so multi-host callers must gate this to
-    an explicit single-host evaluation.
+    the fake-domain features.
+
+    Multi-host: the mesh-global state cannot be mixed with per-host test
+    batches under plain jit, so each process pulls the (replicated)
+    generator params host-local, evaluates its own 1/P test shard
+    independently, then the streaming moments are summed across processes
+    (fid.allreduce_accumulator) — every host reports the full-dataset
+    score.
     """
-    from cyclegan_tpu.eval.fid import FIDAccumulator, fid_from_accumulators
+    from cyclegan_tpu.eval.fid import (
+        FIDAccumulator,
+        allreduce_accumulator,
+        fid_from_accumulators,
+    )
     from cyclegan_tpu.train.state import build_models
 
-    if jax.process_count() > 1:
-        raise ValueError(
-            "make_fid_evaluator is single-process only; run FID evaluation "
-            "out-of-band (python -m cyclegan_tpu.eval.evaluate) on one host"
-        )
     if data.n_test < 2:
         raise ValueError(
             f"FID needs at least 2 test pairs per domain; got {data.n_test}"
@@ -48,10 +51,21 @@ def make_fid_evaluator(config, data, feature_extractor):
     gen, _ = build_models(config)
 
     @jax.jit
-    def translate(state, x, y):
+    def translate(g_params, f_params, x, y):
         # Only the two translation forwards FID needs (not the 4-apply
         # cycle step — the reconstructions would be discarded).
-        return gen.apply(state.f_params, y), gen.apply(state.g_params, x)
+        return gen.apply(f_params, y), gen.apply(g_params, x)
+
+    def host_local(tree):
+        """Replicated global arrays -> host-local values, so the forward
+        runs independently per process on per-host batches."""
+
+        def pull(a):
+            if isinstance(a, jax.Array) and not a.is_fully_addressable:
+                return np.asarray(a.addressable_data(0))
+            return a
+
+        return jax.tree.map(pull, tree)
 
     real = {}
 
@@ -62,15 +76,22 @@ def make_fid_evaluator(config, data, feature_extractor):
             real["b"] = FIDAccumulator(feature_extractor.dim)
         fake_a = FIDAccumulator(feature_extractor.dim)
         fake_b = FIDAccumulator(feature_extractor.dim)
+        g_params, f_params = host_local((state.g_params, state.f_params))
 
         for x, y, w in data.test_epoch(prefetch=False):
-            fake_x, fake_y = translate(state, x, y)
+            fake_x, fake_y = translate(g_params, f_params, x, y)
             keep = np.asarray(w) > 0  # drop zero-padded rows of the final batch
             if first:
                 real["a"].update(np.asarray(feature_extractor(x))[keep])
                 real["b"].update(np.asarray(feature_extractor(y))[keep])
             fake_a.update(np.asarray(feature_extractor(fake_x))[keep])
             fake_b.update(np.asarray(feature_extractor(fake_y))[keep])
+
+        if first:
+            real["a"] = allreduce_accumulator(real["a"])
+            real["b"] = allreduce_accumulator(real["b"])
+        fake_a = allreduce_accumulator(fake_a)
+        fake_b = allreduce_accumulator(fake_b)
 
         return {
             f"fid/{feature_extractor.name}/G(A)_vs_B": fid_from_accumulators(

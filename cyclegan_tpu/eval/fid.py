@@ -97,6 +97,48 @@ class FIDAccumulator:
         return mu, cov
 
 
+def combine_accumulators(accs) -> FIDAccumulator:
+    """Merge accumulators over the same feature space: moments are sums,
+    so the merge is exact (used for cross-host FID reduction)."""
+    accs = list(accs)
+    out = FIDAccumulator(accs[0].dim)
+    for a in accs:
+        assert a.dim == out.dim
+        out.n += a.n
+        out._sum += a._sum
+        out._outer += a._outer
+    return out
+
+
+def allreduce_accumulator(acc: FIDAccumulator) -> FIDAccumulator:
+    """Sum an accumulator's moments across all jax processes, so every
+    host ends up with the full-dataset statistics. No-op single-process.
+
+    Uses process_allgather over the (n, sum, outer) payload — a
+    host-level collective over DCN, outside any jitted computation. The
+    float64 moments travel as raw uint32 bit pairs: jax canonicalizes
+    f64->f32 (x64 mode is never enabled here), which would truncate the
+    cancellation-prone covariance moments to ~7 digits.
+    """
+    if jax.process_count() == 1:
+        return acc
+    from jax.experimental import multihost_utils
+
+    payload = np.concatenate(
+        [np.array([float(acc.n)]), acc._sum, acc._outer.reshape(-1)]
+    )
+    gathered = np.asarray(multihost_utils.process_allgather(payload.view(np.uint32)))
+    parts = []
+    for row in gathered:
+        vals = np.ascontiguousarray(row).view(np.float64)
+        part = FIDAccumulator(acc.dim)
+        part.n = int(round(vals[0]))
+        part._sum = vals[1 : 1 + acc.dim].copy()
+        part._outer = vals[1 + acc.dim :].reshape(acc.dim, acc.dim).copy()
+        parts.append(part)
+    return combine_accumulators(parts)
+
+
 def fid_from_accumulators(acc_a: FIDAccumulator, acc_b: FIDAccumulator) -> float:
     mu_a, sig_a = acc_a.stats()
     mu_b, sig_b = acc_b.stats()

@@ -130,22 +130,18 @@ def main(args: argparse.Namespace) -> None:
 
     # Periodic FID (the north-star quality metric — BASELINE.md; the
     # reference computes no quality metric at all, SURVEY.md §6).
+    # Every host evaluates its own test shard; moments allreduce across
+    # processes so the logged score covers the full test set.
     fid_eval = None
     if args.fid_every > 0:
-        if jax.process_count() > 1:
-            if primary:
-                print("WARNING: --fid_every is single-host only; disabled. "
-                      "Evaluate checkpoints with python -m "
-                      "cyclegan_tpu.eval.evaluate instead.")
-        else:
-            from cyclegan_tpu.eval.evaluate import make_fid_evaluator
-            from cyclegan_tpu.eval.features import build_feature_extractor
+        from cyclegan_tpu.eval.evaluate import make_fid_evaluator
+        from cyclegan_tpu.eval.features import build_feature_extractor
 
-            fid_eval = make_fid_evaluator(
-                config,
-                data,
-                build_feature_extractor(args.fid_features, args.fid_feature_weights),
-            )
+        fid_eval = make_fid_evaluator(
+            config,
+            data,
+            build_feature_extractor(args.fid_features, args.fid_feature_weights),
+        )
 
     # Preemption (SIGTERM on TPU maintenance events): finish the epoch,
     # checkpoint, exit; auto-resume continues from the next epoch.

@@ -123,3 +123,34 @@ def test_fid_evaluator_is_reusable(tiny_config):
     assert any(abs(a[k] - b[k]) > 1e-9 for k in a), "scores ignore the state"
     # The no-retrace property itself: one compiled program serves all calls.
     assert evaluate.translate._cache_size() == 1
+
+
+def test_combine_accumulators_is_exact():
+    """Split-then-merge moments == single-pass moments (the cross-host
+    reduction is a pure sum, no approximation)."""
+    from cyclegan_tpu.eval.fid import FIDAccumulator, combine_accumulators
+
+    rng = np.random.RandomState(3)
+    feats = rng.randn(64, 8)
+
+    whole = FIDAccumulator(8)
+    whole.update(feats)
+
+    parts = [FIDAccumulator(8) for _ in range(3)]
+    parts[0].update(feats[:10])
+    parts[1].update(feats[10:41])
+    parts[2].update(feats[41:])
+    merged = combine_accumulators(parts)
+
+    assert merged.n == whole.n
+    for a, b in zip(whole.stats(), merged.stats()):
+        np.testing.assert_allclose(a, b, rtol=1e-10, atol=1e-12)
+
+
+def test_allreduce_accumulator_single_process_noop():
+    from cyclegan_tpu.eval.fid import FIDAccumulator, allreduce_accumulator
+
+    acc = FIDAccumulator(4)
+    acc.update(np.random.RandomState(0).randn(5, 4))
+    out = allreduce_accumulator(acc)
+    assert out is acc  # single-process: identity, no copies

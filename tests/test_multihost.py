@@ -75,6 +75,15 @@ def test_two_process_training_matches_single_process(tmp_path):
             line = [l for l in out.splitlines() if l.startswith("METRICS ")]
             assert line, f"no METRICS line in:\n{out}"
             outs.append(json.loads(line[0][len("METRICS "):]))
+            fid_line = [l for l in out.splitlines() if l.startswith("FID ")]
+            assert fid_line, f"no FID line in:\n{out}"
+            fid = json.loads(fid_line[0][len("FID "):])
+            # Sharded accumulation + cross-host allreduce == whole-set
+            # statistics, on every host — bit-preserving f64 reduction,
+            # so the moments agree to f64 roundoff, not f32 truncation.
+            assert fid["n"] == 32
+            assert fid["moment_err"] < 1e-12, fid
+            assert abs(fid["fid_vs_whole"]) < 1e-2, fid
     finally:
         # Never leak a live worker (it holds the coordinator port and two
         # JAX runtimes) when the other worker fails or times out.
