@@ -39,7 +39,7 @@ def make_fid_evaluator(config, data, feature_extractor):
     """
     from cyclegan_tpu.eval.fid import (
         FIDAccumulator,
-        allreduce_accumulator,
+        allreduce_accumulators,
         fid_from_accumulators,
     )
     from cyclegan_tpu.train.state import build_models
@@ -87,11 +87,15 @@ def make_fid_evaluator(config, data, feature_extractor):
             fake_a.update(np.asarray(feature_extractor(fake_x))[keep])
             fake_b.update(np.asarray(feature_extractor(fake_y))[keep])
 
+        # One collective however many domains reduce this call (4 on the
+        # first — real stats included — 2 after). `first` is identical on
+        # every host, so the payload layout agrees across processes.
         if first:
-            real["a"] = allreduce_accumulator(real["a"])
-            real["b"] = allreduce_accumulator(real["b"])
-        fake_a = allreduce_accumulator(fake_a)
-        fake_b = allreduce_accumulator(fake_b)
+            real["a"], real["b"], fake_a, fake_b = allreduce_accumulators(
+                [real["a"], real["b"], fake_a, fake_b]
+            )
+        else:
+            fake_a, fake_b = allreduce_accumulators([fake_a, fake_b])
 
         return {
             f"fid/{feature_extractor.name}/G(A)_vs_B": fid_from_accumulators(

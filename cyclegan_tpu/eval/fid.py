@@ -110,33 +110,54 @@ def combine_accumulators(accs) -> FIDAccumulator:
     return out
 
 
-def allreduce_accumulator(acc: FIDAccumulator) -> FIDAccumulator:
-    """Sum an accumulator's moments across all jax processes, so every
+def allreduce_accumulators(accs) -> list:
+    """Sum each accumulator's moments across all jax processes, so every
     host ends up with the full-dataset statistics. No-op single-process.
 
-    Uses process_allgather over the (n, sum, outer) payload — a
-    host-level collective over DCN, outside any jitted computation. The
-    float64 moments travel as raw uint32 bit pairs: jax canonicalizes
-    f64->f32 (x64 mode is never enabled here), which would truncate the
-    cancellation-prone covariance moments to ~7 digits.
+    ONE process_allgather carries all accumulators' (n, sum, outer)
+    payloads concatenated — a host-level collective over DCN, outside any
+    jitted computation, paying setup latency once however many domains
+    are reduced. The float64 moments travel as raw uint32 bit pairs: jax
+    canonicalizes f64->f32 (x64 mode is never enabled here), which would
+    truncate the cancellation-prone covariance moments to ~7 digits.
     """
-    if jax.process_count() == 1:
-        return acc
+    accs = list(accs)
+    if jax.process_count() == 1 or not accs:
+        return accs
+    assert all(a.dim == accs[0].dim for a in accs)
     from jax.experimental import multihost_utils
 
+    stride = 1 + accs[0].dim + accs[0].dim**2
     payload = np.concatenate(
-        [np.array([float(acc.n)]), acc._sum, acc._outer.reshape(-1)]
+        [
+            np.concatenate(
+                [np.array([float(a.n)]), a._sum, a._outer.reshape(-1)]
+            )
+            for a in accs
+        ]
     )
     gathered = np.asarray(multihost_utils.process_allgather(payload.view(np.uint32)))
-    parts = []
-    for row in gathered:
-        vals = np.ascontiguousarray(row).view(np.float64)
-        part = FIDAccumulator(acc.dim)
-        part.n = int(round(vals[0]))
-        part._sum = vals[1 : 1 + acc.dim].copy()
-        part._outer = vals[1 + acc.dim :].reshape(acc.dim, acc.dim).copy()
-        parts.append(part)
-    return combine_accumulators(parts)
+    out = []
+    for j, acc in enumerate(accs):
+        parts = []
+        for row in gathered:
+            vals = np.ascontiguousarray(row).view(np.float64)[
+                j * stride : (j + 1) * stride
+            ]
+            part = FIDAccumulator(acc.dim)
+            part.n = int(round(vals[0]))
+            part._sum = vals[1 : 1 + acc.dim].copy()
+            part._outer = vals[1 + acc.dim :].reshape(acc.dim, acc.dim).copy()
+            parts.append(part)
+        out.append(combine_accumulators(parts))
+    return out
+
+
+def allreduce_accumulator(acc: FIDAccumulator) -> FIDAccumulator:
+    """Single-accumulator convenience over `allreduce_accumulators`."""
+    if jax.process_count() == 1:
+        return acc
+    return allreduce_accumulators([acc])[0]
 
 
 def fid_from_accumulators(acc_a: FIDAccumulator, acc_b: FIDAccumulator) -> float:

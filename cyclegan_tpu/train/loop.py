@@ -27,6 +27,11 @@ from cyclegan_tpu.utils.dicts import append_dict, mean_dict
 from cyclegan_tpu.utils.summary import Summary
 
 
+# Max dispatched-but-unfetched steps: enough lead to hide host latency,
+# small enough that pinned input batches stay a bounded slice of HBM.
+MAX_IN_FLIGHT = 32
+
+
 def _progress(it, total: int, desc: str, verbose: int):
     if verbose == 0:
         return it
@@ -61,18 +66,23 @@ def train_epoch(
     device work ran.
     """
     k = config.train.steps_per_dispatch
-    results: Dict[str, list] = {}
+    # Deferred metric fetch: device_get per step would SYNC the host to
+    # every step, serializing dispatch. Holding the (tiny scalar) device
+    # arrays and fetching later keeps the dispatch pipeline async — the
+    # per-step path then approaches the fused-scan ceiling. The window is
+    # bounded: fetching the OLDEST entry once more than MAX_IN_FLIGHT are
+    # outstanding gives backpressure, so the host can't enqueue an
+    # unbounded number of steps whose input batches stay pinned on device.
+    pending: list = []
+    fetched: list = []
     it = _progress(
         data.train_epoch(epoch), data.train_steps, "Train", config.train.verbose
     )
 
     def append_metrics(metrics, steps: int = 1):
-        host = jax.device_get(metrics)
-        if steps == 1:
-            append_dict(results, host)
-        else:
-            for i in range(steps):
-                append_dict(results, {key: v[i] for key, v in host.items()})
+        pending.append((metrics, steps))
+        if len(pending) > MAX_IN_FLIGHT:
+            fetched.append(jax.device_get(pending.pop(0)))
 
     buf = []
     for x, y, w in it:
@@ -104,6 +114,14 @@ def train_epoch(
         xs, ys, ws = shard_batch(plan, x, y, w)
         state, metrics = step_fn(state, xs, ys, ws)
         append_metrics(metrics)
+
+    results: Dict[str, list] = {}
+    for metrics, steps in fetched + jax.device_get(pending):
+        if steps == 1:
+            append_dict(results, metrics)
+        else:
+            for i in range(steps):
+                append_dict(results, {key: v[i] for key, v in metrics.items()})
     for key, value in mean_dict(results).items():
         summary.scalar(key, value, step=epoch, training=True)
     return state
@@ -118,13 +136,20 @@ def test_epoch(
     summary: Summary,
     epoch: int,
 ) -> Dict[str, float]:
-    """One eval pass (reference main.py:344-355)."""
-    results: Dict[str, list] = {}
+    """One eval pass (reference main.py:344-355). Metric fetches defer
+    to the end of the pass (same async-dispatch rationale as
+    train_epoch)."""
+    pending: list = []
+    fetched: list = []
     it = _progress(data.test_epoch(), data.test_steps, "Test", config.train.verbose)
     for x, y, w in it:
         xs, ys, ws = shard_batch(plan, x, y, w)
-        metrics = step_fn(state, xs, ys, ws)
-        append_dict(results, jax.device_get(metrics))
+        pending.append(step_fn(state, xs, ys, ws))
+        if len(pending) > MAX_IN_FLIGHT:
+            fetched.append(jax.device_get(pending.pop(0)))
+    results: Dict[str, list] = {}
+    for metrics in fetched + jax.device_get(pending):
+        append_dict(results, metrics)
     means = mean_dict(results)
     for key, value in means.items():
         summary.scalar(key, value, step=epoch, training=False)
