@@ -34,7 +34,7 @@ def make_fid_evaluator(config, data, feature_extractor):
     batches under plain jit, so each process pulls the (replicated)
     generator params host-local, evaluates its own 1/P test shard
     independently, then the streaming moments are summed across processes
-    (fid.allreduce_accumulator) — every host reports the full-dataset
+    (fid.allreduce_accumulators, one collective for all four) — every host reports the full-dataset
     score.
     """
     from cyclegan_tpu.eval.fid import (

@@ -155,8 +155,6 @@ def allreduce_accumulators(accs) -> list:
 
 def allreduce_accumulator(acc: FIDAccumulator) -> FIDAccumulator:
     """Single-accumulator convenience over `allreduce_accumulators`."""
-    if jax.process_count() == 1:
-        return acc
     return allreduce_accumulators([acc])[0]
 
 

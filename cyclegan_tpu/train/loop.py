@@ -27,8 +27,10 @@ from cyclegan_tpu.utils.dicts import append_dict, mean_dict
 from cyclegan_tpu.utils.summary import Summary
 
 
-# Max dispatched-but-unfetched steps: enough lead to hide host latency,
-# small enough that pinned input batches stay a bounded slice of HBM.
+# Max dispatched-but-unfetched TRAIN STEPS (not dispatches: one fused
+# dispatch carries steps_per_dispatch of them): enough lead to hide host
+# latency, small enough that pinned input batches stay a bounded slice
+# of HBM.
 MAX_IN_FLIGHT = 32
 
 
@@ -80,8 +82,10 @@ def train_epoch(
     )
 
     def append_metrics(metrics, steps: int = 1):
+        # Backpressure counts STEPS: a fused dispatch pins K input batches,
+        # so bounding dispatch count alone would let K scale the pinned HBM.
         pending.append((metrics, steps))
-        if len(pending) > MAX_IN_FLIGHT:
+        while sum(s for _, s in pending) > max(MAX_IN_FLIGHT, steps):
             fetched.append(jax.device_get(pending.pop(0)))
 
     buf = []

@@ -64,34 +64,52 @@ def main():
     print("METRICS " + json.dumps(out, sort_keys=True), flush=True)
 
     # Cross-host FID reduction: each process accumulates only ITS slice
-    # of a fixed global feature set; after allreduce_accumulator every
+    # of fixed global feature sets; after allreduce_accumulators every
     # process must hold the full-set statistics (FID vs the whole-set
     # accumulator == 0 up to float roundoff, identically on all hosts).
     from cyclegan_tpu.eval.fid import (
         FIDAccumulator,
-        allreduce_accumulator,
+        allreduce_accumulators,
         fid_from_accumulators,
     )
 
-    feats = np.random.RandomState(7).randn(32, 16)  # same on every process
-    whole = FIDAccumulator(16)
-    whole.update(feats)
+    # THREE accumulators reduced in ONE collective, with distinct feature
+    # sets per accumulator: exercises the j>0 stride-slice path of the
+    # batched payload layout (evaluate.py reduces four per FID sweep) —
+    # an offset bug in any slice must fail here, not just for j=0.
+    n_acc = 3
+    feat_sets = [
+        np.random.RandomState(7 + j).randn(33 + 4 * j, 16) for j in range(n_acc)
+    ]  # same on every process; ODD sizes so per-host counts are ragged
+    wholes, locals_ = [], []
+    for feats in feat_sets:
+        whole = FIDAccumulator(16)
+        whole.update(feats)
+        wholes.append(whole)
+        per = feats.shape[0] // jax.process_count()
+        lo = jax.process_index() * per
+        local = FIDAccumulator(16)
+        # Remainder rows go to the last process so counts differ per host.
+        hi = lo + per if jax.process_index() < jax.process_count() - 1 else None
+        local.update(feats[lo:hi])
+        locals_.append(local)
+    merged = allreduce_accumulators(locals_)
 
-    per = feats.shape[0] // jax.process_count()
-    lo = jax.process_index() * per
-    local = FIDAccumulator(16)
-    local.update(feats[lo:lo + per])
-    merged = allreduce_accumulator(local)
-
-    fid = fid_from_accumulators(merged, whole)
     # The uint32 bit-preserving gather makes the reduction EXACT in f64,
     # not merely close: expose the max moment deviation for the test.
-    mu_w, cov_w = whole.stats()
-    mu_m, cov_m = merged.stats()
-    moment_err = max(
-        float(np.abs(mu_w - mu_m).max()), float(np.abs(cov_w - cov_m).max())
-    )
-    print("FID " + json.dumps({"n": merged.n, "fid_vs_whole": float(fid),
+    fid = moment_err = 0.0
+    n_total = []
+    for whole, m in zip(wholes, merged):
+        fid = max(fid, fid_from_accumulators(m, whole))
+        mu_w, cov_w = whole.stats()
+        mu_m, cov_m = m.stats()
+        moment_err = max(
+            moment_err,
+            float(np.abs(mu_w - mu_m).max()),
+            float(np.abs(cov_w - cov_m).max()),
+        )
+        n_total.append(m.n)
+    print("FID " + json.dumps({"n": n_total, "fid_vs_whole": float(fid),
                                "moment_err": moment_err}),
           flush=True)
 

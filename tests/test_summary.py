@@ -1,0 +1,98 @@
+"""Observability-layer unit tests: Summary writers, scalar accumulation,
+and cycle-panel plotting (reference cyclegan/utils.py:14-145).
+
+test_e2e covers these through the CLI; here each behavior is pinned
+directly — tag layout, the split train/test writer directories
+(utils.py:21-24), the (x+1)*127.5 uint8 rescale (utils.py:127-131), and
+the X_cycle/Y_cycle panel families (utils.py:133-144).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from cyclegan_tpu.utils.dicts import append_dict, mean_dict
+from cyclegan_tpu.utils.plotting import plot_cycle, to_uint8
+from cyclegan_tpu.utils.summary import Summary
+
+
+def _event_files(d):
+    return [f for f in os.listdir(d) if f.startswith("events")]
+
+
+def test_summary_split_writers(tmp_path):
+    """Train events land in output_dir, test events in output_dir/test
+    (reference utils.py:21-24) so TensorBoard overlays them."""
+    s = Summary(str(tmp_path))
+    s.scalar("loss_G/total", 1.5, step=0, training=True)
+    s.scalar("loss_G/total", 1.2, step=0, training=False)
+    s.image("panel", np.zeros((8, 8, 3), np.uint8), step=0)
+    s.close()
+    assert _event_files(tmp_path)
+    assert _event_files(tmp_path / "test")
+
+
+def test_summary_figure_renders(tmp_path):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(2, 2))
+    ax.plot([0, 1], [1, 0])
+    s = Summary(str(tmp_path))
+    s.figure("fig", fig, step=3)
+    s.close()
+    assert _event_files(tmp_path)
+    assert not plt.fignum_exists(fig.number)  # closed by default
+
+
+def test_append_and_mean_dict():
+    """append_dict accumulates per key (reference utils.py:101-109);
+    mean_dict reduces to the epoch mean (main.py:340-341)."""
+    acc = {}
+    append_dict(acc, {"a": 1.0, "b": 2.0})
+    append_dict(acc, {"a": 3.0, "b": 4.0})
+    means = mean_dict(acc)
+    assert means == {"a": 2.0, "b": 3.0}
+
+
+def test_to_uint8_rescale():
+    """(x + 1) * 127.5 with clipping (reference utils.py:127-131)."""
+    x = np.array([-1.0, 0.0, 1.0, 1.5, -2.0], np.float32)
+    out = to_uint8(x)
+    assert out.dtype == np.uint8
+    assert list(out) == [0, 127, 255, 255, 0]
+
+
+def test_plot_cycle_emits_both_panel_families(tmp_path):
+    """plot_cycle runs the inference cycle over the plot pairs and emits
+    X_cycle = [X, G(X), F(G(X))] and Y_cycle = [Y, F(Y), G(F(Y))]
+    (reference utils.py:133-144), one 1x3 panel per sample."""
+    calls = []
+
+    class SpySummary(Summary):
+        def __init__(self):
+            self._writers = []
+
+        def image_cycle(self, tag, images, titles=None, step=0, training=False):
+            calls.append((tag, images.shape, tuple(titles), step))
+
+    def cycle_fn(state, x, y):
+        # Deterministic stand-in for the jitted generators.
+        return -y, -x, x * 0.5, y * 0.5
+
+    pairs = [
+        (np.full((1, 4, 4, 3), -0.5, np.float32), np.full((1, 4, 4, 3), 0.5, np.float32))
+        for _ in range(2)
+    ]
+    plot_cycle(pairs, cycle_fn, state=None, summary=SpySummary(), epoch=7)
+
+    assert [c[0] for c in calls] == ["X_cycle", "Y_cycle"]
+    for tag, shape, titles, step in calls:
+        assert shape == (2, 3, 4, 4, 3)  # [n_pairs, 3 panels, H, W, C]
+        assert step == 7
+    assert calls[0][2] == ("X", "G(X)", "F(G(X))")
+    assert calls[1][2] == ("Y", "F(Y)", "G(F(Y))")
