@@ -25,20 +25,85 @@ import jax
 import jax.numpy as jnp
 
 
+def _xla_forward(x, scale, bias, eps):
+    """Subtract-first normalize, all elementwise math in f32 (exact:
+    zero-variance input yields exactly bias), result cast to x.dtype."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=(1, 2), keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=(1, 2), keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    y = (xf - mean) * inv * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(x.dtype), mean, inv
+
+
+def instance_norm_backward(x, scale, mean_b, inv_b, g, bias_dtype):
+    """Shared instance-norm VJP math (single source for the XLA and
+    Pallas custom-VJP paths):
+
+      xhat   = (x - mean) * inv
+      dbias  = sum_{N,HW} g
+      dscale = sum_{N,HW} g * xhat
+      dx     = scale * inv * (g - mean_hw(g) - xhat * mean_hw(g * xhat))
+
+    mean_b/inv_b are broadcast-ready [N, 1, 1, C] f32 stats; all math in
+    f32, outputs cast to the param/activation dtypes.
+    """
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    xhat = (xf - mean_b) * inv_b
+    dbias = jnp.sum(gf, axis=(0, 1, 2))
+    dscale = jnp.sum(gf * xhat, axis=(0, 1, 2))
+    g_mean = jnp.mean(gf, axis=(1, 2), keepdims=True)
+    gx_mean = jnp.mean(gf * xhat, axis=(1, 2), keepdims=True)
+    dx = scale.astype(jnp.float32)[None, None, None, :] * inv_b * (
+        gf - g_mean - xhat * gx_mean
+    )
+    return dx.astype(x.dtype), dscale.astype(scale.dtype), dbias.astype(bias_dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_xla(eps: float):
+    """custom_vjp wrapper: full f32 precision in BOTH passes while saving
+    only (x, scale, mean, inv) for the backward — x in its own dtype.
+
+    Why not plain autodiff: its residuals are the f32 intermediates of
+    the forward chain, so under bfloat16 compute every instance norm
+    pinned full-resolution f32 activations through the backward —
+    22.4G for the 512² batch-4 remat config on a 16G v5e (OOM). With
+    the VJP recomputing xhat from the bf16 x and the tiny per-(N,C)
+    stats, the saves stay bf16 and the same config fits. Gradient math
+    matches ops/pallas/norm_kernel.py op_bwd; cross-checked against
+    torch autograd in tests/test_torch_parity.py.
+    """
+
+    @jax.custom_vjp
+    def op(x, scale, bias):
+        return _xla_forward(x, scale, bias, eps)[0]
+
+    def op_fwd(x, scale, bias):
+        y, mean, inv = _xla_forward(x, scale, bias, eps)
+        return y, (x, scale, mean, inv)
+
+    def op_bwd(res, g):
+        x, scale, mean, inv = res
+        # bias is not a residual (unused by the math); its grad shares
+        # scale's param dtype.
+        return instance_norm_backward(x, scale, mean, inv, g, scale.dtype)
+
+    op.defvjp(op_fwd, op_bwd)
+    return op
+
+
 def _instance_norm_xla(
     x: jnp.ndarray,
     scale: jnp.ndarray,
     bias: jnp.ndarray,
     eps: float,
 ) -> jnp.ndarray:
-    orig_dtype = x.dtype
-    xf = x.astype(jnp.float32)
-    mean = jnp.mean(xf, axis=(1, 2), keepdims=True)
-    var = jnp.mean(jnp.square(xf - mean), axis=(1, 2), keepdims=True)
-    inv = jax.lax.rsqrt(var + eps)
-    y = (xf - mean) * inv
-    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
-    return y.astype(orig_dtype)
+    if x.ndim == 4:
+        return _build_xla(float(eps))(x, scale, bias)
+    # Non-NHWC ranks (not used by the models): plain autodiff path.
+    return _xla_forward(x, scale, bias, eps)[0]
 
 
 @functools.partial(jax.jit, static_argnames=("eps", "impl"))

@@ -112,21 +112,12 @@ def _build(eps: float, interpret: bool):
         return y, (x, scale, bias, mean, inv)
 
     def op_bwd(res, g):
+        from cyclegan_tpu.ops.norm import instance_norm_backward
+
         x, scale, bias, mean, inv = res
-        n, h, w, c = x.shape
-        xf = x.astype(jnp.float32)
-        gf = g.astype(jnp.float32)
-        mean_b = mean[:, None, None, :]
-        inv_b = inv[:, None, None, :]
-        xhat = (xf - mean_b) * inv_b
-        dbias = jnp.sum(gf, axis=(0, 1, 2))
-        dscale = jnp.sum(gf * xhat, axis=(0, 1, 2))
-        g_mean = jnp.mean(gf, axis=(1, 2), keepdims=True)
-        gx_mean = jnp.mean(gf * xhat, axis=(1, 2), keepdims=True)
-        dx = scale.astype(jnp.float32)[None, None, None, :] * inv_b * (
-            gf - g_mean - xhat * gx_mean
+        return instance_norm_backward(
+            x, scale, mean[:, None, None, :], inv[:, None, None, :], g, bias.dtype
         )
-        return dx.astype(x.dtype), dscale.astype(scale.dtype), dbias.astype(bias.dtype)
 
     op.defvjp(op_fwd, op_bwd)
     return op

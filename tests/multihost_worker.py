@@ -100,7 +100,8 @@ def main():
     fid = moment_err = 0.0
     n_total = []
     for whole, m in zip(wholes, merged):
-        fid = max(fid, fid_from_accumulators(m, whole))
+        # abs(): a negative distance regression must not hide under max().
+        fid = max(fid, abs(fid_from_accumulators(m, whole)))
         mu_w, cov_w = whole.stats()
         mu_m, cov_m = m.stats()
         moment_err = max(
