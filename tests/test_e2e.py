@@ -50,3 +50,31 @@ def test_main_end_to_end_and_resume(tmp_path):
     r2 = run_main(out)
     assert r2.returncode == 0, f"stdout:\n{r2.stdout}\nstderr:\n{r2.stderr}"
     assert "Resumed" in r2.stdout
+
+
+@pytest.mark.slow
+def test_main_with_periodic_fid(tmp_path):
+    """--fid_every through the CLI: fid/* scalars computed on the test
+    split at the final epoch and printed (offline random-conv features)."""
+    out = tmp_path / "run"
+    r = run_main(out, extra=("--fid_every", "1", "--fid_features", "random"))
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "fid/" in r.stdout
+
+
+@pytest.mark.slow
+def test_main_scan_blocks_bf16(tmp_path):
+    """--scan_blocks + --bf16 through the CLI: the scanned residual
+    trunk and mixed precision compose end-to-end (loop, checkpoint)."""
+    out = tmp_path / "run"
+    r = run_main(out, extra=("--scan_blocks", "--bf16"))
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert (out / "checkpoints" / "checkpoint").is_dir()
+    assert "MAE(X, F(G(X)))" in r.stdout
+
+    # Resume restores the STACKED trunk layout (ScannedTrunk params +
+    # Adam mirrors), not just the unrolled one test_main_end_to_end_and
+    # _resume covers.
+    r2 = run_main(out, extra=("--scan_blocks", "--bf16"))
+    assert r2.returncode == 0, f"stdout:\n{r2.stdout}\nstderr:\n{r2.stderr}"
+    assert "Resumed" in r2.stdout
