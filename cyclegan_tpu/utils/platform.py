@@ -24,10 +24,12 @@ def ensure_platform_from_env() -> None:
         pass
 
 
-def enable_compilation_cache() -> None:
+def enable_compilation_cache(min_compile_secs: float = 5.0) -> None:
     """Persistent XLA compilation cache (JAX_COMPILATION_CACHE_DIR or
     ~/.cache/jax_comp_cache). Programs here compile in minutes on
-    remote-TPU transports; the cache makes restarts/resumes start hot."""
+    remote-TPU transports; the cache makes restarts/resumes start hot.
+    `min_compile_secs` sets the caching threshold — the test suite
+    lowers it to sweep up its many small CPU programs."""
     import jax
 
     try:
@@ -38,6 +40,14 @@ def enable_compilation_cache() -> None:
                 os.path.expanduser("~/.cache/jax_comp_cache"),
             ),
         )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(
+                os.environ.get(
+                    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                    min_compile_secs,
+                )
+            ),
+        )
     except Exception:
         pass

@@ -23,6 +23,22 @@ import jax  # noqa: E402
 # freezing JAX_PLATFORMS at its launch-time value — override post-import.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: XLA:CPU compiles dominate the suite's
+# ~25 min wall time; repeat runs with a warm cache cut per-program
+# compile ~5x (measured 11.8s -> 2.4s on the tiny train step). Tests
+# get their OWN cache dir (never the user's production cache), and the
+# env vars below propagate into the subprocess e2e tests so their
+# main.py runs cache at the same threshold. Set
+# CYCLEGAN_TEST_NO_COMP_CACHE=1 to bisect any suspected cache issue.
+if not os.environ.get("CYCLEGAN_TEST_NO_COMP_CACHE"):
+    from cyclegan_tpu.utils.platform import enable_compilation_cache
+
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = os.path.expanduser(
+        "~/.cache/jax_comp_cache_tests"
+    )
+    os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "1.0"
+    enable_compilation_cache()
+
 import pytest  # noqa: E402
 
 from cyclegan_tpu.config import tiny_test_config  # noqa: E402
