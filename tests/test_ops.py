@@ -66,3 +66,36 @@ def test_instance_norm_bfloat16_stats_in_fp32():
     assert y.dtype == jnp.bfloat16
     yf = np.asarray(y.astype(jnp.float32))
     assert abs(yf.mean()) < 0.05
+
+
+def test_instance_norm_custom_vjp_matches_autodiff():
+    """The 4-D path's hand-written VJP (norm.py instance_norm_backward,
+    written so bf16 activations are the only large residual) must equal
+    plain autodiff through the same f32 forward — for dx, dscale, dbias,
+    in both f32 and bf16."""
+    from cyclegan_tpu.ops.norm import _xla_forward
+
+    rng = np.random.RandomState(3)
+    x32 = rng.randn(2, 6, 6, 4).astype(np.float32)
+    scale = rng.randn(4).astype(np.float32)
+    bias = rng.randn(4).astype(np.float32)
+    g32 = rng.randn(2, 6, 6, 4).astype(np.float32)
+
+    for dtype, tol in ((jnp.float32, 1e-5), (jnp.bfloat16, 1e-2)):
+        x = jnp.asarray(x32, dtype)
+        g = jnp.asarray(g32, dtype)
+
+        def loss_custom(x, s, b):
+            return jnp.sum(instance_norm(x, s, b, impl="xla").astype(jnp.float32) * g.astype(jnp.float32))
+
+        def loss_ref(x, s, b):
+            return jnp.sum(_xla_forward(x, s, b, 1e-3)[0].astype(jnp.float32) * g.astype(jnp.float32))
+
+        got = jax.grad(loss_custom, argnums=(0, 1, 2))(x, jnp.asarray(scale), jnp.asarray(bias))
+        want = jax.grad(loss_ref, argnums=(0, 1, 2))(x, jnp.asarray(scale), jnp.asarray(bias))
+        for a, b_ in zip(got, want):
+            assert a.dtype == b_.dtype
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b_, np.float32),
+                rtol=tol, atol=tol,
+            )
