@@ -222,8 +222,8 @@ def _emit(results, done: bool) -> None:
     if _backend() != "tpu":
         note = (
             "Non-TPU backend (explicit CPU run, or tunnel unavailable at "
-            "bench time). On-chip measurements with methodology: "
-            "docs/BENCHMARKS.md (scan/bf16/b16 = 95.0 img/s on one v5e)."
+            "bench time) — not chip numbers. On-chip measurements with "
+            "methodology are logged in docs/BENCHMARKS.md."
         )
     if not results:
         line = {"metric": "cyclegan_256_train_images_per_sec_1chip",
