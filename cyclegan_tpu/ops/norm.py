@@ -9,9 +9,12 @@ Statistics are per-(N, C), so data-parallel batch sharding is
 semantics-free — no cross-replica moments, unlike batch norm. Statistics
 are always computed in float32 even under bfloat16 compute.
 
-Two implementations:
-- "xla": jnp reductions; XLA fuses mean/var/normalize into the surrounding
-  elementwise graph.
+Two implementations, both with the same hand-written VJP
+(instance_norm_backward — bf16 activations are the only large residual;
+measured on a v5e it took the 256² bf16 train step from 89 to 95 img/s
+and made the 512² batch-4 remat config fit 16G HBM):
+- "xla": jnp reductions; XLA fuses mean/var/normalize into the
+  surrounding elementwise graph.
 - "pallas": a fused single-pass Pallas TPU kernel (ops/pallas/norm_kernel.py)
   for the cases where XLA's fusion leaves the activation in HBM between the
   moment pass and the normalize pass.
