@@ -6,6 +6,11 @@ images/sec": public TF2-CycleGAN multi-GPU runs land around ~7.5
 images/sec/V100 at 256^2 with this exact 12-forward train step, so the
 2xV100 reference rig ~= 15 images/sec. `vs_baseline` = ours / 15.
 
+Because that baseline is an estimate, the emission also carries absolute
+accounting: analytic FLOPs for the fused train step
+(cyclegan_tpu/utils/flops.py), achieved TFLOP/s, and MFU against the
+chip's published bf16 peak — "fast" judged against hardware capability.
+
 Methodology notes:
 - Synchronization is via fetching a SCALAR metric that data-depends on
   the final step (not `block_until_ready`, which some remote-device
@@ -15,6 +20,14 @@ Methodology notes:
   one jitted `lax.scan` over K pre-staged batches — device-resident
   sustained throughput with zero host dispatch, the TPU-native ceiling a
   double-buffered input pipeline approaches.
+
+Tunnel-failure handling (the remote-TPU transport can wedge; observed in
+practice): the accelerator is probed in killable subprocesses in a RETRY
+LOOP across the bench window — a tunnel that recovers minutes in still
+gets measured on chip. On the FIRST failed probe a concurrent CPU-worker
+child starts measuring a shrunk workload, so if the chip never appears
+the bench still emits an honest platform="cpu" line without having
+serialized probing behind measuring.
 
 Prints ONE JSON line to stdout; per-config details go to stderr.
 """
@@ -26,31 +39,37 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
+import threading
 import time
 
-import threading
+# Leave headroom for the slow remote compiles: skip configs that would
+# start after the budget is spent, and emit the JSON line from a SIGTERM/
+# SIGALRM handler if the driver kills us mid-config.
+TIME_BUDGET_S = float(os.environ.get("BENCH_TIME_BUDGET_S", "480"))
+
+# Probe retry schedule: first attempt generous (healthy remote init can
+# take ~2 min cold), later ones shorter; keep probing until this much of
+# the budget remains so a late-recovering tunnel still fits one config.
+PROBE_TIMEOUTS_S = (150.0, 90.0)  # first, then the rest
+PROBE_RETRY_SLEEP_S = 15.0
+PROBE_WINDOW_S = max(0.0, TIME_BUDGET_S - 120.0)
+
+_WORKER_DONE_KEY = "__done__"
 
 
-def _probe_backend_or_fall_back_to_cpu(timeout_s: float = 150.0) -> None:
-    """Probe backend init in a SUBPROCESS before this process imports jax.
+def _probe_backend_once(timeout_s: float) -> str:
+    """Probe backend init in a SUBPROCESS; return backend name or "".
 
     A wedged remote-TPU tunnel hangs PJRT init indefinitely and
-    uninterruptibly (C-level; Python signal handlers never run), which
-    would turn the driver's bench run into a watchdog zero. A subprocess
-    probe CAN be timed out; if it hangs, fails, or reports that jax
-    itself silently fell back to CPU, pin this process to CPU so the
-    bench still measures something — honestly labeled platform="cpu" and
-    with a workload sized for host cores (see the config loop).
+    uninterruptibly (C-level; Python signal handlers never run). A
+    subprocess CAN be timed out and killed — killing a probe child at
+    init time is safe where killing a client mid-compile is not.
 
     The child reports its backend via a temp file and runs with DEVNULL
     pipes in its own session: plugin helper processes inheriting a pipe
-    could otherwise block us past the timeout, and this runs before any
-    kill-safe emitter is armed.
+    could otherwise block us past the timeout.
     """
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return  # explicitly CPU already
-    import tempfile
-
     fd, path = tempfile.mkstemp(prefix="bench_probe_")
     os.close(fd)
     code = (
@@ -73,35 +92,54 @@ def _probe_backend_or_fall_back_to_cpu(timeout_s: float = 150.0) -> None:
         proc.wait()
     try:
         with open(path) as f:
-            backend = f.read().strip()
+            return f.read().strip()
     except OSError:
-        backend = ""
+        return ""
     finally:
         try:
             os.unlink(path)
         except OSError:
             pass
-    if backend and backend != "cpu":
-        return  # healthy accelerator
-    reason = (
-        f"probe did not finish in {timeout_s:.0f}s or failed"
-        if not backend
-        else "jax itself fell back to cpu"
-    )
-    print(
-        f"[bench] accelerator backend unavailable ({reason}); running on "
-        "CPU — numbers are NOT chip numbers",
-        file=sys.stderr,
-        flush=True,
-    )
-    os.environ["JAX_PLATFORMS"] = "cpu"
 
 
-# Probe ONLY when executed as the benchmark: importing this module (the
-# test suite does) must not spawn backend-init subprocesses or mutate
-# JAX_PLATFORMS. Runs before `import jax` below by module execution order.
-if __name__ == "__main__":
-    _probe_backend_or_fall_back_to_cpu()
+def _spawn_cpu_worker(results_path: str) -> subprocess.Popen:
+    """Start this script as a CPU-pinned measurement child.
+
+    It writes incremental per-config results to `results_path` (atomic
+    replace after each config), so the coordinator's emitters always see
+    the latest completed work even if the worker is still running — or
+    gets killed because the tunnel recovered.
+    """
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_ROLE"] = "cpu-worker"
+    env["BENCH_RESULTS_FILE"] = results_path
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.DEVNULL,
+        stderr=sys.stderr,
+        env=env,
+        start_new_session=True,
+    )
+
+
+def _kill_cpu_worker(proc: subprocess.Popen) -> None:
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)  # CPU-only child: safe to kill
+    except ProcessLookupError:
+        pass
+    proc.wait()
+
+
+def _read_worker_results(path: str | None) -> dict:
+    if not path:
+        return {}
+    try:
+        with open(path) as f:
+            return json.loads(f.read() or "{}")
+    except (OSError, ValueError):
+        return {}
+
 
 import jax
 import jax.numpy as jnp
@@ -113,7 +151,8 @@ from cyclegan_tpu.utils.platform import (
 )
 
 # The axon sitecustomize overrides JAX_PLATFORMS at interpreter start;
-# re-assert whatever the probe decided (no-op when the env var is unset).
+# re-assert the env var's choice (no-op when the env var is unset, which
+# is the coordinator's accelerator path).
 ensure_platform_from_env()
 
 # Persistent compilation cache: compiles of the bench programs can take
@@ -121,10 +160,11 @@ ensure_platform_from_env()
 # runs — including the driver's — start hot.
 enable_compilation_cache()
 
-# Leave headroom for the slow remote compiles: skip configs that would
-# start after the budget is spent, and emit the JSON line from a SIGTERM/
-# SIGALRM handler if the driver kills us mid-config.
-TIME_BUDGET_S = float(os.environ.get("BENCH_TIME_BUDGET_S", "480"))
+
+def _default_config():
+    from cyclegan_tpu.config import Config, ModelConfig, TrainConfig
+
+    return Config(model=ModelConfig(), train=TrainConfig())
 
 
 def _build(compute_dtype: str, batch: int, image: int, norm_impl: str):
@@ -140,8 +180,9 @@ def _build(compute_dtype: str, batch: int, image: int, norm_impl: str):
         train=TrainConfig(batch_size=batch),
     )
     state = create_state(cfg, jax.random.PRNGKey(0))
-    global _PLATFORM
+    global _PLATFORM, _DEVICE_KIND
     _PLATFORM = jax.default_backend()  # backend is up once state exists
+    _DEVICE_KIND = jax.devices()[0].device_kind
     step = make_train_step(cfg, batch)
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.rand(batch, image, image, 3).astype(np.float32) * 2 - 1)
@@ -207,19 +248,65 @@ def bench_scan(compute_dtype: str, batch: int, image: int = 256,
 # jax.default_backend() itself — against a dead TPU transport that call
 # blocks indefinitely, which would wedge the watchdog/signal emitters.
 _PLATFORM = "unknown (backend never initialized)"
+_DEVICE_KIND = ""
+
+# Set by the coordinator when it has a CPU worker running; _emit merges
+# the worker's incremental results (in-process results win on key clash).
+_WORKER_RESULTS_PATH: str | None = None
 
 
 def _backend() -> str:
     return _PLATFORM
 
 
+def _flops_accounting(best_ips: float, platform: str) -> dict:
+    """Analytic step FLOPs -> achieved TFLOP/s (+ MFU when the chip's
+    peak is known). Pure host math — safe in signal/watchdog emitters."""
+    try:
+        from cyclegan_tpu.utils.flops import (
+            peak_tflops_for_device_kind,
+            train_step_flops_per_image,
+        )
+
+        flops_img = train_step_flops_per_image(_default_config())
+    except Exception:  # accounting must never break the emission contract
+        return {}
+    out = {
+        "flops_per_image": int(flops_img),
+        "tflops_per_sec": round(best_ips * flops_img / 1e12, 2),
+    }
+    try:
+        peak = float(os.environ["BENCH_PEAK_TFLOPS"])
+    except (KeyError, ValueError):  # unset or malformed override
+        peak = peak_tflops_for_device_kind(_DEVICE_KIND) if _DEVICE_KIND else None
+    if _DEVICE_KIND:
+        out["device_kind"] = _DEVICE_KIND
+    if peak and platform == "tpu":
+        out["peak_tflops_bf16"] = peak
+        out["mfu"] = round(out["tflops_per_sec"] / peak, 4)
+    return out
+
+
 def _emit(results, done: bool) -> None:
     results = dict(results)  # snapshot: emitters race the config loop
+    worker = _read_worker_results(_WORKER_RESULTS_PATH)
+    worker.pop(_WORKER_DONE_KEY, None)
+    platform = _backend()
+    # Worker (CPU) numbers are a FALLBACK, never mixed into a chip line:
+    # with in-process TPU results present they are ignored; with none,
+    # they are the emission and the platform says cpu even if a _build
+    # got far enough to record tpu before the tunnel re-wedged.
+    if not results and worker:
+        results = worker
+        platform = "cpu"
+    elif results and platform != "tpu":
+        for k, v in worker.items():
+            results.setdefault(k, v)
     # When the chip was unreachable (wedged tunnel -> CPU fallback), say
     # where the real numbers live so a fallback line can't be mistaken
     # for a perf regression.
     note = None
-    if _backend() != "tpu":
+    if platform != "tpu":
         note = (
             "Non-TPU backend (explicit CPU run, or tunnel unavailable at "
             "bench time) — not chip numbers. On-chip measurements with "
@@ -229,7 +316,7 @@ def _emit(results, done: bool) -> None:
         line = {"metric": "cyclegan_256_train_images_per_sec_1chip",
                 "value": 0.0, "unit": "images/sec",
                 "vs_baseline": 0.0, "error": "no config completed",
-                "platform": _backend()}
+                "platform": platform}
         if note:
             line["note"] = note
         print(json.dumps(line), flush=True)
@@ -244,9 +331,10 @@ def _emit(results, done: bool) -> None:
         "config": best_key,
         # Honest labeling: if the TPU backend was unavailable and JAX fell
         # back to CPU, the numbers must not read as chip numbers.
-        "platform": _backend(),
+        "platform": platform,
         "all": {k: round(v, 2) for k, v in results.items()},
     }
+    line.update(_flops_accounting(best, platform))
     if note:
         line["note"] = note
     if not done:
@@ -254,8 +342,89 @@ def _emit(results, done: bool) -> None:
     print(json.dumps(line), flush=True)
 
 
+def _run_configs(results: dict, configs, t_start: float, on_result=None,
+                 tag: str = "bench") -> None:
+    """Run the config list, accumulating into `results` (shared with the
+    emitters). Budget check uses time since process start so a late TPU
+    recovery runs the headline config and skips the rest. `on_result` is
+    called after each config lands (the CPU worker flushes its file)."""
+    for mode, dtype, batch in configs:
+        key = f"{mode}/{dtype}/b{batch}"
+        spent = time.perf_counter() - t_start
+        if results and spent > TIME_BUDGET_S:
+            print(f"[{tag}] {key}: skipped (budget {TIME_BUDGET_S:.0f}s spent)",
+                  file=sys.stderr, flush=True)
+            continue
+        try:
+            # CPU (explicit run, worker child, or jax fell back): a 256^2
+            # step takes minutes on host cores — shrink the work so at
+            # least one honest measurement lands inside the budget.
+            on_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+            if mode == "steps":
+                # on_cpu: 2 total steps (~100s each at 256^2) — the CPU
+                # fallback is a liveness signal, not a precision number,
+                # and it must land inside the worker's wait window even
+                # on a loaded host.
+                ips = bench_steps(
+                    dtype, batch, warmup=1 if on_cpu else 2,
+                    iters=1 if on_cpu else 10,
+                )
+            else:
+                ips = bench_scan(
+                    dtype, batch, warmup=1,
+                    iters=1 if on_cpu else 3, k=2 if on_cpu else 8,
+                )
+            results[key] = ips
+            if on_result is not None:
+                on_result()
+            print(f"[{tag}] {key}: {ips:.2f} images/sec", file=sys.stderr, flush=True)
+        except Exception as e:
+            print(f"[{tag}] {key}: FAILED {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
+
+
+# Two configs only: each compile through a remote-TPU tunnel can take
+# minutes, and the driver's bench window is bounded. On TPU the headline
+# config (device-resident sustained, MXU dtype; b16 measured best on the
+# chip — 95.0 img/s with the custom-VJP instance norm, vs 83 @ b8, 79 @
+# b32, 71 @ b20, 86 @ b24) runs FIRST so a late-recovering tunnel lands
+# the number that matters before the budget runs out.
+TPU_CONFIGS = [
+    ("scan", "bfloat16", 16),
+    ("steps", "float32", 1),  # reference default: per-replica batch 1
+]
+# On CPU the cheap per-step config leads: the scan config's 16-image
+# batches take far too long on host cores to land first.
+CPU_CONFIGS = [
+    ("steps", "float32", 1),
+    ("scan", "bfloat16", 16),
+]
+
+
+def _cpu_worker_main() -> None:
+    """Measurement child: CPU-pinned (JAX_PLATFORMS=cpu set by the
+    coordinator, so _run_configs' shrunk-workload branch fires), writing
+    incremental results after each config."""
+    path = os.environ["BENCH_RESULTS_FILE"]
+    # Self-destruct if orphaned (coordinator SIGKILLed): nothing reaps us.
+    signal.alarm(int(TIME_BUDGET_S) + 300)
+    results: dict = {}
+
+    def flush_results() -> None:
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".")
+        with os.fdopen(fd, "w") as f:
+            f.write(json.dumps(results))
+        os.replace(tmp, path)  # atomic: coordinator may read any time
+
+    _run_configs(results, CPU_CONFIGS, time.perf_counter(),
+                 on_result=flush_results, tag="bench cpu-worker")
+    results[_WORKER_DONE_KEY] = True
+    flush_results()
+
+
 def main():
-    results = {}
+    global _PLATFORM, _WORKER_RESULTS_PATH
+    results: dict = {}
     t_start = time.perf_counter()
 
     # Exactly-one-emit: every emitter (signal handler, watchdog thread,
@@ -296,49 +465,88 @@ def main():
 
     threading.Thread(target=watchdog, daemon=True).start()
 
-    # Two configs only: each compile through a remote-TPU tunnel can take
-    # minutes, and the driver's bench window is bounded.
-    configs = [
-        # (mode, dtype, batch)
-        ("steps", "float32", 1),   # reference default: per-replica batch 1
-        # Device-resident sustained, MXU dtype. b16 measured best on the
-        # chip (95.0 img/s with the custom-VJP instance norm, vs 83 @ b8,
-        # 79 @ b32, 71 @ b20, 86 @ b24).
-        ("scan", "bfloat16", 16),
-    ]
-    for mode, dtype, batch in configs:
-        key = f"{mode}/{dtype}/b{batch}"
-        spent = time.perf_counter() - t_start
-        if results and spent > TIME_BUDGET_S:
-            print(f"[bench] {key}: skipped (budget {TIME_BUDGET_S:.0f}s spent)",
+    # done=False only when the emission depends on a worker that never
+    # finished; a completed in-process config loop (skips included) is
+    # "done" — the historical contract.
+    done = True
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # Explicitly CPU (tests, dev boxes): measure in-process, no
+        # probes, no children — same contract as ever.
+        _run_configs(results, CPU_CONFIGS, t_start)
+    else:
+        # Accelerator path: retrying probe. The tunnel has been observed
+        # to wedge AND to recover; one probe at t=0 forfeits every
+        # recovery after it, so keep probing across the window. A CPU
+        # worker starts measuring concurrently on the FIRST failure so
+        # the fallback isn't serialized behind the probing.
+        cpu_worker = None
+        backend = ""
+        attempt = 0
+        while True:
+            timeout = PROBE_TIMEOUTS_S[min(attempt, len(PROBE_TIMEOUTS_S) - 1)]
+            attempt += 1
+            backend = _probe_backend_once(timeout)
+            if backend and backend != "cpu":
+                break  # healthy accelerator
+            why = "hung/failed" if not backend else "jax fell back to cpu"
+            print(f"[bench] probe {attempt} ({timeout:.0f}s): {why}",
                   file=sys.stderr, flush=True)
-            continue
-        try:
-            # CPU fallback (tunnel down) or explicit CPU: a 256^2 step
-            # takes minutes on host cores — shrink the work so at least
-            # one honest measurement lands inside the budget.
-            on_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
-            if mode == "steps":
-                ips = bench_steps(
-                    dtype, batch, warmup=1 if on_cpu else 2,
-                    iters=2 if on_cpu else 10,
-                )
-            else:
-                ips = bench_scan(
-                    dtype, batch, warmup=1,
-                    iters=1 if on_cpu else 3, k=2 if on_cpu else 8,
-                )
-            results[key] = ips
-            print(f"[bench] {key}: {ips:.2f} images/sec", file=sys.stderr, flush=True)
-        except Exception as e:
-            print(f"[bench] {key}: FAILED {type(e).__name__}: {e}",
+            if cpu_worker is None:
+                fd, path = tempfile.mkstemp(prefix="bench_cpu_results_")
+                os.close(fd)
+                _WORKER_RESULTS_PATH = path
+                cpu_worker = _spawn_cpu_worker(path)
+                print("[bench] started concurrent CPU fallback worker",
+                      file=sys.stderr, flush=True)
+            if time.perf_counter() - t_start > PROBE_WINDOW_S:
+                backend = ""
+                break
+            time.sleep(PROBE_RETRY_SLEEP_S)
+
+        if backend and backend != "cpu":
+            if cpu_worker is not None:
+                _kill_cpu_worker(cpu_worker)
+                print(f"[bench] probe {attempt}: tunnel recovered — "
+                      "measuring on chip", file=sys.stderr, flush=True)
+            # The worker's partial results stay on disk as a FALLBACK:
+            # _emit uses them only if no chip config completes (tunnel
+            # re-wedging mid-compile is the observed failure mode), and
+            # labels that emission cpu.
+            _run_configs(results, TPU_CONFIGS, t_start)
+        else:
+            print("[bench] accelerator unavailable for the whole probe "
+                  "window; using CPU worker results — NOT chip numbers",
                   file=sys.stderr, flush=True)
+            _PLATFORM = "cpu"
+            # Wait for the worker, stopping comfortably BEFORE the
+            # SIGALRM armed above (budget+240) — the orderly final emit
+            # below must win that race, not the partial-emitting handler.
+            deadline = t_start + TIME_BUDGET_S + 210
+            while time.perf_counter() < deadline:
+                if cpu_worker.poll() is not None:
+                    break
+                if _read_worker_results(_WORKER_RESULTS_PATH).get(_WORKER_DONE_KEY):
+                    break
+                time.sleep(5.0)
+            _kill_cpu_worker(cpu_worker)  # no-op if it already exited
+            done = bool(
+                _read_worker_results(_WORKER_RESULTS_PATH).get(_WORKER_DONE_KEY)
+            )
+
     # Disarm signals BEFORE taking the emit lock: a handler firing while
     # the main thread holds the (non-reentrant) lock would deadlock.
     signal.signal(signal.SIGTERM, signal.SIG_IGN)
     signal.signal(signal.SIGALRM, signal.SIG_IGN)
-    emit_once(done=True)
+    emit_once(done=done)
+    if _WORKER_RESULTS_PATH:
+        try:
+            os.unlink(_WORKER_RESULTS_PATH)
+        except OSError:
+            pass
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_ROLE") == "cpu-worker":
+        _cpu_worker_main()
+    else:
+        main()

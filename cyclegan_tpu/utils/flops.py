@@ -1,0 +1,159 @@
+"""Analytic FLOPs accounting for the CycleGAN train step.
+
+Counts convolution multiply-accumulates (the >99% term; norms,
+activations, and padding are bandwidth-, not FLOP-, bound) walking the
+exact architectures in models/generator.py and models/discriminator.py
+(reference: /root/reference/cyclegan/model.py:129-213). Used by bench.py
+to report TFLOP/s and MFU against the chip's peak so "fast" is judged
+against hardware capability rather than an estimated baseline rig.
+
+Backward-pass weighting (per apply site in train/steps.py):
+
+- The 6 generator applies and the 4 discriminator applies with LIVE
+  params cost forward + full backward ~= 3x forward (the standard 2x
+  backward: activation-gradient chain + weight gradients).
+- The 2 discriminator applies with STOPPED params (adversarial terms,
+  steps.py:77-78) need only the activation-gradient chain back to the
+  fake images ~= 2x forward total.
+
+Stopped *inputs* (e.g. gen.apply on stop(fake_x), steps.py:84-85) save
+only the first layer's input gradient — negligible, counted as full.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from cyclegan_tpu.config import Config
+
+# Conv layer spec: (out_h, out_w, c_in, c_out, k_h, k_w). MACs = product.
+_Layer = Tuple[int, int, int, int, int, int]
+
+
+def _conv_macs(layers: List[_Layer]) -> int:
+    return sum(h * w * ci * co * kh * kw for h, w, ci, co, kh, kw in layers)
+
+
+def generator_layers(
+    image_size: int,
+    filters: int = 64,
+    num_residual_blocks: int = 9,
+    num_downsampling_blocks: int = 2,
+    num_upsample_blocks: int = 2,
+    in_channels: int = 3,
+    out_channels: int = 3,
+) -> List[_Layer]:
+    """Conv shapes of ResNetGenerator (models/generator.py:57-134)."""
+    s = image_size
+    f = filters
+    layers: List[_Layer] = [(s, s, in_channels, f, 7, 7)]  # c7s1, reflect+valid
+    for _ in range(num_downsampling_blocks):  # Conv3x3 s2 SAME
+        s //= 2
+        layers.append((s, s, f, 2 * f, 3, 3))
+        f *= 2
+    for _ in range(num_residual_blocks):  # two Conv3x3 (reflect+valid)
+        layers.append((s, s, f, f, 3, 3))
+        layers.append((s, s, f, f, 3, 3))
+    for _ in range(num_upsample_blocks):
+        # ConvTranspose 3x3 s2: each INPUT pixel multiplies the full
+        # kernel, so MACs = in_h*in_w*c_in*c_out*k*k; record via output
+        # dims scaled back (out = 2*in).
+        layers.append((s, s, f, f // 2, 3, 3))
+        s *= 2
+        f //= 2
+    layers.append((s, s, f, out_channels, 7, 7))
+    return layers
+
+
+def discriminator_layers(
+    image_size: int,
+    filters: int = 64,
+    num_downsampling: int = 3,
+    in_channels: int = 3,
+) -> List[_Layer]:
+    """Conv shapes of PatchGANDiscriminator (models/discriminator.py:30-74)."""
+    s = image_size // 2  # stem: Conv4x4 s2 SAME
+    f = filters
+    layers: List[_Layer] = [(s, s, in_channels, f, 4, 4)]
+    for i in range(num_downsampling):  # s2, s2, then s1
+        if i < 2:
+            s //= 2
+        layers.append((s, s, f, 2 * f, 4, 4))
+        f *= 2
+    layers.append((s, s, f, 1, 4, 4))  # patch logits head
+    return layers
+
+
+def generator_fwd_flops(config: Config) -> int:
+    """Forward FLOPs (2*MACs) for one generator apply on one image."""
+    g = config.model.generator
+    return 2 * _conv_macs(
+        generator_layers(
+            config.model.image_size,
+            filters=g.filters,
+            num_residual_blocks=g.num_residual_blocks,
+            num_downsampling_blocks=g.num_downsampling_blocks,
+            num_upsample_blocks=g.num_upsample_blocks,
+        )
+    )
+
+
+def discriminator_fwd_flops(config: Config) -> int:
+    """Forward FLOPs (2*MACs) for one discriminator apply on one image."""
+    d = config.model.discriminator
+    return 2 * _conv_macs(
+        discriminator_layers(
+            config.model.image_size,
+            filters=d.filters,
+            num_downsampling=d.num_downsampling,
+        )
+    )
+
+
+def train_step_flops_per_pair(config: Config) -> int:
+    """FLOPs of one fused train step per (x, y) example pair.
+
+    Apply sites (train/steps.py:71-102): 6 generator applies with live
+    params (x3), 4 discriminator applies with live params (x3), and 2
+    discriminator applies with stopped params (x2 — activation-gradient
+    chain only). The optimizer update is O(params), negligible next to
+    O(params * spatial).
+    """
+    g = generator_fwd_flops(config)
+    d = discriminator_fwd_flops(config)
+    return 6 * 3 * g + 4 * 3 * d + 2 * 2 * d
+
+
+def train_step_flops_per_image(config: Config) -> float:
+    """FLOPs per *counted* image: throughput counts both domains' images
+    (2 per pair per step), so per-image cost is half the pair cost."""
+    return train_step_flops_per_pair(config) / 2.0
+
+
+# Dense peak TFLOP/s by TPU generation (bf16 MXU peak per chip; public
+# figures from cloud.google.com/tpu/docs/system-architecture). Keyed by
+# substrings of jax.Device.device_kind.
+PEAK_TFLOPS_BY_KIND = {
+    "v6": 918.0,  # Trillium
+    "v5p": 459.0,
+    "v5e": 197.0,
+    "v5 lite": 197.0,
+    "v5lite": 197.0,
+    "v4": 275.0,
+    "v3": 123.0,
+    "v2": 45.0,
+}
+
+
+def peak_tflops_for_device_kind(device_kind: str) -> float | None:
+    """Best-effort bf16 peak for a jax device_kind string; None if unknown.
+
+    Override with BENCH_PEAK_TFLOPS (bench.py) for new chips. For float32
+    configs this is an optimistic denominator (f32 convs run the MXU via
+    multi-pass emulation), so reported MFU is conservative there.
+    """
+    kind = device_kind.lower()
+    for key, peak in PEAK_TFLOPS_BY_KIND.items():
+        if key in kind:
+            return peak
+    return None

@@ -36,3 +36,75 @@ def test_emit_best_and_partial(capsys):
 def test_emit_done_has_no_partial_flag(capsys):
     bench._emit({"k": 1.0}, done=True)
     assert "partial" not in _last_json(capsys)
+
+
+def test_emit_includes_flops_accounting(capsys):
+    bench._emit({"scan/bfloat16/b16": 95.0}, done=True)
+    d = _last_json(capsys)
+    # Analytic accounting rides along; MFU only when on a known TPU.
+    assert d["flops_per_image"] > 9e11
+    assert abs(d["tflops_per_sec"] - 95.0 * d["flops_per_image"] / 1e12) < 0.01
+    assert "mfu" not in d  # platform is not tpu in tests
+
+
+def test_emit_merges_cpu_worker_results(tmp_path, capsys, monkeypatch):
+    """On a non-TPU platform the emitters fold in the concurrent CPU
+    worker's incremental results file; in-process results win on clash."""
+    path = tmp_path / "worker.json"
+    path.write_text(json.dumps(
+        {"steps/float32/b1": 0.02, "scan/bfloat16/b16": 7.0,
+         bench._WORKER_DONE_KEY: True}
+    ))
+    monkeypatch.setattr(bench, "_WORKER_RESULTS_PATH", str(path))
+    bench._emit({"scan/bfloat16/b16": 95.0}, done=True)
+    d = _last_json(capsys)
+    assert d["value"] == 95.0  # in-process beats worker on the clash
+    assert d["all"]["steps/float32/b1"] == 0.02
+    assert bench._WORKER_DONE_KEY not in d["all"]
+
+
+def test_emit_never_mixes_cpu_worker_into_tpu_line(tmp_path, capsys, monkeypatch):
+    """Chip emissions must be pure chip data: worker (CPU) numbers are
+    dropped, not presented under platform=tpu."""
+    path = tmp_path / "worker.json"
+    path.write_text(json.dumps({"steps/float32/b1": 0.02}))
+    monkeypatch.setattr(bench, "_WORKER_RESULTS_PATH", str(path))
+    monkeypatch.setattr(bench, "_PLATFORM", "tpu")
+    bench._emit({"scan/bfloat16/b16": 95.0}, done=False)
+    d = _last_json(capsys)
+    assert d["platform"] == "tpu"
+    assert "steps/float32/b1" not in d["all"]
+    assert "note" not in d
+
+
+def test_emit_pure_worker_fallback_relabels_platform_cpu(tmp_path, capsys, monkeypatch):
+    """If the tunnel re-wedged before any chip config completed, the
+    worker's numbers carry the line — labeled cpu even though a _build
+    had already recorded tpu."""
+    path = tmp_path / "worker.json"
+    path.write_text(json.dumps({"steps/float32/b1": 0.02}))
+    monkeypatch.setattr(bench, "_WORKER_RESULTS_PATH", str(path))
+    monkeypatch.setattr(bench, "_PLATFORM", "tpu")
+    bench._emit({}, done=False)
+    d = _last_json(capsys)
+    assert d["platform"] == "cpu"
+    assert d["value"] == 0.02
+    assert "mfu" not in d and "note" in d
+
+
+def test_emit_survives_malformed_peak_override(capsys, monkeypatch):
+    """BENCH_PEAK_TFLOPS garbage must not break the emission contract:
+    a raise inside _emit would permanently disarm every later emitter."""
+    monkeypatch.setenv("BENCH_PEAK_TFLOPS", "459tflops")
+    bench._emit({"scan/bfloat16/b16": 95.0}, done=True)
+    d = _last_json(capsys)
+    assert d["value"] == 95.0
+    assert "mfu" not in d
+
+
+def test_read_worker_results_tolerates_missing_and_garbage(tmp_path):
+    assert bench._read_worker_results(None) == {}
+    assert bench._read_worker_results(str(tmp_path / "nope.json")) == {}
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert bench._read_worker_results(str(bad)) == {}

@@ -1,0 +1,86 @@
+"""Analytic FLOPs accounting (utils/flops.py).
+
+The layer walk is validated structurally: the conv kernel shapes it
+produces must reproduce the REAL models' conv parameter counts exactly
+(params are the (ci, co, kh, kw) part of each layer tuple), so any drift
+between the walk and models/{generator,discriminator}.py fails here.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cyclegan_tpu.config import Config, GeneratorConfig, ModelConfig
+from cyclegan_tpu.models.discriminator import PatchGANDiscriminator
+from cyclegan_tpu.models.generator import ResNetGenerator
+from cyclegan_tpu.utils import flops as F
+
+
+def _conv_param_count(params) -> int:
+    """Count conv kernel elements only (the walk does not model IN
+    scale/bias or conv biases)."""
+    total = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        if any(getattr(p, "key", None) == "kernel" for p in path):
+            total += leaf.size
+    return total
+
+
+def test_generator_layer_walk_matches_real_params():
+    model = ResNetGenerator()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    walked = sum(
+        ci * co * kh * kw for _, _, ci, co, kh, kw in F.generator_layers(64)
+    )
+    assert walked == _conv_param_count(params)
+
+
+def test_discriminator_layer_walk_matches_real_params():
+    model = PatchGANDiscriminator()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    walked = sum(
+        ci * co * kh * kw for _, _, ci, co, kh, kw in F.discriminator_layers(64)
+    )
+    assert walked == _conv_param_count(params)
+
+
+def test_nondefault_architecture_walk_matches_real_params():
+    cfg = GeneratorConfig(filters=16, num_residual_blocks=3)
+    model = ResNetGenerator(config=cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    walked = sum(
+        ci * co * kh * kw
+        for _, _, ci, co, kh, kw in F.generator_layers(
+            32, filters=16, num_residual_blocks=3
+        )
+    )
+    assert walked == _conv_param_count(params)
+
+
+def test_step_flops_magnitude():
+    cfg = Config()
+    g = F.generator_fwd_flops(cfg)
+    d = F.discriminator_fwd_flops(cfg)
+    # Known magnitudes for the 256^2 default architecture.
+    assert 90e9 < g < 110e9
+    assert 5e9 < d < 8e9
+    pair = F.train_step_flops_per_pair(cfg)
+    assert pair == 18 * g + 16 * d
+    assert F.train_step_flops_per_image(cfg) == pair / 2.0
+
+
+def test_flops_scale_quadratically_with_image_size():
+    small = Config(model=ModelConfig(image_size=128))
+    big = Config(model=ModelConfig(image_size=256))
+    ratio = F.train_step_flops_per_pair(big) / F.train_step_flops_per_pair(small)
+    assert abs(ratio - 4.0) < 0.1
+
+
+def test_peak_lookup():
+    assert F.peak_tflops_for_device_kind("TPU v5 lite") == 197.0
+    assert F.peak_tflops_for_device_kind("TPU v4") == 275.0
+    assert F.peak_tflops_for_device_kind("weird accelerator") is None
