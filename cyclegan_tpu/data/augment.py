@@ -21,6 +21,14 @@ def normalize_image(img: np.ndarray) -> np.ndarray:
     return img.astype(np.float32) / 127.5 - 1.0
 
 
+def quantize_uint8(img: np.ndarray) -> np.ndarray:
+    """float32 [0,255] -> uint8, round-half-even (matches the native
+    path's std::nearbyint). The caches store this 4x-smaller format and
+    normalize on batch assembly; quantization error is <= 0.5/127.5 in
+    [-1, 1] terms, below the source images' own 8-bit grain."""
+    return np.rint(np.clip(img, 0, 255)).astype(np.uint8)
+
+
 def resize_bilinear(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
     """Bilinear resize with half-pixel centers (TF2 tf.image.resize
     default). img: [H, W, C] float32 -> [out_h, out_w, C] float32."""
@@ -61,28 +69,35 @@ def preprocess_train(
     resize_size: int = 286,
     crop_size: int = 256,
     use_native: bool | None = None,
+    normalize: bool = True,
 ) -> np.ndarray:
     """Random flip -> resize -> random crop -> normalize (main.py:40-45).
 
     Dispatches to the fused C++ kernel (data/native.py) when built,
-    falling back to the identical-algorithm numpy path.
+    falling back to the identical-algorithm numpy path. normalize=False
+    returns uint8 (cache format, see quantize_uint8).
     """
     flip, oy, ox = draw_augment_params(rng, resize_size, crop_size)
     if use_native is None or use_native:
         from cyclegan_tpu.data import native
 
         if native.available():
-            return native.preprocess_one(img, resize_size, flip, oy, ox, crop_size)
+            return native.preprocess_one(
+                img, resize_size, flip, oy, ox, crop_size, normalize=normalize
+            )
         if use_native:
             raise RuntimeError("native preprocessing requested but unavailable")
     if flip:
         img = img[:, ::-1]
     img = resize_bilinear(img.astype(np.float32), resize_size, resize_size)
     img = img[oy : oy + crop_size, ox : ox + crop_size]
-    return normalize_image(img)
+    return normalize_image(img) if normalize else quantize_uint8(img)
 
 
-def preprocess_test(img: np.ndarray, crop_size: int = 256) -> np.ndarray:
-    """Resize -> normalize (main.py:47-50)."""
+def preprocess_test(
+    img: np.ndarray, crop_size: int = 256, normalize: bool = True
+) -> np.ndarray:
+    """Resize -> normalize (main.py:47-50). normalize=False returns the
+    uint8 cache format (see quantize_uint8)."""
     img = resize_bilinear(img.astype(np.float32), crop_size, crop_size)
-    return normalize_image(img)
+    return normalize_image(img) if normalize else quantize_uint8(img)

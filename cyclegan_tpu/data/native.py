@@ -68,9 +68,27 @@ def load() -> Optional[ctypes.CDLL]:
         if not os.path.exists(_SO) or stale:
             if not have_src or not _build():
                 return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
+        lib = _try_load_checked()
+        if lib is None and have_src:
+            # ABI mismatch from a stale artifact the mtime check missed
+            # (restored build caches, packaged prebuilts): rebuild once.
+            if _build():
+                lib = _try_load_checked()
+        _lib = lib
+        return _lib
+
+
+# The C ABI revision this binding requires (cgdata.cpp cg_version).
+_ABI_VERSION = 2
+
+
+def _try_load_checked() -> Optional[ctypes.CDLL]:
+    """CDLL + symbol binding + ABI check; None on any mismatch so the
+    numpy fallback engages instead of raising mid-pipeline."""
+    try:
+        lib = ctypes.CDLL(_SO)
+        lib.cg_version.restype = ctypes.c_int
+        if int(lib.cg_version()) != _ABI_VERSION:
             return None
         u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
         i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
@@ -83,9 +101,18 @@ def load() -> Optional[ctypes.CDLL]:
             u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, i32p, i32p, i32p, ctypes.c_int, f32p, ctypes.c_int,
         ]
-        lib.cg_version.restype = ctypes.c_int
-        _lib = lib
-        return _lib
+        lib.cg_preprocess_u8.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p,
+        ]
+        lib.cg_preprocess_batch_u8.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, i32p, i32p, i32p, ctypes.c_int, u8p, ctypes.c_int,
+        ]
+        return lib
+    except (OSError, AttributeError):
+        # unloadable artifact, or symbols from an older ABI missing
+        return None
 
 
 def available() -> bool:
@@ -93,14 +120,24 @@ def available() -> bool:
 
 
 def preprocess_one(
-    img: np.ndarray, resize: int, flip: bool, oy: int, ox: int, crop: int
+    img: np.ndarray, resize: int, flip: bool, oy: int, ox: int, crop: int,
+    normalize: bool = True,
 ) -> np.ndarray:
-    """Fused flip->resize->crop->normalize of one uint8 [H, W, 3] image."""
+    """Fused flip->resize->crop of one uint8 [H, W, 3] image.
+
+    normalize=True: float32 in [-1, 1] (feeds the device directly).
+    normalize=False: uint8 (the 4x-smaller cache format; the pipeline
+    normalizes on batch assembly)."""
     lib = load()
     assert lib is not None, "native library unavailable"
     img = np.ascontiguousarray(img, np.uint8)
-    out = np.empty((crop, crop, 3), np.float32)
-    lib.cg_preprocess(
+    if normalize:
+        out = np.empty((crop, crop, 3), np.float32)
+        fn = lib.cg_preprocess
+    else:
+        out = np.empty((crop, crop, 3), np.uint8)
+        fn = lib.cg_preprocess_u8
+    fn(
         img, img.shape[0], img.shape[1], resize, resize,
         int(flip), int(oy), int(ox), crop, out,
     )
@@ -115,14 +152,21 @@ def preprocess_batch(
     oxs: np.ndarray,
     crop: int,
     n_threads: int = 0,
+    normalize: bool = True,
 ) -> np.ndarray:
-    """Threaded fused preprocess of a same-sized uint8 batch [N, H, W, 3]."""
+    """Threaded fused preprocess of a same-sized uint8 batch [N, H, W, 3].
+    See preprocess_one for the `normalize` output-format switch."""
     lib = load()
     assert lib is not None, "native library unavailable"
     imgs = np.ascontiguousarray(imgs, np.uint8)
     n, h, w, _ = imgs.shape
-    out = np.empty((n, crop, crop, 3), np.float32)
-    lib.cg_preprocess_batch(
+    if normalize:
+        out = np.empty((n, crop, crop, 3), np.float32)
+        fn = lib.cg_preprocess_batch
+    else:
+        out = np.empty((n, crop, crop, 3), np.uint8)
+        fn = lib.cg_preprocess_batch_u8
+    fn(
         imgs, n, h, w, resize, resize,
         np.ascontiguousarray(flips, np.int32),
         np.ascontiguousarray(oys, np.int32),

@@ -21,6 +21,12 @@ TPU-first differences:
   story, SURVEY.md §2.4), indices deterministic so hosts never disagree.
 - Background-thread prefetch overlaps host preprocessing with device
   steps (the AUTOTUNE prefetch analog, main.py:72).
+- Bounded memory: caches hold post-augment UINT8 (4x smaller than the
+  reference's float32 tf.data cache; quantization error <= 0.5/127.5,
+  below the sources' own 8-bit grain), normalization happens on batch
+  assembly in the prefetch thread, and native preprocessing runs in
+  bounded windows so no full-split float32 or raw stack is ever
+  transiently resident. `cache_nbytes()` is the ledger.
 """
 
 from __future__ import annotations
@@ -33,7 +39,11 @@ from typing import Iterator, List, Optional, Tuple
 import numpy as np
 
 from cyclegan_tpu.config import Config
-from cyclegan_tpu.data.augment import preprocess_test, preprocess_train
+from cyclegan_tpu.data.augment import (
+    normalize_image,
+    preprocess_test,
+    preprocess_train,
+)
 from cyclegan_tpu.data.sources import Source, resolve_source, split_tag
 
 Batch = Tuple[np.ndarray, np.ndarray, np.ndarray]  # x, y, weights
@@ -112,7 +122,8 @@ class CycleGANData:
         c = self.config.data
         n = self.n_test
         return [
-            preprocess_test(self.source.load(split, i), c.crop_size) for i in range(n)
+            preprocess_test(self.source.load(split, i), c.crop_size, normalize=False)
+            for i in range(n)
         ]
 
     def _sample_rng(self, split: str, epoch: int, i: int) -> np.random.Generator:
@@ -122,13 +133,21 @@ class CycleGANData:
         return np.random.default_rng((self.seed, split_tag(split), epoch, i))
 
     def _augment_one(self, split: str, epoch: int, i: int) -> np.ndarray:
+        """One augmented image in the uint8 cache format (normalization
+        happens centrally in _batches)."""
         c = self.config.data
         return preprocess_train(
             self.source.load(split, int(i)),
             self._sample_rng(split, epoch, int(i)),
             c.resize_size,
             c.crop_size,
+            normalize=False,
         )
+
+    # Native preprocessing window: bounds the transient raw uint8 stack
+    # (~50MB at 256^2) so a 7k-image split never materializes whole —
+    # wide enough that the C++ thread pool stays saturated.
+    _NATIVE_WINDOW = 256
 
     def _prep_train(self, split: str, epoch: int) -> List[np.ndarray]:
         c = self.config.data
@@ -137,28 +156,33 @@ class CycleGANData:
 
         if not native.available():
             return [self._augment_one(split, epoch, i) for i in range(self.n_train)]
-        raws = [self.source.load(split, i) for i in range(self.n_train)]
-        if len({r.shape for r in raws}) == 1:
-            # Same-sized source (TFDS cycle_gan/*, synthetic): fused
-            # threaded C++ batch path.
-            flips, oys, oxs = [], [], []
-            for i in range(self.n_train):
-                rng = self._sample_rng(split, epoch, i)
-                f, oy, ox = draw_augment_params(rng, c.resize_size, c.crop_size)
-                flips.append(int(f)); oys.append(oy); oxs.append(ox)
-            out = native.preprocess_batch(
-                np.stack(raws), c.resize_size,
-                np.asarray(flips, np.int32), np.asarray(oys, np.int32),
-                np.asarray(oxs, np.int32), c.crop_size,
-            )
-            return list(out)
-        # Mixed-size source: per-image native path, reusing the decoded raws.
-        return [
-            preprocess_train(
-                raws[i], self._sample_rng(split, epoch, i), c.resize_size, c.crop_size
-            )
-            for i in range(self.n_train)
-        ]
+        out: List[np.ndarray] = []
+        for lo in range(0, self.n_train, self._NATIVE_WINDOW):
+            hi = min(lo + self._NATIVE_WINDOW, self.n_train)
+            raws = [self.source.load(split, i) for i in range(lo, hi)]
+            if len({r.shape for r in raws}) == 1:
+                # Same-sized window (TFDS cycle_gan/*, synthetic): fused
+                # threaded C++ batch path.
+                flips, oys, oxs = [], [], []
+                for i in range(lo, hi):
+                    rng = self._sample_rng(split, epoch, i)
+                    f, oy, ox = draw_augment_params(rng, c.resize_size, c.crop_size)
+                    flips.append(int(f)); oys.append(oy); oxs.append(ox)
+                out.extend(native.preprocess_batch(
+                    np.stack(raws), c.resize_size,
+                    np.asarray(flips, np.int32), np.asarray(oys, np.int32),
+                    np.asarray(oxs, np.int32), c.crop_size, normalize=False,
+                ))
+            else:
+                # Mixed-size window: per-image native path on the raws.
+                out.extend(
+                    preprocess_train(
+                        raws[i - lo], self._sample_rng(split, epoch, i),
+                        c.resize_size, c.crop_size, normalize=False,
+                    )
+                    for i in range(lo, hi)
+                )
+        return out
 
     # -- iteration -------------------------------------------------------
 
@@ -198,8 +222,10 @@ class CycleGANData:
             gb = np.concatenate([gb, pad]) if k < gbs else gb
             la, lb = self._host_slice(ga), self._host_slice(gb)
             wlocal = self._host_slice(weights)
-            x = np.stack([get_a(i) for i in la]).astype(np.float32)
-            y = np.stack([get_b(i) for i in lb]).astype(np.float32)
+            # get_* return the uint8 cache format; normalize here, in the
+            # prefetch thread, so float32 exists only batch-at-a-time.
+            x = normalize_image(np.stack([get_a(i) for i in la]))
+            y = normalize_image(np.stack([get_b(i) for i in lb]))
             if k < gbs:
                 # zero out padded positions on this host
                 x = x * wlocal[:, None, None, None]
@@ -232,12 +258,26 @@ class CycleGANData:
         return iter(_Prefetcher(it)) if prefetch else it
 
     def plot_pairs(self, k: Optional[int] = None) -> List[Tuple[np.ndarray, np.ndarray]]:
-        """First k test pairs at batch 1 (main.py:76-77)."""
+        """First k test pairs at batch 1 (main.py:76-77), normalized."""
         k = k if k is not None else self.config.train.plot_samples
         k = min(k, self.n_test)
         return [
-            (self._test_a[i][None, ...], self._test_b[i][None, ...]) for i in range(k)
+            (
+                normalize_image(self._test_a[i][None, ...]),
+                normalize_image(self._test_b[i][None, ...]),
+            )
+            for i in range(k)
         ]
+
+    def cache_nbytes(self) -> int:
+        """Memory ledger: bytes held by the resident test/train caches."""
+        total = sum(a.nbytes for a in self._test_a) + sum(
+            a.nbytes for a in self._test_b
+        )
+        if self._train_cache is not None:
+            for items in self._train_cache:
+                total += sum(a.nbytes for a in items)
+        return total
 
 
 def build_data(config: Config, global_batch_size: int) -> CycleGANData:

@@ -110,7 +110,15 @@ class FolderSource:
 
 
 class TFDSSource:
-    """TFDS cycle_gan/<name> (reference main.py:22-26), import-gated."""
+    """TFDS cycle_gan/<name> (reference main.py:22-26), import-gated.
+
+    Prefers `builder.as_data_source` (TFDS random-access API): records
+    decode LAZILY per `load`, so no split is ever resident whole — the
+    pipeline's windowed preprocessing then bounds memory end to end.
+    Datasets prepared in a format without random access fall back to
+    materializing each split once as uint8 arrays (~260MB for
+    horse2zebra; the pre-r2 behavior).
+    """
 
     def __init__(self, dataset: str = "horse2zebra", data_dir: str | None = None):
         try:
@@ -123,18 +131,38 @@ class TFDSSource:
         self.name = f"tfds:cycle_gan/{dataset}"
         builder = tfds.builder(f"cycle_gan/{dataset}", data_dir=data_dir)
         builder.download_and_prepare()
-        self._splits = {}
-        self._sizes = {}
+        self._random_access: dict | None = None
+        self._splits: dict = {}
+        self._sizes: dict = {}
+        try:
+            sources = {
+                split: builder.as_data_source(split=split) for split in SPLITS
+            }
+            self._random_access = sources
+            self._sizes = {split: len(src) for split, src in sources.items()}
+        except (AttributeError, NotImplementedError, RuntimeError, ValueError):
+            self._materialize(builder)
+
+    def _materialize(self, builder) -> None:
+        """Eager fallback for non-random-access dataset formats."""
         for split in SPLITS:
             ds = builder.as_dataset(split=split, as_supervised=True)
             # Label discarded, as in reference main.py:40.
-            self._splits[split] = [np.asarray(img) for img, _ in ds.as_numpy_iterator()]
+            self._splits[split] = [
+                np.asarray(img) for img, _ in ds.as_numpy_iterator()
+            ]
             self._sizes[split] = len(self._splits[split])
 
     def split_size(self, split: str) -> int:
         return self._sizes[split]
 
     def load(self, split: str, index: int) -> np.ndarray:
+        if self._random_access is not None:
+            rec = self._random_access[split][index]
+            # data_source records are feature dicts; label discarded
+            # (main.py:40 parity).
+            img = rec["image"] if isinstance(rec, dict) else rec[0]
+            return np.asarray(img)
         return self._splits[split][index]
 
 

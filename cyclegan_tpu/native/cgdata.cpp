@@ -38,12 +38,30 @@ static inline Coord coord(int out_i, int in_n, float scale) {
   return r;
 }
 
+// Output policies: float32 normalized to [-1, 1] (feeding the device
+// directly), or uint8 rounded half-even (the 4x-smaller cache format —
+// the pipeline normalizes on batch assembly).
+static inline void store_px(float v, float* o) {
+  constexpr float kInv = 1.0f / 127.5f;
+  // clamp: bilinear of uint8 is within [0,255] mathematically, but
+  // float32 rounding can spill a ulp past +/-1 after normalizing
+  *o = std::min(1.0f, std::max(-1.0f, v * kInv - 1.0f));
+}
+
+static inline void store_px(float v, uint8_t* o) {
+  // std::nearbyint rounds half-even in the default FP environment,
+  // matching numpy's np.rint in the fallback path (data/augment.py).
+  *o = static_cast<uint8_t>(
+      std::nearbyint(std::min(255.0f, std::max(0.0f, v))));
+}
+
 // Fused: uint8 [h, w, 3] -> resize to [rh, rw] -> optional horizontal
 // flip (applied BEFORE resize, matching the reference op order
-// main.py:40-44) -> crop [crop, crop] at (oy, ox) -> float32 in [-1, 1].
+// main.py:40-44) -> crop [crop, crop] at (oy, ox) -> OutT (see store_px).
+template <typename OutT>
 void preprocess_one(const uint8_t* img, int h, int w,
                     int rh, int rw, int flip, int oy, int ox, int crop,
-                    float* out) {
+                    OutT* out) {
   const float sy = static_cast<float>(h) / rh;
   const float sx = static_cast<float>(w) / rw;
   // Precompute x-coords for the cropped window only.
@@ -56,13 +74,12 @@ void preprocess_one(const uint8_t* img, int h, int w,
     }
     xs[j] = cx;
   }
-  constexpr float kInv = 1.0f / 127.5f;
   for (int i = 0; i < crop; ++i) {
     const Coord cy = coord(oy + i, h, sy);
     const uint8_t* row0 = img + static_cast<size_t>(cy.i0) * w * 3;
     const uint8_t* row1 = img + static_cast<size_t>(cy.i1) * w * 3;
     const float fy = cy.frac;
-    float* orow = out + static_cast<size_t>(i) * crop * 3;
+    OutT* orow = out + static_cast<size_t>(i) * crop * 3;
     for (int j = 0; j < crop; ++j) {
       const Coord& cx = xs[j];
       const float fx = cx.frac;
@@ -74,31 +91,17 @@ void preprocess_one(const uint8_t* img, int h, int w,
         const float top = p00[ch] + (p01[ch] - static_cast<float>(p00[ch])) * fx;
         const float bot = p10[ch] + (p11[ch] - static_cast<float>(p10[ch])) * fx;
         const float v = top + (bot - top) * fy;
-        // clamp: bilinear of uint8 is within [0,255] mathematically, but
-        // float32 rounding can spill a ulp past +/-1 after normalizing
-        orow[j * 3 + ch] = std::min(1.0f, std::max(-1.0f, v * kInv - 1.0f));
+        store_px(v, orow + j * 3 + ch);
       }
     }
   }
 }
 
-}  // namespace
-
-extern "C" {
-
-// Single image (see preprocess_one).
-void cg_preprocess(const uint8_t* img, int h, int w,
-                   int rh, int rw, int flip, int oy, int ox, int crop,
-                   float* out) {
-  preprocess_one(img, h, w, rh, rw, flip, oy, ox, crop, out);
-}
-
-// Batch of same-sized images, threaded. imgs: [n, h, w, 3] contiguous;
-// flips/oys/oxs: per-image params; out: [n, crop, crop, 3].
-void cg_preprocess_batch(const uint8_t* imgs, int n, int h, int w,
-                         int rh, int rw,
-                         const int* flips, const int* oys, const int* oxs,
-                         int crop, float* out, int n_threads) {
+template <typename OutT>
+void preprocess_batch(const uint8_t* imgs, int n, int h, int w,
+                      int rh, int rw,
+                      const int* flips, const int* oys, const int* oxs,
+                      int crop, OutT* out, int n_threads) {
   if (n_threads < 1) {
     n_threads = static_cast<int>(std::thread::hardware_concurrency());
     if (n_threads < 1) n_threads = 1;
@@ -119,6 +122,43 @@ void cg_preprocess_batch(const uint8_t* imgs, int n, int h, int w,
   for (auto& th : workers) th.join();
 }
 
-int cg_version() { return 1; }
+}  // namespace
+
+extern "C" {
+
+// Single image, float32 [-1, 1] output (see preprocess_one).
+void cg_preprocess(const uint8_t* img, int h, int w,
+                   int rh, int rw, int flip, int oy, int ox, int crop,
+                   float* out) {
+  preprocess_one(img, h, w, rh, rw, flip, oy, ox, crop, out);
+}
+
+// Single image, uint8 output (cache format; no normalize).
+void cg_preprocess_u8(const uint8_t* img, int h, int w,
+                      int rh, int rw, int flip, int oy, int ox, int crop,
+                      uint8_t* out) {
+  preprocess_one(img, h, w, rh, rw, flip, oy, ox, crop, out);
+}
+
+// Batch of same-sized images, threaded. imgs: [n, h, w, 3] contiguous;
+// flips/oys/oxs: per-image params; out: [n, crop, crop, 3].
+void cg_preprocess_batch(const uint8_t* imgs, int n, int h, int w,
+                         int rh, int rw,
+                         const int* flips, const int* oys, const int* oxs,
+                         int crop, float* out, int n_threads) {
+  preprocess_batch(imgs, n, h, w, rh, rw, flips, oys, oxs, crop, out,
+                   n_threads);
+}
+
+// Batch, uint8 output (cache format; no normalize).
+void cg_preprocess_batch_u8(const uint8_t* imgs, int n, int h, int w,
+                            int rh, int rw,
+                            const int* flips, const int* oys, const int* oxs,
+                            int crop, uint8_t* out, int n_threads) {
+  preprocess_batch(imgs, n, h, w, rh, rw, flips, oys, oxs, crop, out,
+                   n_threads);
+}
+
+int cg_version() { return 2; }
 
 }  // extern "C"

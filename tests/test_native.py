@@ -70,3 +70,53 @@ def test_preprocess_train_dispatches_native():
 def test_output_range():
     out = native.preprocess_one(_img(4), 80, False, 0, 0, 64)
     assert out.min() >= -1.0 and out.max() <= 1.0
+
+
+def numpy_ref_u8(img, resize, flip, oy, ox, crop):
+    if flip:
+        img = img[:, ::-1]
+    out = resize_bilinear(img.astype(np.float32), resize, resize)
+    return np.rint(np.clip(out[oy : oy + crop, ox : ox + crop], 0, 255)).astype(
+        np.uint8
+    )
+
+
+def test_native_u8_matches_numpy_quantization():
+    """uint8 cache outputs: same rounding (half-even) both paths; allow
+    off-by-one only where float arithmetic order puts a value within a
+    ulp of a .5 boundary."""
+    img = _img(11, 96, 80)
+    got = native.preprocess_one(img, 80, True, 3, 7, 64, normalize=False)
+    assert got.dtype == np.uint8
+    want = numpy_ref_u8(img, 80, True, 3, 7, 64)
+    diff = np.abs(got.astype(np.int16) - want.astype(np.int16))
+    assert diff.max() <= 1
+    assert (diff > 0).mean() < 0.01  # only boundary pixels may differ
+
+
+def test_native_batch_u8():
+    n = 6
+    imgs = np.stack([_img(i) for i in range(n)])
+    rng = np.random.RandomState(0)
+    flips = rng.randint(0, 2, n).astype(np.int32)
+    oys = rng.randint(0, 17, n).astype(np.int32)
+    oxs = rng.randint(0, 17, n).astype(np.int32)
+    got = native.preprocess_batch(
+        imgs, 80, flips, oys, oxs, 64, n_threads=3, normalize=False
+    )
+    assert got.dtype == np.uint8 and got.shape == (n, 64, 64, 3)
+    for i in range(n):
+        want = numpy_ref_u8(imgs[i], 80, bool(flips[i]), oys[i], oxs[i], 64)
+        diff = np.abs(got[i].astype(np.int16) - want.astype(np.int16))
+        assert diff.max() <= 1, i
+
+
+def test_u8_normalize_roundtrip_close_to_float_path():
+    """normalize(u8 cache) must sit within one quantum of the direct
+    float path — the cache format loses nothing visible."""
+    img = _img(12, 70, 90)
+    f32 = native.preprocess_one(img, 80, False, 2, 5, 64)
+    u8 = native.preprocess_one(img, 80, False, 2, 5, 64, normalize=False)
+    np.testing.assert_allclose(
+        normalize_image(u8), f32, atol=0.5 / 127.5 + 1e-6
+    )
