@@ -18,6 +18,11 @@ and made the 512² batch-4 remat config fit 16G HBM):
 - "pallas": a fused single-pass Pallas TPU kernel (ops/pallas/norm_kernel.py)
   for the cases where XLA's fusion leaves the activation in HBM between the
   moment pass and the normalize pass.
+
+Both 4-D paths use jax.custom_vjp, which makes instance_norm
+REVERSE-MODE ONLY: jax.jvp/jacfwd through it raises. Training and every
+test use jax.grad (reverse mode); if forward mode is ever needed, route
+through the plain-autodiff `_xla_forward` instead.
 """
 
 from __future__ import annotations
@@ -85,13 +90,15 @@ def _build_xla(eps: float):
 
     def op_fwd(x, scale, bias):
         y, mean, inv = _xla_forward(x, scale, bias, eps)
-        return y, (x, scale, mean, inv)
+        # bias itself is unused by the backward math, but it is saved (a
+        # tiny [C] vector, same as the Pallas path) so dbias comes back
+        # in bias's OWN dtype — assuming scale.dtype here would produce a
+        # mismatched cotangent aval if the two params ever differ.
+        return y, (x, scale, bias, mean, inv)
 
     def op_bwd(res, g):
-        x, scale, mean, inv = res
-        # bias is not a residual (unused by the math); its grad shares
-        # scale's param dtype.
-        return instance_norm_backward(x, scale, mean, inv, g, scale.dtype)
+        x, scale, bias, mean, inv = res
+        return instance_norm_backward(x, scale, mean, inv, g, bias.dtype)
 
     op.defvjp(op_fwd, op_bwd)
     return op

@@ -30,7 +30,10 @@ from cyclegan_tpu.utils.summary import Summary
 # Max dispatched-but-unfetched TRAIN STEPS (not dispatches: one fused
 # dispatch carries steps_per_dispatch of them): enough lead to hide host
 # latency, small enough that pinned input batches stay a bounded slice
-# of HBM.
+# of HBM. NOTE: with steps_per_dispatch K > MAX_IN_FLIGHT the effective
+# bound is K, not this constant — at least one whole fused dispatch must
+# be allowed in flight (append_metrics uses max(MAX_IN_FLIGHT, K)), so
+# the pinned window is ~2K steps' batches in that regime.
 MAX_IN_FLIGHT = 32
 
 
