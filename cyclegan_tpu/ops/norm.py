@@ -132,10 +132,12 @@ def instance_norm(
       bias: [C] learned beta (zeros init).
       eps: numerical epsilon; 1e-3 matches tfa's default.
       impl: "xla" | "pallas" | "auto". "auto" resolves to "xla": measured
-        on TPU v5e inside the full fused train step, XLA's own fusion of
-        the reduce+normalize beats the hand-written kernel (the Pallas
-        grid serializes (N, C/128) slabs that XLA overlaps), so the
-        kernel is opt-in for shapes/backends where it wins.
+        on TPU v5e inside the full fused train step (95.0 vs 86.1 img/s),
+        XLA wins because it fuses the norm into the producer/consumer
+        convs' HBM passes while pallas_call is an opaque fusion boundary
+        that forces an isolated read+write — the quantified ceiling
+        analysis is in docs/BENCHMARKS.md. The kernel stays opt-in for
+        shapes/backends where producer fusion is unavailable.
     """
     if impl == "pallas":
         from cyclegan_tpu.ops.pallas.norm_kernel import instance_norm_pallas

@@ -36,12 +36,27 @@ from cyclegan_tpu.train import create_state, make_train_step  # noqa: E402
 
 def main():
     assert jax.process_count() == int(os.environ["TEST_NPROC"])
-    assert len(jax.devices()) == 4  # 2 local x 2 processes
+    # Defaults preserve the original 2-proc x 2-local = 4-device layout;
+    # TEST_LOCAL_DEVICES / TEST_SPATIAL widen it (e.g. 2 x 4 = 8 global
+    # with a 4x2 data x spatial mesh — halo exchange composing with the
+    # cross-process runtime).
+    local = int(os.environ.get("TEST_LOCAL_DEVICES", "2"))
+    spatial = int(os.environ.get("TEST_SPATIAL", "1"))
+    n_global = local * jax.process_count()
+    assert len(jax.devices()) == n_global
+
+    import dataclasses
 
     config = tiny_test_config()
+    config = dataclasses.replace(
+        config,
+        parallel=dataclasses.replace(
+            config.parallel, spatial_parallelism=spatial
+        ),
+    )
     plan = make_mesh_plan(config.parallel)
-    assert plan.n_data == 4
-    global_batch = 4
+    assert plan.n_data == n_global // spatial
+    global_batch = plan.n_data
 
     state = create_state(config, jax.random.PRNGKey(0))
     state = jax.device_put(state, replicated(plan))

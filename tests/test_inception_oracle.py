@@ -1,0 +1,76 @@
+"""Numerical cross-check of the Flax InceptionV3 pool3 port against an
+independent torch implementation (tests/torch_inception.py).
+
+Random weights (including random batch-norm running stats) flow through
+tools/convert_inception_weights.py into the Flax model; both nets then
+see the same inputs. Agreement at <=1e-4 pins every convention that can
+silently diverge — stem VALID padding, factorized-7x7 padding,
+count_include_pad=False averages, Mixed_7c's FID max-pool branch, the
+OIHW->HWIO kernel transpose, and the BN eps/affine/running-stat wiring.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from cyclegan_tpu.eval.inception import InceptionV3Pool3, load_params_npz  # noqa: E402
+from tools.convert_inception_weights import convert_state_dict  # noqa: E402
+from torch_inception import TorchInceptionPool3, randomize_  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def models(tmp_path_factory):
+    tmodel = TorchInceptionPool3()
+    randomize_(tmodel, seed=7)
+    tmodel.eval()
+
+    sd = {k: v.numpy() for k, v in tmodel.state_dict().items()}
+    npz = convert_state_dict(sd)
+    path = tmp_path_factory.mktemp("w") / "inception_oracle.npz"
+    np.savez(path, **npz)
+
+    net = InceptionV3Pool3()
+    template = jax.eval_shape(
+        lambda: net.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
+    )
+    variables = load_params_npz(str(path), template)
+    # One jitted apply shared by all tests (per-call lambdas would retrace
+    # and recompile the full graph every time).
+    apply = jax.jit(net.apply)
+    return tmodel, apply, variables
+
+
+def _features(models, x_nhwc: np.ndarray):
+    tmodel, apply, variables = models
+    with torch.no_grad():
+        t_out = tmodel(torch.from_numpy(np.transpose(x_nhwc, (0, 3, 1, 2))))
+    f_out = apply(variables, jnp.asarray(x_nhwc))
+    return np.asarray(t_out), np.asarray(f_out)
+
+
+def test_pool3_features_match_torch_oracle(models):
+    rng = np.random.RandomState(0)
+    x = (rng.rand(2, 299, 299, 3).astype(np.float32) * 2.0) - 1.0
+    t_out, f_out = _features(models, x)
+    assert t_out.shape == f_out.shape == (2, 2048)
+    np.testing.assert_allclose(f_out, t_out, rtol=1e-4, atol=1e-4)
+
+
+def test_pool3_match_on_structured_input(models):
+    """Smooth gradient image (exercises border pixels differently from
+    noise — SAME/VALID off-by-ones show up at borders first)."""
+    yy, xx = np.mgrid[0:299, 0:299].astype(np.float32) / 299.0
+    img = np.stack([yy, xx, (yy + xx) / 2.0], axis=-1) * 2.0 - 1.0
+    x = img[None]
+    t_out, f_out = _features(models, x)
+    np.testing.assert_allclose(f_out, t_out, rtol=1e-4, atol=1e-4)

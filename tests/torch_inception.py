@@ -1,0 +1,201 @@
+"""Independent torch implementation of the FID-standard InceptionV3
+pool3 graph — the numerical oracle for cyclegan_tpu/eval/inception.py.
+
+Written from the published architecture (Szegedy et al. 2015; the
+pytorch-fid `pt_inception-2015-12-05` graph for the two FID quirks:
+count_include_pad=False average pools and Mixed_7c's max-pool branch),
+NOT by importing torchvision — this environment has none, and an import
+would defeat the point of an independent check. Module names match the
+torchvision state-dict convention so tools/convert_inception_weights.py
+maps this model's state dict onto the Flax port unchanged.
+
+Input: [N, 3, 299, 299] in [-1, 1]. Output: [N, 2048] pool3 features.
+"""
+
+from __future__ import annotations
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+
+class BasicConv2d(nn.Module):
+    def __init__(self, cin, cout, **kw):
+        super().__init__()
+        self.conv = nn.Conv2d(cin, cout, bias=False, **kw)
+        self.bn = nn.BatchNorm2d(cout, eps=1e-3)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+def _avg3(x):
+    # FID-graph average pool: 3x3 stride 1, border windows averaged over
+    # valid pixels only.
+    return F.avg_pool2d(x, 3, stride=1, padding=1, count_include_pad=False)
+
+
+class Mixed5(nn.Module):  # 35x35 (InceptionA)
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.branch1x1 = BasicConv2d(cin, 64, kernel_size=1)
+        self.branch5x5_1 = BasicConv2d(cin, 48, kernel_size=1)
+        self.branch5x5_2 = BasicConv2d(48, 64, kernel_size=5, padding=2)
+        self.branch3x3dbl_1 = BasicConv2d(cin, 64, kernel_size=1)
+        self.branch3x3dbl_2 = BasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = BasicConv2d(96, 96, kernel_size=3, padding=1)
+        self.branch_pool = BasicConv2d(cin, pool_features, kernel_size=1)
+
+    def forward(self, x):
+        b0 = self.branch1x1(x)
+        b1 = self.branch5x5_2(self.branch5x5_1(x))
+        b2 = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        b3 = self.branch_pool(_avg3(x))
+        return torch.cat([b0, b1, b2, b3], 1)
+
+
+class Mixed6a(nn.Module):  # 35 -> 17 (InceptionB)
+    def __init__(self, cin):
+        super().__init__()
+        self.branch3x3 = BasicConv2d(cin, 384, kernel_size=3, stride=2)
+        self.branch3x3dbl_1 = BasicConv2d(cin, 64, kernel_size=1)
+        self.branch3x3dbl_2 = BasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = BasicConv2d(96, 96, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b0 = self.branch3x3(x)
+        b1 = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        b2 = F.max_pool2d(x, 3, stride=2)
+        return torch.cat([b0, b1, b2], 1)
+
+
+class Mixed6(nn.Module):  # 17x17 (InceptionC)
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.branch1x1 = BasicConv2d(cin, 192, kernel_size=1)
+        self.branch7x7_1 = BasicConv2d(cin, c7, kernel_size=1)
+        self.branch7x7_2 = BasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7_3 = BasicConv2d(c7, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_1 = BasicConv2d(cin, c7, kernel_size=1)
+        self.branch7x7dbl_2 = BasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_3 = BasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7dbl_4 = BasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_5 = BasicConv2d(c7, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch_pool = BasicConv2d(cin, 192, kernel_size=1)
+
+    def forward(self, x):
+        b0 = self.branch1x1(x)
+        b1 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+        b2 = self.branch7x7dbl_5(
+            self.branch7x7dbl_4(
+                self.branch7x7dbl_3(self.branch7x7dbl_2(self.branch7x7dbl_1(x)))
+            )
+        )
+        b3 = self.branch_pool(_avg3(x))
+        return torch.cat([b0, b1, b2, b3], 1)
+
+
+class Mixed7a(nn.Module):  # 17 -> 8 (InceptionD)
+    def __init__(self, cin):
+        super().__init__()
+        self.branch3x3_1 = BasicConv2d(cin, 192, kernel_size=1)
+        self.branch3x3_2 = BasicConv2d(192, 320, kernel_size=3, stride=2)
+        self.branch7x7x3_1 = BasicConv2d(cin, 192, kernel_size=1)
+        self.branch7x7x3_2 = BasicConv2d(192, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7x3_3 = BasicConv2d(192, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7x3_4 = BasicConv2d(192, 192, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b0 = self.branch3x3_2(self.branch3x3_1(x))
+        b1 = self.branch7x7x3_4(
+            self.branch7x7x3_3(self.branch7x7x3_2(self.branch7x7x3_1(x)))
+        )
+        b2 = F.max_pool2d(x, 3, stride=2)
+        return torch.cat([b0, b1, b2], 1)
+
+
+class Mixed7(nn.Module):  # 8x8 (InceptionE; pool="max" = FID Mixed_7c)
+    def __init__(self, cin, pool="avg"):
+        super().__init__()
+        self.pool = pool
+        self.branch1x1 = BasicConv2d(cin, 320, kernel_size=1)
+        self.branch3x3_1 = BasicConv2d(cin, 384, kernel_size=1)
+        self.branch3x3_2a = BasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3_2b = BasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = BasicConv2d(cin, 448, kernel_size=1)
+        self.branch3x3dbl_2 = BasicConv2d(448, 384, kernel_size=3, padding=1)
+        self.branch3x3dbl_3a = BasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = BasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch_pool = BasicConv2d(cin, 192, kernel_size=1)
+
+    def forward(self, x):
+        b0 = self.branch1x1(x)
+        b1 = self.branch3x3_1(x)
+        b1 = torch.cat([self.branch3x3_2a(b1), self.branch3x3_2b(b1)], 1)
+        b2 = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        b2 = torch.cat([self.branch3x3dbl_3a(b2), self.branch3x3dbl_3b(b2)], 1)
+        if self.pool == "max":
+            pooled = F.max_pool2d(x, 3, stride=1, padding=1)
+        else:
+            pooled = _avg3(x)
+        b3 = self.branch_pool(pooled)
+        return torch.cat([b0, b1, b2, b3], 1)
+
+
+class TorchInceptionPool3(nn.Module):
+    """Stem through Mixed_7c, global-average-pooled to [N, 2048]."""
+
+    def __init__(self):
+        super().__init__()
+        self.Conv2d_1a_3x3 = BasicConv2d(3, 32, kernel_size=3, stride=2)
+        self.Conv2d_2a_3x3 = BasicConv2d(32, 32, kernel_size=3)
+        self.Conv2d_2b_3x3 = BasicConv2d(32, 64, kernel_size=3, padding=1)
+        self.Conv2d_3b_1x1 = BasicConv2d(64, 80, kernel_size=1)
+        self.Conv2d_4a_3x3 = BasicConv2d(80, 192, kernel_size=3)
+        self.Mixed_5b = Mixed5(192, 32)
+        self.Mixed_5c = Mixed5(256, 64)
+        self.Mixed_5d = Mixed5(288, 64)
+        self.Mixed_6a = Mixed6a(288)
+        self.Mixed_6b = Mixed6(768, 128)
+        self.Mixed_6c = Mixed6(768, 160)
+        self.Mixed_6d = Mixed6(768, 160)
+        self.Mixed_6e = Mixed6(768, 192)
+        self.Mixed_7a = Mixed7a(768)
+        self.Mixed_7b = Mixed7(1280, pool="avg")
+        self.Mixed_7c = Mixed7(2048, pool="max")
+
+    def forward(self, x):
+        x = self.Conv2d_1a_3x3(x)
+        x = self.Conv2d_2a_3x3(x)
+        x = self.Conv2d_2b_3x3(x)
+        x = F.max_pool2d(x, 3, stride=2)
+        x = self.Conv2d_3b_1x1(x)
+        x = self.Conv2d_4a_3x3(x)
+        x = F.max_pool2d(x, 3, stride=2)
+        x = self.Mixed_5b(x)
+        x = self.Mixed_5c(x)
+        x = self.Mixed_5d(x)
+        x = self.Mixed_6a(x)
+        x = self.Mixed_6b(x)
+        x = self.Mixed_6c(x)
+        x = self.Mixed_6d(x)
+        x = self.Mixed_6e(x)
+        x = self.Mixed_7a(x)
+        x = self.Mixed_7b(x)
+        x = self.Mixed_7c(x)
+        return torch.mean(x, dim=(2, 3))
+
+
+def randomize_(model: TorchInceptionPool3, seed: int = 0) -> None:
+    """Deterministic non-trivial weights INCLUDING batch-norm running
+    stats (default mean=0/var=1 would leave the stats mapping untested)."""
+    g = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for m in model.modules():
+            if isinstance(m, nn.Conv2d):
+                m.weight.normal_(0.0, 0.05, generator=g)
+            elif isinstance(m, nn.BatchNorm2d):
+                m.weight.normal_(1.0, 0.2, generator=g)
+                m.bias.normal_(0.0, 0.1, generator=g)
+                m.running_mean.normal_(0.0, 0.5, generator=g)
+                m.running_var.uniform_(0.5, 2.0, generator=g)
