@@ -61,8 +61,15 @@ def main(args: argparse.Namespace) -> None:
     distributed.barrier("output_dir_ready")
     os.makedirs(args.output_dir, exist_ok=True)
 
+    from cyclegan_tpu.config import DiscriminatorConfig, GeneratorConfig
+
     config = Config(
         model=ModelConfig(
+            generator=GeneratorConfig(
+                filters=args.filters,
+                num_residual_blocks=args.residual_blocks,
+            ),
+            discriminator=DiscriminatorConfig(filters=args.filters),
             compute_dtype="bfloat16" if args.bf16 else "float32",
             remat=args.remat,
             scan_blocks=args.scan_blocks,
@@ -97,6 +104,17 @@ def main(args: argparse.Namespace) -> None:
     if primary:
         print(f"Devices: {plan.n_devices} ({plan.n_data} data x {plan.n_spatial} spatial), "
               f"global batch size: {global_batch_size}")
+
+    # Utilization accounting for the perf/* scalars: per-image step FLOPs
+    # and the mesh's aggregate bf16 peak (None off-TPU / unknown chips).
+    from cyclegan_tpu.utils.flops import (
+        peak_tflops_for_device_kind,
+        train_step_flops_per_image,
+    )
+
+    flops_per_image = train_step_flops_per_image(config)
+    per_chip = peak_tflops_for_device_kind(jax.devices()[0].device_kind)
+    peak_tflops = per_chip * plan.n_devices if per_chip else None
 
     summary = make_summary(config.train.output_dir, primary)
     data = build_data(config, global_batch_size)
@@ -160,11 +178,21 @@ def main(args: argparse.Namespace) -> None:
             results = loop.test_epoch(config, data, plan, test_step, state, summary, epoch)
             elapse = time() - start
             summary.scalar("elapse", elapse, step=epoch)
+            ips = loop.images_per_sec(2 * data.n_train, elapse)
+            summary.scalar("images_per_sec", ips, step=epoch)
+            # Absolute utilization next to raw throughput: analytic step
+            # FLOPs (utils/flops.py) x achieved rate, plus MFU when the
+            # chip's bf16 peak is known. The epoch window includes the
+            # test pass, so this is a conservative lower bound.
             summary.scalar(
-                "images_per_sec",
-                loop.images_per_sec(2 * data.n_train, elapse),
-                step=epoch,
+                "perf/tflops_per_sec", ips * flops_per_image / 1e12, step=epoch
             )
+            if peak_tflops:
+                summary.scalar(
+                    "perf/mfu",
+                    ips * flops_per_image / 1e12 / peak_tflops,
+                    step=epoch,
+                )
             if primary:
                 loop.print_epoch_summary(results, elapse)
 
@@ -217,6 +245,13 @@ if __name__ == "__main__":
     parser.add_argument("--data_source", default="auto",
                         choices=["auto", "tfds", "folder", "synthetic"])
     parser.add_argument("--image_size", default=256, type=int)
+    parser.add_argument("--filters", default=64, type=int,
+                        help="base filter count for generator and "
+                             "discriminator (reference: 64, model.py:130/173); "
+                             "smaller values scale the model for small "
+                             "hardware — FLOPs scale ~quadratically")
+    parser.add_argument("--residual_blocks", default=9, type=int,
+                        help="generator residual trunk depth (reference: 9)")
     parser.add_argument("--bf16", action="store_true",
                         help="bfloat16 compute (fp32 params/optimizer)")
     parser.add_argument("--remat", action="store_true",

@@ -15,16 +15,20 @@ import sys
 import numpy as np
 import pytest
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+# Same import convention as test_inception_convert.py (top-level module
+# from tools/), so one pytest session loads the converter exactly once.
+sys.path.insert(0, os.path.join(_REPO, "tools"))
 
 torch = pytest.importorskip("torch")
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
+from convert_inception_weights import convert_state_dict  # noqa: E402
 from cyclegan_tpu.eval.inception import InceptionV3Pool3, load_params_npz  # noqa: E402
-from tools.convert_inception_weights import convert_state_dict  # noqa: E402
 from torch_inception import TorchInceptionPool3, randomize_  # noqa: E402
 
 

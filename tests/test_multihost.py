@@ -79,9 +79,9 @@ def _spawn_workers(port, local_devices=2, spatial=1):
 
 
 def _collect_outputs(procs):
-    """communicate() both workers, assert success, parse METRICS (and FID
-    when present). Kills stragglers so a failed worker never leaks its
-    coordinator port + JAX runtime."""
+    """communicate() both workers, assert success, parse the METRICS and
+    FID lines every worker prints. Kills stragglers so a failed worker
+    never leaks its coordinator port + JAX runtime."""
     outs, fids = [], []
     try:
         for p in procs:
@@ -123,7 +123,7 @@ def test_two_process_training_matches_single_process(tmp_path):
 
 
 @pytest.mark.slow
-def test_two_process_four_device_spatial_mesh(tmp_path):
+def test_two_process_four_device_spatial_mesh():
     """2 processes x 4 local devices = 8 global, 4x2 data x spatial mesh:
     halo-exchange spatial sharding composing with the cross-process
     runtime (VERDICT r1 asked for exactly this combination). Both

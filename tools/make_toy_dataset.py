@@ -1,0 +1,76 @@
+"""Generate a two-domain toy dataset for offline qualitative runs.
+
+Domain A: solid-filled ellipses/rectangles on a light gray background.
+Domain B: the same shape family, but STRIPE-textured fills.
+
+The A<->B translation ("add stripes" / "remove stripes") is the offline
+stand-in for horse<->zebra (reference README.md:4-6): it is learnable by
+a small CycleGAN in CPU-hours, and success/failure is obvious to the eye
+in the X_cycle/Y_cycle panels. Images are written as trainA/ trainB/
+testA/ testB .npy files in the FolderSource layout (data/sources.py).
+
+Usage:
+  python tools/make_toy_dataset.py --out /tmp/shapes2stripes \
+      --train 128 --test 12 --size 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def _draw(rng: np.random.Generator, size: int, striped: bool) -> np.ndarray:
+    """One sample: 1-3 shapes, solid or striped fill, uint8 [size,size,3]."""
+    img = np.full((size, size, 3), 225, np.float32)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
+    for _ in range(int(rng.integers(1, 4))):
+        cy, cx = rng.uniform(0.2, 0.8, 2) * size
+        ry, rx = rng.uniform(0.12, 0.3, 2) * size
+        color = rng.uniform(30, 220, 3)
+        if rng.random() < 0.5:  # ellipse
+            mask = ((yy - cy) / ry) ** 2 + ((xx - cx) / rx) ** 2 <= 1.0
+        else:  # rectangle
+            mask = (np.abs(yy - cy) <= ry) & (np.abs(xx - cx) <= rx)
+        if striped:
+            # Diagonal stripes, random phase/period, dark-on-color.
+            period = rng.uniform(4.0, 7.0)
+            phase = rng.uniform(0, period)
+            stripes = ((yy + xx + phase) % period) < period / 2
+            fill = np.where(stripes[..., None], color, color * 0.25)
+        else:
+            fill = np.broadcast_to(color, img.shape)
+        img = np.where(mask[..., None], fill, img)
+    img += rng.normal(0, 3.0, img.shape)  # sensor-ish grain
+    return np.clip(img, 0, 255).astype(np.uint8)
+
+
+def generate(out: str, train: int, test: int, size: int, seed: int = 0) -> None:
+    import zlib
+
+    specs = [("trainA", train, False), ("trainB", train, True),
+             ("testA", test, False), ("testB", test, True)]
+    for split, n, striped in specs:
+        d = os.path.join(out, split)
+        os.makedirs(d, exist_ok=True)
+        for i in range(n):
+            # crc32, not hash(): Python string hashing is salted per
+            # process and would make the dataset non-reproducible.
+            rng = np.random.default_rng(
+                (seed, zlib.crc32(split.encode()) & 0xFFFF, i)
+            )
+            np.save(os.path.join(d, f"{i:04d}.npy"), _draw(rng, size, striped))
+    print(f"wrote {2 * (train + test)} images -> {out}")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", required=True)
+    p.add_argument("--train", default=128, type=int)
+    p.add_argument("--test", default=12, type=int)
+    p.add_argument("--size", default=64, type=int)
+    p.add_argument("--seed", default=0, type=int)
+    a = p.parse_args()
+    generate(a.out, a.train, a.test, a.size, a.seed)
