@@ -134,6 +134,35 @@ class Config:
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
 
+    def model_meta(self) -> dict:
+        """JSON-able description of the model architecture, stored in the
+        checkpoint sidecar so slots are self-describing (translate.py
+        rebuilds the exact network without re-specified flags)."""
+        return {"model": dataclasses.asdict(self.model)}
+
+    @staticmethod
+    def model_from_meta(meta: dict, **overrides) -> ModelConfig:
+        """Rebuild a ModelConfig from `model_meta` output (tolerates
+        missing/legacy sidecars and unknown keys from future versions);
+        keyword overrides win over recorded values."""
+        recorded = dict(meta.get("model") or {})
+        gen = recorded.pop("generator", None)
+        disc = recorded.pop("discriminator", None)
+
+        def known(cls, d):
+            names = {f.name for f in dataclasses.fields(cls)}
+            return {k: v for k, v in (d or {}).items() if k in names}
+
+        kw = known(ModelConfig, recorded)
+        if gen is not None:
+            kw["generator"] = GeneratorConfig(**known(GeneratorConfig, gen))
+        if disc is not None:
+            kw["discriminator"] = DiscriminatorConfig(
+                **known(DiscriminatorConfig, disc)
+            )
+        kw.update(overrides)
+        return ModelConfig(**kw)
+
 
 def tiny_test_config() -> Config:
     """A miniature config for fast CPU tests: same topology, tiny sizes."""

@@ -70,6 +70,22 @@ class SyntheticSource:
         return np.clip(img, 0, 255).astype(np.uint8)
 
 
+def load_image_file(path: str) -> np.ndarray:
+    """Decode one image file (raster formats via PIL, .npy directly) to
+    uint8 RGB [H, W, 3] — the one shared decode for FolderSource and
+    translate.py, so format rules can't diverge."""
+    if path.endswith(".npy"):
+        arr = np.load(path)
+    else:
+        from PIL import Image
+
+        with Image.open(path) as im:
+            arr = np.asarray(im.convert("RGB"))
+    if arr.dtype != np.uint8:
+        arr = np.clip(arr, 0, 255).astype(np.uint8)
+    return arr
+
+
 class FolderSource:
     """trainA/trainB/testA/testB folders of images under `root`."""
 
@@ -96,17 +112,7 @@ class FolderSource:
         return len(self._files[split])
 
     def load(self, split: str, index: int) -> np.ndarray:
-        path = self._files[split][index]
-        if path.endswith(".npy"):
-            arr = np.load(path)
-        else:
-            from PIL import Image
-
-            with Image.open(path) as im:
-                arr = np.asarray(im.convert("RGB"))
-        if arr.dtype != np.uint8:
-            arr = np.clip(arr, 0, 255).astype(np.uint8)
-        return arr
+        return load_image_file(self._files[split][index])
 
 
 class TFDSSource:

@@ -30,16 +30,34 @@ class Checkpointer:
         self.meta_path = os.path.join(self.dir, "meta.json")
         self._ckptr = ocp.StandardCheckpointer()
 
-    def save(self, state: CycleGANState, epoch: int) -> None:
+    def save(self, state: CycleGANState, epoch: int, meta: Optional[dict] = None) -> None:
         """Overwrite the single slot (reference .write semantics,
-        main.py:157-160) and record the epoch counter."""
+        main.py:157-160) and record the epoch counter plus any extra
+        metadata (main.py passes the model architecture, making the slot
+        self-describing — translate.py rebuilds the right network without
+        the user re-specifying --filters etc.)."""
         self._ckptr.save(self.slot, state, force=True)
         # StandardCheckpointer saves asynchronously; block until the slot
         # is committed so the overwrite/auto-resume contract holds.
         self._ckptr.wait_until_finished()
         if jax.process_index() == 0:
-            with open(self.meta_path, "w") as f:
-                json.dump({"epoch": int(epoch)}, f)
+            record = dict(meta or {})
+            record["epoch"] = int(epoch)
+            # Atomic: a preemption mid-write must never truncate the
+            # sidecar (the slot itself is valid; a broken meta.json would
+            # brick auto-resume).
+            tmp = self.meta_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+            os.replace(tmp, self.meta_path)
+
+    def read_meta(self) -> dict:
+        """The sidecar metadata ({} when absent/unreadable)."""
+        try:
+            with open(self.meta_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
 
     def exists(self) -> bool:
         return os.path.isdir(self.slot)
@@ -64,10 +82,7 @@ class Checkpointer:
                 template,
             )
             state = self._ckptr.restore(self.slot, abstract)
-        epoch = 0
-        if os.path.exists(self.meta_path):
-            with open(self.meta_path) as f:
-                epoch = int(json.load(f).get("epoch", -1)) + 1
+        epoch = int(self.read_meta().get("epoch", -1)) + 1
         return state, epoch
 
     @staticmethod

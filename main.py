@@ -211,7 +211,7 @@ def main(args: argparse.Namespace) -> None:
                 # landing during it must still checkpoint below.
                 preempted = preempted or guard.should_stop()
             if preempted or last or epoch % config.train.checkpoint_every == 0:
-                ckpt.save(state, epoch)
+                ckpt.save(state, epoch, meta=config.model_meta())
                 if primary:
                     print(f"saved checkpoint to {ckpt.slot}")
                 # Every host must run the jitted cycle inference (state is

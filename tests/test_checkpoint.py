@@ -129,3 +129,48 @@ def test_partial_restore_rejects_total_param_mismatch(tiny_config, tmp_path):
     template = create_state(cfg2, jax.random.PRNGKey(1))
     with pytest.raises(ValueError, match="wrong checkpoint"):
         ckpt.restore(template, partial=True)
+
+
+def test_checkpoint_meta_records_architecture(tmp_path):
+    """Self-describing slots: save() records the model architecture and
+    Config.model_from_meta rebuilds it — the translate.py contract."""
+    from cyclegan_tpu.config import (
+        Config,
+        DiscriminatorConfig,
+        GeneratorConfig,
+        ModelConfig,
+    )
+    from cyclegan_tpu.train import create_state
+    from cyclegan_tpu.utils.checkpoint import Checkpointer
+
+    cfg = Config(
+        model=ModelConfig(
+            generator=GeneratorConfig(filters=8, num_residual_blocks=3),
+            discriminator=DiscriminatorConfig(filters=8),
+            image_size=32,
+            scan_blocks=True,
+        )
+    )
+    state = create_state(cfg, jax.random.PRNGKey(0))
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(state, epoch=4, meta=cfg.model_meta())
+
+    meta = Checkpointer(str(tmp_path)).read_meta()
+    assert meta["epoch"] == 4
+    rebuilt = Config.model_from_meta(meta)
+    assert rebuilt == cfg.model
+
+    # Overrides win; unknown future keys are tolerated.
+    assert Config.model_from_meta(meta, image_size=64).image_size == 64
+    meta["model"]["from_the_future"] = 1
+    meta["model"]["generator"]["also_new"] = 2
+    assert Config.model_from_meta(meta) == cfg.model
+
+
+def test_model_from_meta_tolerates_legacy_sidecar():
+    """Pre-r2 sidecars only carry {'epoch': N}: defaults must come back."""
+    from cyclegan_tpu.config import Config, ModelConfig
+
+    assert Config.model_from_meta({"epoch": 3}) == ModelConfig()
+    assert Config.model_from_meta({}) == ModelConfig()
+    assert Config.model_from_meta({}, scan_blocks=True).scan_blocks

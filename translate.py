@@ -22,20 +22,19 @@ import numpy as np
 
 from cyclegan_tpu.utils.platform import ensure_platform_from_env
 
-IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".webp")
+IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".webp", ".npy")
 
 
 def load_image(path: str, size: int) -> np.ndarray:
-    """Decode, then apply the SAME test-time preprocessing the model was
-    trained/evaluated with (data/augment.py preprocess_test: half-pixel-
-    center bilinear resize + [-1, 1] normalize — reference main.py:47-50).
-    PIL only decodes; the resize must not diverge from the pipeline's."""
-    from PIL import Image
-
+    """Decode (data/sources.py load_image_file — the same decode the
+    training pipeline uses), then apply the SAME test-time preprocessing
+    the model was trained/evaluated with (data/augment.py
+    preprocess_test: half-pixel-center bilinear resize + [-1, 1]
+    normalize — reference main.py:47-50)."""
     from cyclegan_tpu.data.augment import preprocess_test
+    from cyclegan_tpu.data.sources import load_image_file
 
-    raw = np.asarray(Image.open(path).convert("RGB"), np.uint8)
-    return preprocess_test(raw, size)
+    return preprocess_test(load_image_file(path), size)
 
 
 def save_image(path: str, x: np.ndarray) -> None:
@@ -50,17 +49,46 @@ def main(args: argparse.Namespace) -> None:
     ensure_platform_from_env()
     import jax
 
-    from cyclegan_tpu.config import Config, ModelConfig, TrainConfig
+    from cyclegan_tpu.config import Config, TrainConfig
     from cyclegan_tpu.train import create_state
     from cyclegan_tpu.train.state import build_models
     from cyclegan_tpu.utils.checkpoint import Checkpointer
 
+    # Self-describing checkpoints: the slot's meta.json records the model
+    # architecture at save time, so the right network is rebuilt without
+    # the user re-specifying --filters etc. Each explicitly-passed CLI
+    # flag overrides ONLY its own field; everything else defers to the
+    # recorded values (or the class defaults for legacy sidecars).
+    import dataclasses
+
+    ckpt = Checkpointer(args.output_dir)
+    model_cfg = Config.model_from_meta(ckpt.read_meta())
+    if args.image_size is not None:
+        model_cfg = dataclasses.replace(model_cfg, image_size=args.image_size)
+    if args.scan_blocks:
+        model_cfg = dataclasses.replace(model_cfg, scan_blocks=True)
+    if args.filters is not None:
+        model_cfg = dataclasses.replace(
+            model_cfg,
+            generator=dataclasses.replace(
+                model_cfg.generator, filters=args.filters
+            ),
+            discriminator=dataclasses.replace(
+                model_cfg.discriminator, filters=args.filters
+            ),
+        )
+    if args.residual_blocks is not None:
+        model_cfg = dataclasses.replace(
+            model_cfg,
+            generator=dataclasses.replace(
+                model_cfg.generator, num_residual_blocks=args.residual_blocks
+            ),
+        )
     config = Config(
-        model=ModelConfig(image_size=args.image_size, scan_blocks=args.scan_blocks),
+        model=model_cfg,
         train=TrainConfig(output_dir=args.output_dir),
     )
     state = create_state(config, jax.random.PRNGKey(config.train.seed))
-    ckpt = Checkpointer(args.output_dir)
     state, _, resumed = ckpt.restore_if_exists(state)
     if not resumed:
         raise SystemExit(f"no checkpoint under {args.output_dir}/checkpoints")
@@ -132,9 +160,18 @@ if __name__ == "__main__":
     p.add_argument("--input", required=True, help="image file or directory")
     p.add_argument("--output", required=True, help="directory for translated PNGs")
     p.add_argument("--direction", default="AtoB", choices=["AtoB", "BtoA"])
-    p.add_argument("--image_size", default=256, type=int)
+    p.add_argument("--image_size", default=None, type=int,
+                   help="inference size (default: the size recorded in the "
+                        "checkpoint meta, else 256)")
     p.add_argument("--scan_blocks", action="store_true",
-                   help="checkpoint was trained with --scan_blocks (stacked trunk)")
+                   help="checkpoint was trained with --scan_blocks (stacked "
+                        "trunk) — only needed for legacy checkpoints whose "
+                        "meta.json predates architecture recording")
+    p.add_argument("--filters", default=None, type=int,
+                   help="generator/discriminator base filters — only needed "
+                        "for legacy checkpoints without recorded architecture")
+    p.add_argument("--residual_blocks", default=None, type=int,
+                   help="generator trunk depth — legacy checkpoints only")
     p.add_argument("--batch_size", default=8, type=int)
     p.add_argument("--panels", action="store_true",
                    help="also save [input | translated | cycled] panels")
