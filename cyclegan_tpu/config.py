@@ -141,6 +141,41 @@ class Config:
         return {"model": dataclasses.asdict(self.model)}
 
     @staticmethod
+    def model_from_cli_and_meta(
+        meta: dict,
+        image_size: Optional[int] = None,
+        scan_blocks: bool = False,
+        filters: Optional[int] = None,
+        residual_blocks: Optional[int] = None,
+    ) -> ModelConfig:
+        """The shared CLI contract of translate.py / evaluate.py /
+        convert.py: rebuild the architecture from the checkpoint sidecar,
+        then apply ONLY the explicitly-passed flags field-by-field (each
+        unset flag defers to the recorded value — or the class default
+        for legacy sidecars that predate architecture recording)."""
+        cfg = Config.model_from_meta(meta)
+        if image_size is not None:
+            cfg = dataclasses.replace(cfg, image_size=image_size)
+        if scan_blocks:
+            cfg = dataclasses.replace(cfg, scan_blocks=True)
+        if filters is not None:
+            cfg = dataclasses.replace(
+                cfg,
+                generator=dataclasses.replace(cfg.generator, filters=filters),
+                discriminator=dataclasses.replace(
+                    cfg.discriminator, filters=filters
+                ),
+            )
+        if residual_blocks is not None:
+            cfg = dataclasses.replace(
+                cfg,
+                generator=dataclasses.replace(
+                    cfg.generator, num_residual_blocks=residual_blocks
+                ),
+            )
+        return cfg
+
+    @staticmethod
     def model_from_meta(meta: dict, **overrides) -> ModelConfig:
         """Rebuild a ModelConfig from `model_meta` output (tolerates
         missing/legacy sidecars and unknown keys from future versions);

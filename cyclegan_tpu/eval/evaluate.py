@@ -117,29 +117,37 @@ def evaluate_fid(config, state, data, feature_extractor) -> Dict[str, float]:
 
 def main(args: argparse.Namespace) -> None:
     ensure_platform_from_env()
-    from cyclegan_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+    from cyclegan_tpu.config import Config, DataConfig, TrainConfig
     from cyclegan_tpu.data import build_data
     from cyclegan_tpu.eval.features import build_feature_extractor
     from cyclegan_tpu.train import create_state
     from cyclegan_tpu.utils.checkpoint import Checkpointer
 
-    # Mirror main.py's geometry derivation so a checkpoint trained at
-    # --image_size N is evaluated at the same resolution.
+    # Architecture from the self-describing checkpoint sidecar (the same
+    # contract translate.py uses), with the same legacy-override flags;
+    # the data geometry below mirrors main.py's derivation.
+    ckpt = Checkpointer(args.output_dir)
+    model_cfg = Config.model_from_cli_and_meta(
+        ckpt.read_meta(),
+        image_size=args.image_size,
+        scan_blocks=args.scan_blocks,
+        filters=args.filters,
+        residual_blocks=args.residual_blocks,
+    )
     config = Config(
-        model=ModelConfig(image_size=args.image_size),
+        model=model_cfg,
         data=DataConfig(
             dataset=args.dataset,
             data_dir=args.data_dir,
             source=args.data_source,
-            crop_size=args.image_size,
-            resize_size=int(args.image_size * 286 / 256),
+            crop_size=model_cfg.image_size,
+            resize_size=int(model_cfg.image_size * 286 / 256),
             synthetic_test_size=args.synthetic_test_size,
         ),
         train=TrainConfig(output_dir=args.output_dir),
     )
     data = build_data(config, global_batch_size=args.batch_size)
     state = create_state(config, jax.random.PRNGKey(config.train.seed))
-    ckpt = Checkpointer(args.output_dir)
     state, _, resumed = ckpt.restore_if_exists(state)
     if not resumed:
         print(f"WARNING: no checkpoint under {args.output_dir}; evaluating init weights")
@@ -157,7 +165,16 @@ if __name__ == "__main__":
     p.add_argument("--data_source", default="auto",
                    choices=["auto", "tfds", "folder", "synthetic"])
     p.add_argument("--batch_size", default=8, type=int)
-    p.add_argument("--image_size", default=256, type=int)
+    p.add_argument("--image_size", default=None, type=int,
+                   help="evaluation resolution (default: the size recorded "
+                        "in the checkpoint meta, else 256)")
+    p.add_argument("--scan_blocks", action="store_true",
+                   help="legacy checkpoints only (meta.json predates "
+                        "architecture recording)")
+    p.add_argument("--filters", default=None, type=int,
+                   help="legacy checkpoints only")
+    p.add_argument("--residual_blocks", default=None, type=int,
+                   help="legacy checkpoints only")
     p.add_argument("--features", default="auto", choices=["auto", "random", "inception"])
     p.add_argument("--feature_weights", default=None)
     p.add_argument("--synthetic_test_size", default=16, type=int)

@@ -52,26 +52,44 @@ def main(args: argparse.Namespace) -> None:
     ensure_platform_from_env()
     import jax
 
-    from cyclegan_tpu.config import Config, ModelConfig, TrainConfig
+    from cyclegan_tpu.config import Config, TrainConfig
     from cyclegan_tpu.train import create_state
     from cyclegan_tpu.utils.checkpoint import Checkpointer
 
-    # The checkpoint on disk is in the SOURCE layout; build the template
-    # accordingly, convert in memory, save back in the target layout.
-    src_scanned = args.to == "unrolled"
-    config = Config(
-        model=ModelConfig(image_size=args.image_size, scan_blocks=src_scanned),
-        train=TrainConfig(output_dir=args.output_dir),
-    )
+    import dataclasses
+
+    # The checkpoint on disk is in the SOURCE layout; its architecture
+    # (filters, depth, recorded scan_blocks) comes from the sidecar when
+    # present, so non-default models convert without extra flags. The
+    # template uses the source layout; the rewritten sidecar records the
+    # TARGET layout so translate/evaluate keep auto-detecting correctly.
     ckpt = Checkpointer(args.output_dir)
     if not ckpt.exists():
         raise SystemExit(f"no checkpoint under {args.output_dir}/checkpoints")
+    src_scanned = args.to == "unrolled"
+    meta = ckpt.read_meta()
+    model_cfg = Config.model_from_meta(
+        meta,
+        **({"image_size": args.image_size} if args.image_size else {}),
+    )
+    if "model" in meta and model_cfg.scan_blocks == (args.to == "scanned"):
+        raise SystemExit(
+            f"{ckpt.slot} already records the {args.to} trunk layout — "
+            "nothing to convert"
+        )
+    config = Config(
+        model=dataclasses.replace(model_cfg, scan_blocks=src_scanned),
+        train=TrainConfig(output_dir=args.output_dir),
+    )
     template = create_state(config, jax.random.PRNGKey(config.train.seed))
     state, next_epoch = ckpt.restore(template)
 
     n = config.model.generator.num_residual_blocks
     state = convert_state_trunk(state, n, args.to)
-    ckpt.save(state, next_epoch - 1)
+    target_cfg = config.replace(
+        model=dataclasses.replace(config.model, scan_blocks=not src_scanned)
+    )
+    ckpt.save(state, next_epoch - 1, meta=target_cfg.model_meta())
     ckpt.close()
     print(f"converted {ckpt.slot} to {args.to} trunk layout")
 
@@ -80,5 +98,8 @@ if __name__ == "__main__":
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--output_dir", default="runs")
     p.add_argument("--to", required=True, choices=["scanned", "unrolled"])
-    p.add_argument("--image_size", default=256, type=int)
+    p.add_argument("--image_size", default=None, type=int,
+                   help="override the size recorded in the checkpoint meta "
+                        "(fully-convolutional nets: affects nothing but the "
+                        "recorded metadata)")
     main(p.parse_args())

@@ -83,6 +83,22 @@ def test_convert_cli_roundtrip(tmp_path):
     assert r.returncode == 0, r.stderr[-2000:]
     assert "converted" in r.stdout
 
+    # The sidecar now records the TARGET layout (self-describing slots).
+    import json
+
+    with open(os.path.join(out, "checkpoints", "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["model"]["scan_blocks"] is True
+
+    # Converting to the layout the sidecar already records refuses cleanly.
+    r = subprocess.run(
+        [sys.executable, "-m", "cyclegan_tpu.utils.convert", "--output_dir", out,
+         "--to", "scanned"],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT, timeout=600,
+    )
+    assert r.returncode != 0
+    assert "already records" in (r.stdout + r.stderr)
+
     r = subprocess.run(base + ["--epochs", "2", "--scan_blocks"],
                        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
                        timeout=600)

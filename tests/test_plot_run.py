@@ -1,0 +1,44 @@
+"""tools/plot_run.py: scalar read-back and curve rendering round trip."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+))
+
+from plot_run import plot, read_scalars  # noqa: E402
+
+
+@pytest.fixture
+def run_dir(tmp_path):
+    from cyclegan_tpu.utils.summary import Summary
+
+    s = Summary(str(tmp_path))
+    for epoch in range(5):
+        s.scalar("fid/G_vs_B", 1.0 / (epoch + 1), step=epoch)
+        s.scalar("loss_G/total", 2.0 - epoch * 0.1, step=epoch, training=True)
+    s.close()
+    return str(tmp_path)
+
+
+def test_read_scalars_round_trip(run_dir):
+    series = read_scalars(run_dir)
+    assert "fid/G_vs_B" in series
+    steps, values = zip(*series["fid/G_vs_B"])
+    assert steps == (0, 1, 2, 3, 4)
+    assert values[0] == pytest.approx(1.0) and values[4] == pytest.approx(0.2)
+
+
+def test_plot_renders_matching_tags(run_dir, tmp_path):
+    out = str(tmp_path / "curve.png")
+    chosen = plot(read_scalars(run_dir), ["fid/.*"], out)
+    assert chosen == ["fid/G_vs_B"]
+    assert os.path.getsize(out) > 1000
+
+
+def test_plot_unmatched_tags_fail_loudly(run_dir, tmp_path):
+    with pytest.raises(SystemExit):
+        plot(read_scalars(run_dir), ["nope/.*"], str(tmp_path / "x.png"))

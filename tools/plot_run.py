@@ -1,0 +1,90 @@
+"""Plot scalar curves from a training run's TensorBoard event files.
+
+Offline matplotlib rendering of any logged scalar (loss_*, error/*,
+fid/*, perf/*) straight from `<output_dir>`'s event files — no
+TensorBoard server needed. Used to produce the committed FID-vs-epoch
+curves in docs/images/.
+
+Usage:
+  python tools/plot_run.py --run /tmp/toyrun --tags "fid/.*" \
+      --out docs/images/toy_fid_curve.png --title "FID vs epoch"
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import re
+import struct
+from collections import defaultdict
+
+
+def read_scalars(run_dir: str) -> dict:
+    """{tag: [(step, value), ...]} from every event file under run_dir
+    (tensorboardX record format: u64 length, u32 crc, payload, u32 crc)."""
+    from tensorboardX.proto import event_pb2
+
+    series = defaultdict(list)
+    for path in sorted(glob.glob(os.path.join(run_dir, "**", "events.out.tfevents.*"),
+                                 recursive=True)):
+        with open(path, "rb") as f:
+            data = f.read()
+        i = 0
+        while i + 12 <= len(data):
+            (length,) = struct.unpack_from("<Q", data, i)
+            i += 12
+            if i + length > len(data):
+                break  # truncated tail (live run): keep what parsed
+            ev = event_pb2.Event()
+            ev.ParseFromString(data[i:i + length])
+            i += length + 4
+            for v in ev.summary.value:
+                if v.HasField("simple_value"):
+                    series[v.tag].append((int(ev.step), float(v.simple_value)))
+    return {k: sorted(vs) for k, vs in series.items()}
+
+
+def plot(series: dict, tags: list, out: str, title: str = "",
+         logy: bool = False) -> list:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    patterns = [re.compile(t) for t in tags]
+    chosen = sorted(
+        tag for tag in series if any(p.fullmatch(tag) for p in patterns)
+    )
+    if not chosen:
+        raise SystemExit(
+            f"no tags match {tags}; available: {sorted(series)[:20]} ..."
+        )
+    fig, ax = plt.subplots(figsize=(7, 4))
+    for tag in chosen:
+        steps, values = zip(*series[tag])
+        ax.plot(steps, values, label=tag, linewidth=1.5)
+    ax.set_xlabel("epoch")
+    if logy:
+        ax.set_yscale("log")
+    if title:
+        ax.set_title(title)
+    ax.legend(fontsize=8)
+    ax.grid(alpha=0.3)
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    fig.savefig(out, dpi=120)
+    print(f"plotted {len(chosen)} series -> {out}")
+    return chosen
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--run", required=True, help="training output dir")
+    p.add_argument("--tags", nargs="+", required=True,
+                   help="regex(es) matched against full scalar tags")
+    p.add_argument("--out", required=True, help="destination PNG")
+    p.add_argument("--title", default="")
+    p.add_argument("--logy", action="store_true")
+    a = p.parse_args()
+    plot(read_scalars(a.run), a.tags, a.out, a.title, a.logy)

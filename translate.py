@@ -56,34 +56,16 @@ def main(args: argparse.Namespace) -> None:
 
     # Self-describing checkpoints: the slot's meta.json records the model
     # architecture at save time, so the right network is rebuilt without
-    # the user re-specifying --filters etc. Each explicitly-passed CLI
-    # flag overrides ONLY its own field; everything else defers to the
-    # recorded values (or the class defaults for legacy sidecars).
-    import dataclasses
-
+    # the user re-specifying --filters etc. Explicitly-passed CLI flags
+    # override field-by-field (Config.model_from_cli_and_meta).
     ckpt = Checkpointer(args.output_dir)
-    model_cfg = Config.model_from_meta(ckpt.read_meta())
-    if args.image_size is not None:
-        model_cfg = dataclasses.replace(model_cfg, image_size=args.image_size)
-    if args.scan_blocks:
-        model_cfg = dataclasses.replace(model_cfg, scan_blocks=True)
-    if args.filters is not None:
-        model_cfg = dataclasses.replace(
-            model_cfg,
-            generator=dataclasses.replace(
-                model_cfg.generator, filters=args.filters
-            ),
-            discriminator=dataclasses.replace(
-                model_cfg.discriminator, filters=args.filters
-            ),
-        )
-    if args.residual_blocks is not None:
-        model_cfg = dataclasses.replace(
-            model_cfg,
-            generator=dataclasses.replace(
-                model_cfg.generator, num_residual_blocks=args.residual_blocks
-            ),
-        )
+    model_cfg = Config.model_from_cli_and_meta(
+        ckpt.read_meta(),
+        image_size=args.image_size,
+        scan_blocks=args.scan_blocks,
+        filters=args.filters,
+        residual_blocks=args.residual_blocks,
+    )
     config = Config(
         model=model_cfg,
         train=TrainConfig(output_dir=args.output_dir),
