@@ -120,6 +120,12 @@ class TrainConfig:
     # reference's per-step host loop. Epoch remainders (< K full batches)
     # run through the single-step program for exact semantics.
     steps_per_dispatch: int = 1
+    # TPU knob (no reference counterpart): gradient accumulation. The
+    # effective global batch becomes n_data * batch_size * grad_accum,
+    # with per-device activation memory tracking only the microbatch —
+    # exactly equal to the big-batch update (train/steps.py
+    # make_accum_train_step). Mutually exclusive with steps_per_dispatch.
+    grad_accum: int = 1
 
 
 @dataclasses.dataclass(frozen=True)

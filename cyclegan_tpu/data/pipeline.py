@@ -82,10 +82,23 @@ class _Prefetcher:
 class CycleGANData:
     """Materialized, preprocessed two-domain dataset with epoch iterators."""
 
-    def __init__(self, config: Config, global_batch_size: int, source: Optional[Source] = None):
+    def __init__(
+        self,
+        config: Config,
+        global_batch_size: int,
+        source: Optional[Source] = None,
+        test_batch_size: Optional[int] = None,
+    ):
         c = config.data
         self.config = config
         self.global_batch_size = int(global_batch_size)
+        # Eval batches may be smaller than train batches: under
+        # --grad_accum the train "batch" is the ACCUMULATED effective
+        # batch (memory-bounded by microbatching in the step), but the
+        # test/FID forwards have no microbatching — they must run at the
+        # real per-dispatch size or they would OOM exactly the configs
+        # accumulation exists for.
+        self.test_batch_size = int(test_batch_size or global_batch_size)
         self.source = source or resolve_source(c)
         self.seed = config.train.seed
 
@@ -93,7 +106,7 @@ class CycleGANData:
         self.n_test = min(self.source.split_size("testA"), self.source.split_size("testB"))
         # ceil(n / global_batch) (main.py:32-33)
         self.train_steps = math.ceil(self.n_train / self.global_batch_size)
-        self.test_steps = math.ceil(self.n_test / self.global_batch_size)
+        self.test_steps = math.ceil(self.n_test / self.test_batch_size)
 
         try:
             import jax
@@ -200,13 +213,16 @@ class CycleGANData:
         lo = self._process_index * per_host
         return idx[lo : lo + per_host]
 
-    def _batches(self, get_a, get_b, order_a: np.ndarray, order_b: np.ndarray) -> Iterator[Batch]:
+    def _batches(
+        self, get_a, get_b, order_a: np.ndarray, order_b: np.ndarray,
+        gbs: Optional[int] = None,
+    ) -> Iterator[Batch]:
         """Yield host-local (x, y, weights) batches, each the 1/P slice of
         a zero-padded static global batch. `get_a`/`get_b` map a sample
         index to a preprocessed image and are only called for indices this
         host owns (lazy: runs inside the prefetch thread, overlapping the
         device step)."""
-        gbs = self.global_batch_size
+        gbs = gbs or self.global_batch_size
         n = len(order_a)
         crop = self.config.data.crop_size
         ch = 3
@@ -254,7 +270,10 @@ class CycleGANData:
 
     def test_epoch(self, prefetch: bool = True) -> Iterator[Batch]:
         order = np.arange(self.n_test)
-        it = self._batches(self._test_a.__getitem__, self._test_b.__getitem__, order, order)
+        it = self._batches(
+            self._test_a.__getitem__, self._test_b.__getitem__, order, order,
+            gbs=self.test_batch_size,
+        )
         return iter(_Prefetcher(it)) if prefetch else it
 
     def plot_pairs(self, k: Optional[int] = None) -> List[Tuple[np.ndarray, np.ndarray]]:
@@ -280,5 +299,10 @@ class CycleGANData:
         return total
 
 
-def build_data(config: Config, global_batch_size: int) -> CycleGANData:
-    return CycleGANData(config, global_batch_size)
+def build_data(
+    config: Config, global_batch_size: int,
+    test_batch_size: Optional[int] = None,
+) -> CycleGANData:
+    return CycleGANData(
+        config, global_batch_size, test_batch_size=test_batch_size
+    )

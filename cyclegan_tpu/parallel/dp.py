@@ -140,6 +140,22 @@ def shard_multi_train_step(plan: MeshPlan, train_step: Callable, k: int) -> Call
     )
 
 
+def shard_accum_train_step(plan: MeshPlan, accum_step: Callable) -> Callable:
+    """Jit a gradient-accumulation step (train/steps.py
+    make_accum_train_step) over the mesh: microbatch axis [K] unsharded,
+    each microbatch batch-sharded over "data" exactly like a plain step —
+    so per-device peak activation memory is the MICRO batch while the
+    update sees the full effective batch."""
+    rep = replicated(plan)
+    bs, ws = _stacked_shardings(plan)
+    return jax.jit(
+        accum_step,
+        in_shardings=(rep, bs, bs, ws),
+        out_shardings=(rep, rep),
+        donate_argnums=(0,),
+    )
+
+
 def shard_test_step(plan: MeshPlan, test_step: Callable) -> Callable:
     rep = replicated(plan)
     bs = batch_sharding(plan)

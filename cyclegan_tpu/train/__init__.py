@@ -2,6 +2,7 @@
 
 from cyclegan_tpu.train.state import CycleGANState, create_state, build_models
 from cyclegan_tpu.train.steps import (
+    make_accum_train_step,
     make_train_step,
     make_test_step,
     make_cycle_step,
@@ -11,6 +12,7 @@ __all__ = [
     "CycleGANState",
     "create_state",
     "build_models",
+    "make_accum_train_step",
     "make_train_step",
     "make_test_step",
     "make_cycle_step",

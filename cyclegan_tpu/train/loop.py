@@ -69,8 +69,16 @@ def train_epoch(
     one fused DISPATCH (containing K steps): stepping it K times before a
     single dispatch would open and close the capture window before any
     device work ran.
+
+    With grad_accum A > 1, `step_fn` is the accumulation step
+    (make_accum_train_step + shard_accum_train_step): each pipeline
+    batch IS the full effective batch, reshaped here to [A, micro, ...]
+    so per-device memory tracks the microbatch while the update sees the
+    whole thing. One update per effective batch — exactly the
+    big-batch update (tests/test_accum.py).
     """
     k = config.train.steps_per_dispatch
+    accum = config.train.grad_accum
     # Deferred metric fetch: device_get per step would SYNC the host to
     # every step, serializing dispatch. Holding the (tiny scalar) device
     # arrays and fetching later keeps the dispatch pipeline async — the
@@ -110,7 +118,15 @@ def train_epoch(
             continue
         if tracer is not None:
             tracer.step()  # before dispatch: full steps land in the window
-        xs, ys, ws = shard_batch(plan, x, y, w)
+        if accum > 1:
+            xs, ys, ws = shard_stacked_batch(
+                plan,
+                x.reshape(accum, -1, *x.shape[1:]),
+                y.reshape(accum, -1, *y.shape[1:]),
+                w.reshape(accum, -1),
+            )
+        else:
+            xs, ys, ws = shard_batch(plan, x, y, w)
         state, metrics = step_fn(state, xs, ys, ws)
         append_metrics(metrics)
     # Remainder: fewer than K batches left — per-step program, exact

@@ -168,6 +168,63 @@ def make_train_step(
     return train_step
 
 
+def make_accum_train_step(
+    config: Config, global_batch_size: int, accum_steps: int
+) -> Callable:
+    """Gradient-accumulation train step: ONE optimizer update from
+    `accum_steps` microbatches, exactly equal to the single-big-batch
+    step (tests/test_accum.py pins this).
+
+    Why exact, not approximate: every loss already scales as
+    sum(w * per_sample) / global_batch_size (losses.py, reference
+    main.py:172-174), so with `global_batch_size` set to the FULL
+    effective batch, each microbatch contributes its exact share and the
+    K summed gradients ARE the big-batch gradient — linearity, no
+    averaging heuristics. Instance norm keeps statistics per-sample, so
+    (unlike batch norm) microbatching changes no normalizer semantics.
+
+    TPU rationale: peak activation memory scales with the microbatch, so
+    effective batches far beyond HBM fit; the scan keeps ONE compiled
+    program (static shapes, compiler-friendly control flow).
+
+    Returned fn: (state, xs, ys, ws) with leading [K] microbatch axis
+    (xs: [K, micro, H, W, C]) -> (state, metrics) where metrics are the
+    exact full-batch scalars.
+    """
+    grad_fn = make_grad_fn(config, global_batch_size)
+    update = make_update_fn(config)
+
+    def accum_step(
+        state: CycleGANState, xs: jnp.ndarray, ys: jnp.ndarray, ws: jnp.ndarray
+    ) -> Tuple[CycleGANState, Metrics]:
+        params = (state.g_params, state.f_params, state.dx_params, state.dy_params)
+
+        def one(mx, my, mw):
+            return grad_fn(*params, mx, my, mw)
+
+        # Shape-only trace for the zero initializers (no FLOPs).
+        g_shape, m_shape = jax.eval_shape(one, xs[0], ys[0], ws[0])
+        zeros = lambda t: jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), t
+        )
+
+        def body(carry, inp):
+            acc_g, acc_m = carry
+            grads, metrics = one(*inp)
+            return (
+                jax.tree.map(jnp.add, acc_g, grads),
+                jax.tree.map(jnp.add, acc_m, metrics),
+            ), None
+
+        (grads, metrics), _ = jax.lax.scan(
+            body, (zeros(g_shape), zeros(m_shape)), (xs, ys, ws),
+            length=accum_steps,
+        )
+        return update(state, grads), metrics
+
+    return accum_step
+
+
 def make_cycle_step(config: Config):
     """x -> G -> fake_y -> F -> cycle_x; y -> F -> fake_x -> G -> cycle_y
     (reference main.py:197-205)."""

@@ -93,17 +93,32 @@ def main(args: argparse.Namespace) -> None:
             verbose=args.verbose,
             clear_output_dir=args.clear_output_dir,
             steps_per_dispatch=args.steps_per_dispatch,
+            grad_accum=args.grad_accum,
         ),
     )
+    if config.train.grad_accum < 1 or config.train.steps_per_dispatch < 1:
+        raise SystemExit("--grad_accum and --steps_per_dispatch must be >= 1")
+    if config.train.grad_accum > 1 and config.train.steps_per_dispatch > 1:
+        raise SystemExit(
+            "--grad_accum and --steps_per_dispatch are mutually exclusive "
+            "(one fuses updates, the other splits one update)"
+        )
 
     np.random.seed(config.train.seed)
 
     # Device mesh — replaces MirroredStrategy (reference main.py:370-373).
+    # With --grad_accum A the EFFECTIVE global batch is A x bigger: the
+    # pipeline yields effective batches, losses scale by the effective
+    # size, and the accum step sees [A, micro] stacks (loop.py).
     plan = make_mesh_plan(config.parallel)
-    global_batch_size = plan.n_data * config.train.batch_size
+    global_batch_size = (
+        plan.n_data * config.train.batch_size * config.train.grad_accum
+    )
     if primary:
         print(f"Devices: {plan.n_devices} ({plan.n_data} data x {plan.n_spatial} spatial), "
-              f"global batch size: {global_batch_size}")
+              f"global batch size: {global_batch_size}"
+              + (f" ({config.train.grad_accum}x accumulated)"
+                 if config.train.grad_accum > 1 else ""))
 
     # Utilization accounting for the perf/* scalars: per-image step FLOPs
     # and the mesh's aggregate bf16 peak (None off-TPU / unknown chips).
@@ -117,7 +132,12 @@ def main(args: argparse.Namespace) -> None:
     peak_tflops = per_chip * plan.n_devices if per_chip else None
 
     summary = make_summary(config.train.output_dir, primary)
-    data = build_data(config, global_batch_size)
+    # Test/FID forwards have no microbatching, so they run at the real
+    # per-dispatch batch (the training microbatch) — under --grad_accum
+    # the effective train batch would OOM exactly the configs
+    # accumulation exists for.
+    eval_batch_size = plan.n_data * config.train.batch_size
+    data = build_data(config, global_batch_size, test_batch_size=eval_batch_size)
     if primary:
         print(f"Dataset {data.source.name}: {data.n_train} train / {data.n_test} test pairs, "
               f"{data.train_steps} train steps, {data.test_steps} test steps per epoch")
@@ -132,8 +152,19 @@ def main(args: argparse.Namespace) -> None:
     if resumed and primary:
         print(f"Resumed from {ckpt.slot} at epoch {start_epoch}")
 
-    step = make_train_step(config, global_batch_size)
-    train_step = shard_train_step(plan, step)
+    if config.train.grad_accum > 1:
+        from cyclegan_tpu.parallel.dp import shard_accum_train_step
+        from cyclegan_tpu.train import make_accum_train_step
+
+        train_step = shard_accum_train_step(
+            plan,
+            make_accum_train_step(
+                config, global_batch_size, config.train.grad_accum
+            ),
+        )
+    else:
+        step = make_train_step(config, global_batch_size)
+        train_step = shard_train_step(plan, step)
     multi_step = None
     if config.train.steps_per_dispatch > 1:
         from cyclegan_tpu.parallel.dp import shard_multi_train_step
@@ -143,7 +174,7 @@ def main(args: argparse.Namespace) -> None:
         multi_step = shard_multi_train_step(
             plan, step, config.train.steps_per_dispatch
         )
-    test_step = shard_test_step(plan, make_test_step(config, global_batch_size))
+    test_step = shard_test_step(plan, make_test_step(config, eval_batch_size))
     cycle_step = jax.jit(make_cycle_step(config))
 
     # Periodic FID (the north-star quality metric — BASELINE.md; the
@@ -262,6 +293,13 @@ if __name__ == "__main__":
                              "param layout (convert with models.stack_trunk_params)")
     parser.add_argument("--spatial_parallelism", default=1, type=int,
                         help="shard the image H axis over this many mesh columns")
+    parser.add_argument("--grad_accum", default=1, type=int, metavar="A",
+                        help="gradient accumulation: one optimizer update "
+                             "from A microbatches — effective global batch "
+                             "A x n_data x batch_size with per-device memory "
+                             "tracking only the microbatch; exactly the "
+                             "big-batch update (instance norm keeps "
+                             "per-sample statistics)")
     parser.add_argument("--steps_per_dispatch", default=1, type=int,
                         help="fuse this many train steps into one lax.scan "
                              "dispatch (amortizes host->device latency; "

@@ -152,3 +152,25 @@ def test_synthetic_source_deterministic():
     np.testing.assert_array_equal(s1.load("trainA", 0), s2.load("trainA", 0))
     assert not np.array_equal(s1.load("trainA", 0), s1.load("trainA", 1))
     assert not np.array_equal(s1.load("trainA", 0), s1.load("trainB", 0))
+
+
+def test_separate_test_batch_size():
+    """Under --grad_accum the train batch is the effective (accumulated)
+    batch, but eval forwards have no microbatching: test_epoch must use
+    its own smaller batch size."""
+    cfg = Config(
+        data=DataConfig(
+            source="synthetic", resize_size=20, crop_size=16,
+            synthetic_train_size=8, synthetic_test_size=6,
+        ),
+        train=TrainConfig(batch_size=8),
+    )
+    data = build_data(cfg, global_batch_size=8, test_batch_size=2)
+    assert data.train_steps == 1
+    assert data.test_steps == 3  # ceil(6 / 2), not ceil(6 / 8)
+    train_batches = list(data.train_epoch(0, prefetch=False))
+    test_batches = list(data.test_epoch(prefetch=False))
+    assert train_batches[0][0].shape[0] == 8
+    assert len(test_batches) == 3
+    assert all(b[0].shape[0] == 2 for b in test_batches)
+    assert sum(int(b[2].sum()) for b in test_batches) == 6

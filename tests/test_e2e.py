@@ -78,3 +78,21 @@ def test_main_scan_blocks_bf16(tmp_path):
     r2 = run_main(out, extra=("--scan_blocks", "--bf16"))
     assert r2.returncode == 0, f"stdout:\n{r2.stdout}\nstderr:\n{r2.stderr}"
     assert "Resumed" in r2.stdout
+
+
+@pytest.mark.slow
+def test_main_grad_accum_cli(tmp_path):
+    """--grad_accum A through the CLI: effective batch = A x batch,
+    accumulated updates, normal artifacts; mutually exclusive with
+    --steps_per_dispatch."""
+    out = tmp_path / "run"
+    r = run_main(out, extra=("--grad_accum", "2"))
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "global batch size: 4 (2x accumulated)" in r.stdout
+    assert (out / "checkpoints" / "checkpoint").is_dir()
+    assert "MAE(X, F(G(X)))" in r.stdout
+
+    r = run_main(tmp_path / "bad",
+                 extra=("--grad_accum", "2", "--steps_per_dispatch", "2"))
+    assert r.returncode != 0
+    assert "mutually exclusive" in (r.stdout + r.stderr)

@@ -19,7 +19,10 @@ def run_dir(tmp_path):
     s = Summary(str(tmp_path))
     for epoch in range(5):
         s.scalar("fid/G_vs_B", 1.0 / (epoch + 1), step=epoch)
+        # Same tag through BOTH writers (exactly what the epoch loops do
+        # with every loss scalar).
         s.scalar("loss_G/total", 2.0 - epoch * 0.1, step=epoch, training=True)
+        s.scalar("loss_G/total", 3.0 - epoch * 0.1, step=epoch, training=False)
     s.close()
     return str(tmp_path)
 
@@ -30,6 +33,17 @@ def test_read_scalars_round_trip(run_dir):
     steps, values = zip(*series["fid/G_vs_B"])
     assert steps == (0, 1, 2, 3, 4)
     assert values[0] == pytest.approx(1.0) and values[4] == pytest.approx(0.2)
+
+
+def test_train_and_test_writers_stay_separate(run_dir):
+    """The test writer logs the SAME tags under <run>/test/; merging them
+    into one series would render a meaningless zigzag of both curves."""
+    series = read_scalars(run_dir)
+    train = dict(series["loss_G/total"])
+    test = dict(series["test/loss_G/total"])
+    assert train[0] == pytest.approx(2.0)
+    assert test[0] == pytest.approx(3.0)
+    assert len(series["loss_G/total"]) == 5  # 5 points, not 10 interleaved
 
 
 def test_plot_renders_matching_tags(run_dir, tmp_path):

@@ -22,12 +22,20 @@ from collections import defaultdict
 
 def read_scalars(run_dir: str) -> dict:
     """{tag: [(step, value), ...]} from every event file under run_dir
-    (tensorboardX record format: u64 length, u32 crc, payload, u32 crc)."""
+    (tensorboardX record format: u64 length, u32 crc, payload, u32 crc).
+
+    Writers live in subdirectories (the test writer logs to <run>/test/
+    with the SAME tag names as the train writer — utils/summary.py), so
+    tags from a subdirectory are prefixed with it: "loss_G/total" is the
+    train curve, "test/loss_G/total" the test curve — never interleaved.
+    """
     from tensorboardX.proto import event_pb2
 
     series = defaultdict(list)
     for path in sorted(glob.glob(os.path.join(run_dir, "**", "events.out.tfevents.*"),
                                  recursive=True)):
+        subdir = os.path.relpath(os.path.dirname(path), run_dir)
+        prefix = "" if subdir == "." else subdir.replace(os.sep, "/") + "/"
         with open(path, "rb") as f:
             data = f.read()
         i = 0
@@ -41,7 +49,9 @@ def read_scalars(run_dir: str) -> dict:
             i += length + 4
             for v in ev.summary.value:
                 if v.HasField("simple_value"):
-                    series[v.tag].append((int(ev.step), float(v.simple_value)))
+                    series[prefix + v.tag].append(
+                        (int(ev.step), float(v.simple_value))
+                    )
     return {k: sorted(vs) for k, vs in series.items()}
 
 
