@@ -92,11 +92,15 @@ def train_epoch(
         data.train_epoch(epoch), data.train_steps, "Train", config.train.verbose
     )
 
-    def append_metrics(metrics, steps: int = 1):
-        # Backpressure counts STEPS: a fused dispatch pins K input batches,
-        # so bounding dispatch count alone would let K scale the pinned HBM.
-        pending.append((metrics, steps))
-        while sum(s for _, s in pending) > max(MAX_IN_FLIGHT, steps):
+    def append_metrics(metrics, steps: int = 1, pinned: int = None):
+        # Backpressure counts PINNED BATCHES, not dispatches: a fused
+        # K-step dispatch pins K input batches, and an accumulation
+        # dispatch pins A microbatches (while unstacking as ONE metrics
+        # row) — bounding dispatch count alone would let K or A scale the
+        # pinned HBM unboundedly.
+        pinned = steps if pinned is None else pinned
+        pending.append((metrics, steps, pinned))
+        while sum(p for _, _, p in pending) > max(MAX_IN_FLIGHT, pinned):
             fetched.append(jax.device_get(pending.pop(0)))
 
     buf = []
@@ -128,7 +132,7 @@ def train_epoch(
         else:
             xs, ys, ws = shard_batch(plan, x, y, w)
         state, metrics = step_fn(state, xs, ys, ws)
-        append_metrics(metrics)
+        append_metrics(metrics, pinned=accum)
     # Remainder: fewer than K batches left — per-step program, exact
     # semantics (a zero-weight padded step would still decay Adam moments).
     for x, y, w in buf:
@@ -139,7 +143,7 @@ def train_epoch(
         append_metrics(metrics)
 
     results: Dict[str, list] = {}
-    for metrics, steps in fetched + jax.device_get(pending):
+    for metrics, steps, _ in fetched + jax.device_get(pending):
         if steps == 1:
             append_dict(results, metrics)
         else:

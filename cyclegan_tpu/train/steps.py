@@ -204,9 +204,7 @@ def make_accum_train_step(
 
         # Shape-only trace for the zero initializers (no FLOPs).
         g_shape, m_shape = jax.eval_shape(one, xs[0], ys[0], ws[0])
-        zeros = lambda t: jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype), t
-        )
+        zeros = lambda t: jax.tree.map(jnp.zeros_like, t)
 
         def body(carry, inp):
             acc_g, acc_m = carry
