@@ -148,7 +148,7 @@ def main(args: argparse.Namespace) -> None:
     )
     data = build_data(config, global_batch_size=args.batch_size)
     state = create_state(config, jax.random.PRNGKey(config.train.seed))
-    state, _, resumed = ckpt.restore_if_exists(state)
+    state, _, resumed = ckpt.restore_for_cli(state)
     if not resumed:
         print(f"WARNING: no checkpoint under {args.output_dir}; evaluating init weights")
 

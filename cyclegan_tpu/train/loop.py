@@ -27,13 +27,14 @@ from cyclegan_tpu.utils.dicts import append_dict, mean_dict
 from cyclegan_tpu.utils.summary import Summary
 
 
-# Max dispatched-but-unfetched TRAIN STEPS (not dispatches: one fused
-# dispatch carries steps_per_dispatch of them): enough lead to hide host
-# latency, small enough that pinned input batches stay a bounded slice
-# of HBM. NOTE: with steps_per_dispatch K > MAX_IN_FLIGHT the effective
-# bound is K, not this constant — at least one whole fused dispatch must
-# be allowed in flight (append_metrics uses max(MAX_IN_FLIGHT, K)), so
-# the pinned window is ~2K steps' batches in that regime.
+# Max dispatched-but-unfetched PINNED BATCHES (not dispatches: one fused
+# dispatch pins steps_per_dispatch K batches, one accumulation dispatch
+# pins grad_accum A microbatches): enough lead to hide host latency,
+# small enough that pinned input batches stay a bounded slice of HBM.
+# NOTE: with K or A > MAX_IN_FLIGHT the effective bound is that value,
+# not this constant — at least one whole dispatch must be allowed in
+# flight (append_metrics uses max(MAX_IN_FLIGHT, pinned)), so the pinned
+# window is ~2K (or ~2A) batches in that regime.
 MAX_IN_FLIGHT = 32
 
 

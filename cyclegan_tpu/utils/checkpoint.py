@@ -159,5 +159,23 @@ class Checkpointer:
             return state, epoch, True
         return template, 0, False
 
+    def restore_for_cli(
+        self, template: CycleGANState
+    ) -> Tuple[CycleGANState, int, bool]:
+        """restore_if_exists with the inference-CLI error policy shared
+        by translate.py and eval/evaluate.py: a failed restore exits with
+        the underlying error AND the likeliest cause (legacy sidecars
+        without recorded architecture need the training flags repeated)."""
+        try:
+            return self.restore_if_exists(template)
+        except Exception as e:  # orbax raises various structure/shape errors
+            raise SystemExit(
+                f"checkpoint restore failed: {type(e).__name__}: {e}\n"
+                "If the error is a parameter structure/shape mismatch, the "
+                "likeliest cause is a legacy checkpoint (saved before "
+                "meta.json recorded the architecture) — repeat the training "
+                "flags: --filters/--residual_blocks/--scan_blocks."
+            ) from e
+
     def close(self) -> None:
         self._ckptr.close()

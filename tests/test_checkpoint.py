@@ -174,3 +174,40 @@ def test_model_from_meta_tolerates_legacy_sidecar():
     assert Config.model_from_meta({"epoch": 3}) == ModelConfig()
     assert Config.model_from_meta({}) == ModelConfig()
     assert Config.model_from_meta({}, scan_blocks=True).scan_blocks
+
+
+def test_model_from_cli_and_meta_field_precedence():
+    """Each explicitly-passed flag overrides ONLY its own field; unset
+    flags defer to recorded values (the translate/evaluate/convert CLI
+    contract)."""
+    from cyclegan_tpu.config import (
+        Config,
+        DiscriminatorConfig,
+        GeneratorConfig,
+        ModelConfig,
+    )
+
+    recorded = Config(
+        model=ModelConfig(
+            generator=GeneratorConfig(filters=32, num_residual_blocks=6),
+            discriminator=DiscriminatorConfig(filters=32),
+            image_size=128,
+            scan_blocks=True,
+        )
+    ).model_meta()
+
+    # No flags: everything recorded comes back.
+    got = Config.model_from_cli_and_meta(recorded)
+    assert got.generator.filters == 32 and got.scan_blocks is True
+
+    # One flag: the OTHER recorded fields must survive (a blanket
+    # override to class defaults here once broke orbax restore).
+    got = Config.model_from_cli_and_meta(recorded, residual_blocks=4)
+    assert got.generator.num_residual_blocks == 4
+    assert got.generator.filters == 32  # NOT reset to 64
+    assert got.discriminator.filters == 32
+    assert got.image_size == 128
+
+    got = Config.model_from_cli_and_meta(recorded, filters=8)
+    assert got.generator.filters == 8 and got.discriminator.filters == 8
+    assert got.generator.num_residual_blocks == 6  # NOT reset to 9

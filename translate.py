@@ -71,7 +71,7 @@ def main(args: argparse.Namespace) -> None:
         train=TrainConfig(output_dir=args.output_dir),
     )
     state = create_state(config, jax.random.PRNGKey(config.train.seed))
-    state, _, resumed = ckpt.restore_if_exists(state)
+    state, _, resumed = ckpt.restore_for_cli(state)
     if not resumed:
         raise SystemExit(f"no checkpoint under {args.output_dir}/checkpoints")
 
