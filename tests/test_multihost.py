@@ -78,14 +78,32 @@ def _spawn_workers(port, local_devices=2, spatial=1):
     return procs
 
 
-def _collect_outputs(procs):
-    """communicate() both workers, assert success, parse the METRICS and
-    FID lines every worker prints. Kills stragglers so a failed worker
-    never leaks its coordinator port + JAX runtime."""
+# Cross-process collective setup (Gloo KV exchange, coordination-service
+# barriers) has fixed ~30s handshake deadlines; on a loaded single-core
+# host the second worker can simply not get scheduled in time. That is
+# an environment failure, not a correctness failure — retry once.
+_INIT_FLAKE_SIGNATURES = (
+    "Gloo context initialization failed",
+    "DEADLINE_EXCEEDED",
+    "Barrier timed out",
+)
+
+
+def _collect_outputs_once(procs, last_failure):
+    """communicate() both workers, parse the METRICS and FID lines every
+    worker prints. Kills stragglers so a failed worker never leaks its
+    coordinator port + JAX runtime. Returns None iff a worker died with
+    the collective-init-starvation signature (recording its output in
+    `last_failure` so exhausted retries still show real diagnostics)."""
     outs, fids = [], []
     try:
         for p in procs:
             out, err = p.communicate(timeout=600)
+            if p.returncode != 0 and any(
+                s in out + err for s in _INIT_FLAKE_SIGNATURES
+            ):
+                last_failure[:] = [out, err]
+                return None
             assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
             line = [l for l in out.splitlines() if l.startswith("METRICS ")]
             assert line, f"no METRICS line in:\n{out}"
@@ -100,10 +118,27 @@ def _collect_outputs(procs):
     return outs, fids
 
 
+def _run_workers(local_devices=2, spatial=1, retries=1):
+    last_failure: list = ["", ""]
+    for attempt in range(retries + 1):
+        procs = _spawn_workers(_free_port(), local_devices, spatial)
+        result = _collect_outputs_once(procs, last_failure)
+        if result is not None:
+            return result
+        print(f"collective init starved (attempt {attempt + 1}); retrying")
+    # Could be starvation OR a real desync that happens to hit the same
+    # barrier deadlines — surface the last worker output so a regression
+    # is debuggable rather than hidden behind 'host too loaded'.
+    pytest.fail(
+        "workers failed collective init on every attempt (loaded host? "
+        "real desync?). Last worker output:\n"
+        f"stdout:\n{last_failure[0][-3000:]}\nstderr:\n{last_failure[1][-3000:]}"
+    )
+
+
 @pytest.mark.slow
 def test_two_process_training_matches_single_process(tmp_path):
-    port = _free_port()
-    outs, fids = _collect_outputs(_spawn_workers(port))
+    outs, fids = _run_workers()
     for fid in fids:
         # Sharded accumulation + cross-host allreduce == whole-set
         # statistics, on every host — bit-preserving f64 reduction,
@@ -129,8 +164,7 @@ def test_two_process_four_device_spatial_mesh():
     runtime (VERDICT r1 asked for exactly this combination). Both
     processes must agree with each other and with a single-process
     8-device run of the same layout."""
-    port = _free_port()
-    outs, _ = _collect_outputs(_spawn_workers(port, local_devices=4, spatial=2))
+    outs, _ = _run_workers(local_devices=4, spatial=2)
     assert outs[0] == outs[1]
     ref = _single_process_reference(n_devices=8, spatial=2)
     assert set(ref) == set(outs[0])

@@ -121,7 +121,9 @@ def main(args: argparse.Namespace) -> None:
     bs = args.batch_size
     for lo in range(0, len(paths), bs):
         chunk = paths[lo : lo + bs]
-        batch = np.stack([load_image(p, args.image_size) for p in chunk])
+        # model_cfg.image_size, NOT args.image_size: the flag defaults to
+        # None (= "use the checkpoint-recorded size").
+        batch = np.stack([load_image(p, config.model.image_size) for p in chunk])
         # Pad the final chunk so there is exactly one compiled program.
         pad = bs - len(chunk)
         if pad:
