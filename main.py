@@ -152,6 +152,7 @@ def main(args: argparse.Namespace) -> None:
     if resumed and primary:
         print(f"Resumed from {ckpt.slot} at epoch {start_epoch}")
 
+    multi_step = None
     if config.train.grad_accum > 1:
         from cyclegan_tpu.parallel.dp import shard_accum_train_step
         from cyclegan_tpu.train import make_accum_train_step
@@ -165,15 +166,14 @@ def main(args: argparse.Namespace) -> None:
     else:
         step = make_train_step(config, global_batch_size)
         train_step = shard_train_step(plan, step)
-    multi_step = None
-    if config.train.steps_per_dispatch > 1:
-        from cyclegan_tpu.parallel.dp import shard_multi_train_step
+        if config.train.steps_per_dispatch > 1:
+            from cyclegan_tpu.parallel.dp import shard_multi_train_step
 
-        # Same step closure for both wrappers: the K-scanned == K-dispatched
-        # guarantee is structural, not coincidental.
-        multi_step = shard_multi_train_step(
-            plan, step, config.train.steps_per_dispatch
-        )
+            # Same step closure for both wrappers: the K-scanned ==
+            # K-dispatched guarantee is structural, not coincidental.
+            multi_step = shard_multi_train_step(
+                plan, step, config.train.steps_per_dispatch
+            )
     test_step = shard_test_step(plan, make_test_step(config, eval_batch_size))
     cycle_step = jax.jit(make_cycle_step(config))
 
