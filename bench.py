@@ -15,11 +15,17 @@ Methodology notes:
 - Synchronization is via fetching a SCALAR metric that data-depends on
   the final step (not `block_until_ready`, which some remote-device
   transports treat as dispatch-complete rather than execution-complete).
-- Two modes per config: "steps" dispatches the jitted step from Python
-  per iteration (what the epoch loop does); "scan" runs K steps inside
-  one jitted `lax.scan` over K pre-staged batches — device-resident
-  sustained throughput with zero host dispatch, the TPU-native ceiling a
-  double-buffered input pipeline approaches.
+- Three modes: "steps" dispatches the jitted step from Python per
+  iteration over device-resident inputs (isolates dispatch overhead);
+  "scan" runs K steps inside one jitted `lax.scan` over K pre-staged
+  batches — device-resident sustained throughput with zero host
+  dispatch, the TPU-native ceiling a double-buffered input pipeline
+  approaches; "dispatch" is the REAL epoch-loop contract — every timed
+  dispatch feeds fresh HOST (numpy) batches, paying the host->device
+  input transfer the training loop pays, with k>1 using the fused
+  K-step program `--steps_per_dispatch` uses (train/loop.py:109-123).
+  scan-vs-dispatch/k1 quantifies the dispatch+transfer gap; the k sweep
+  shows how much of it the fused dispatcher recovers.
 
 Tunnel-failure handling (the remote-TPU transport can wedge; observed in
 practice): the accelerator is probed in killable subprocesses in a RETRY
@@ -58,8 +64,11 @@ PROBE_WINDOW_S = max(0.0, TIME_BUDGET_S - 120.0)
 _WORKER_DONE_KEY = "__done__"
 
 
-def _probe_backend_once(timeout_s: float) -> str:
-    """Probe backend init in a SUBPROCESS; return backend name or "".
+def _probe_backend_once(timeout_s: float) -> tuple:
+    """Probe backend init in a SUBPROCESS; returns (backend_or_"",
+    timed_out) — timed_out distinguishes a genuine init hang (killed at
+    the timeout) from a child that exited on its own without reporting a
+    backend (crash/import error).
 
     A wedged remote-TPU tunnel hangs PJRT init indefinitely and
     uninterruptibly (C-level; Python signal handlers never run). A
@@ -82,9 +91,11 @@ def _probe_backend_once(timeout_s: float) -> str:
         stderr=subprocess.DEVNULL,
         start_new_session=True,
     )
+    timed_out = False
     try:
         proc.wait(timeout=timeout_s)
     except subprocess.TimeoutExpired:
+        timed_out = True
         try:
             os.killpg(proc.pid, signal.SIGKILL)  # whole session, helpers too
         except ProcessLookupError:
@@ -92,9 +103,9 @@ def _probe_backend_once(timeout_s: float) -> str:
         proc.wait()
     try:
         with open(path) as f:
-            return f.read().strip()
+            return f.read().strip(), timed_out
     except OSError:
-        return ""
+        return "", timed_out
     finally:
         try:
             os.unlink(path)
@@ -212,17 +223,12 @@ def bench_steps(compute_dtype: str, batch: int, image: int = 256,
     return 2 * batch * iters / dt  # both domains advance per step
 
 
-def bench_scan(compute_dtype: str, batch: int, image: int = 256,
-               norm_impl: str = "auto", warmup: int = 1, iters: int = 3,
-               k: int = 8):
-    """Device-resident: K steps per jitted scan over K pre-staged batches."""
+def _fused_k_step(step_fn, k: int):
+    """One jitted dispatch = k scanned train steps over stacked [k, ...]
+    batches, returning the last step's sync scalar — the program shared
+    by scan mode and dispatch mode k>1 (and semantically the
+    `--steps_per_dispatch` program, parallel/dp.py:109-134)."""
     from functools import partial
-
-    state, step_fn, (x, y, w) = _build(compute_dtype, batch, image, norm_impl)
-    rng = np.random.RandomState(1)
-    xs = jnp.asarray(rng.rand(k, batch, image, image, 3).astype(np.float32) * 2 - 1)
-    ys = jnp.asarray(rng.rand(k, batch, image, image, 3).astype(np.float32) * 2 - 1)
-    ws = jnp.ones((k, batch), jnp.float32)
 
     @partial(jax.jit, donate_argnums=(0,))
     def multi_step(state, xs, ys, ws):
@@ -230,8 +236,62 @@ def bench_scan(compute_dtype: str, batch: int, image: int = 256,
             bx, by, bw = inp
             st, m = step_fn(st, bx, by, bw)
             return st, m["loss_G/total"]
-        state, losses = jax.lax.scan(body, state, (xs, ys, ws))
+
+        state, losses = jax.lax.scan(body, state, (xs, ys, ws), length=k)
         return state, {"loss_G/total": losses[-1]}
+
+    return multi_step
+
+
+def bench_dispatch(compute_dtype: str, batch: int, image: int = 256,
+                   norm_impl: str = "auto", k: int = 1, warmup: int = 1,
+                   iters: int = 10):
+    """Epoch-loop semantics INCLUDING the input pipeline's host->device
+    transfer: every timed dispatch feeds fresh float32 NUMPY batches (the
+    dtype the prefetch thread emits, data/pipeline.py), so each dispatch
+    pays the H2D the real training loop pays. k == 1 is the per-step
+    program; k > 1 stacks k batches and runs the fused lax.scan K-step
+    program (`--steps_per_dispatch`, parallel/dp.py:109-134) — one
+    dispatch + one (k x batch) transfer per k steps."""
+    state, step_fn, _ = _build(compute_dtype, batch, image, norm_impl)
+    rng = np.random.RandomState(1)
+    lead = () if k == 1 else (k,)
+    # Two host copies alternated so the runtime can't alias/cache one
+    # buffer across dispatches.
+    batches = [
+        tuple(
+            rng.rand(*lead, batch, image, image, 3).astype(np.float32) * 2 - 1
+            for _ in range(2)
+        ) + (np.ones(lead + (batch,), np.float32),)
+        for _ in range(2)
+    ]
+
+    if k == 1:
+        step = jax.jit(step_fn, donate_argnums=(0,))
+    else:
+        step = _fused_k_step(step_fn, k)
+
+    for i in range(warmup):
+        state, metrics = step(state, *batches[i % 2])
+    _sync(metrics)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, metrics = step(state, *batches[i % 2])
+    _sync(metrics)
+    dt = time.perf_counter() - t0
+    return 2 * batch * k * iters / dt
+
+
+def bench_scan(compute_dtype: str, batch: int, image: int = 256,
+               norm_impl: str = "auto", warmup: int = 1, iters: int = 3,
+               k: int = 8):
+    """Device-resident: K steps per jitted scan over K pre-staged batches."""
+    state, step_fn, (x, y, w) = _build(compute_dtype, batch, image, norm_impl)
+    rng = np.random.RandomState(1)
+    xs = jnp.asarray(rng.rand(k, batch, image, image, 3).astype(np.float32) * 2 - 1)
+    ys = jnp.asarray(rng.rand(k, batch, image, image, 3).astype(np.float32) * 2 - 1)
+    ws = jnp.ones((k, batch), jnp.float32)
+    multi_step = _fused_k_step(step_fn, k)
 
     for _ in range(warmup):
         state, metrics = multi_step(state, xs, ys, ws)
@@ -254,21 +314,46 @@ _DEVICE_KIND = ""
 # the worker's incremental results (in-process results win on key clash).
 _WORKER_RESULTS_PATH: str | None = None
 
+# One entry per accelerator probe attempt: {"at_s": offset from process
+# start, "wait_s": ACTUAL seconds the probe took (= the allowed timeout
+# when it hung), "result": backend name, "hung" (killed at timeout), or
+# "failed" (child exited without reporting a backend)}. Emitted in the
+# JSON line so a CPU-fallback record SHOWS the attempts that were made
+# (when, how long each waited, what each saw) instead of leaving the
+# tunnel outage implicit.
+_PROBE_LOG: list = []
+
 
 def _backend() -> str:
     return _PLATFORM
 
 
-def _flops_accounting(best_ips: float, platform: str) -> dict:
+def _flops_accounting(best_ips: float, platform: str,
+                      best_key: str = "") -> dict:
     """Analytic step FLOPs -> achieved TFLOP/s (+ MFU when the chip's
-    peak is known). Pure host math — safe in signal/watchdog emitters."""
+    peak is known). Pure host math — safe in signal/watchdog emitters.
+
+    FLOPs/image follow the WINNING config's geometry: keys carry an
+    "/iSIZE" segment for non-256^2 configs (ADVICE r2 — accounting from
+    _default_config would silently mis-state MFU if e.g. a 512^2 config
+    won)."""
     try:
+        import re
+
         from cyclegan_tpu.utils.flops import (
             peak_tflops_for_device_kind,
             train_step_flops_per_image,
         )
 
-        flops_img = train_step_flops_per_image(_default_config())
+        m = re.search(r"/i(\d+)", best_key)
+        cfg = _default_config()
+        if m:
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                cfg, model=dataclasses.replace(cfg.model, image_size=int(m.group(1)))
+            )
+        flops_img = train_step_flops_per_image(cfg)
     except Exception:  # accounting must never break the emission contract
         return {}
     out = {
@@ -319,6 +404,8 @@ def _emit(results, done: bool) -> None:
                 "platform": platform}
         if note:
             line["note"] = note
+        if _PROBE_LOG:
+            line["probes"] = list(_PROBE_LOG)
         print(json.dumps(line), flush=True)
         return
     best_key = max(results, key=results.get)
@@ -334,12 +421,23 @@ def _emit(results, done: bool) -> None:
         "platform": platform,
         "all": {k: round(v, 2) for k, v in results.items()},
     }
-    line.update(_flops_accounting(best, platform))
+    line.update(_flops_accounting(best, platform, best_key))
     if note:
         line["note"] = note
+    if _PROBE_LOG:
+        line["probes"] = list(_PROBE_LOG)
     if not done:
         line["partial"] = True
     print(json.dumps(line), flush=True)
+
+
+def _config_key(c: dict) -> str:
+    key = f"{c['mode']}/{c['dtype']}/b{c['batch']}"
+    if c.get("image", 256) != 256:
+        key += f"/i{c['image']}"
+    if c["mode"] == "dispatch":
+        key += f"/k{c.get('k', 1)}"
+    return key
 
 
 def _run_configs(results: dict, configs, t_start: float, on_result=None,
@@ -348,8 +446,10 @@ def _run_configs(results: dict, configs, t_start: float, on_result=None,
     emitters). Budget check uses time since process start so a late TPU
     recovery runs the headline config and skips the rest. `on_result` is
     called after each config lands (the CPU worker flushes its file)."""
-    for mode, dtype, batch in configs:
-        key = f"{mode}/{dtype}/b{batch}"
+    for c in configs:
+        mode, dtype, batch = c["mode"], c["dtype"], c["batch"]
+        image = c.get("image", 256)
+        key = _config_key(c)
         spent = time.perf_counter() - t_start
         if results and spent > TIME_BUDGET_S:
             print(f"[{tag}] {key}: skipped (budget {TIME_BUDGET_S:.0f}s spent)",
@@ -366,12 +466,19 @@ def _run_configs(results: dict, configs, t_start: float, on_result=None,
                 # and it must land inside the worker's wait window even
                 # on a loaded host.
                 ips = bench_steps(
-                    dtype, batch, warmup=1 if on_cpu else 2,
+                    dtype, batch, image=image, warmup=1 if on_cpu else 2,
                     iters=1 if on_cpu else 10,
+                )
+            elif mode == "dispatch":
+                k = c.get("k", 1)
+                # iters scaled so every k covers >= ~10 steps on chip.
+                ips = bench_dispatch(
+                    dtype, batch, image=image, k=k, warmup=1,
+                    iters=1 if on_cpu else max(2, -(-10 // k)),
                 )
             else:
                 ips = bench_scan(
-                    dtype, batch, warmup=1,
+                    dtype, batch, image=image, warmup=1,
                     iters=1 if on_cpu else 3, k=2 if on_cpu else 8,
                 )
             results[key] = ips
@@ -383,21 +490,34 @@ def _run_configs(results: dict, configs, t_start: float, on_result=None,
                   file=sys.stderr, flush=True)
 
 
-# Two configs only: each compile through a remote-TPU tunnel can take
-# minutes, and the driver's bench window is bounded. On TPU the headline
-# config (device-resident sustained, MXU dtype; b16 measured best on the
-# chip — 95.0 img/s with the custom-VJP instance norm, vs 83 @ b8, 79 @
-# b32, 71 @ b20, 86 @ b24) runs FIRST so a late-recovering tunnel lands
-# the number that matters before the budget runs out.
+# Each compile through a remote-TPU tunnel can take minutes and the
+# driver's bench window is bounded, so the list is ordered by value. The
+# headline config (device-resident sustained, MXU dtype; b16 measured
+# best on the chip — 95.0 img/s with the custom-VJP instance norm, vs
+# 83 @ b8, 79 @ b32, 71 @ b20, 86 @ b24) runs FIRST so a late-recovering
+# tunnel lands the number that matters before the budget runs out. Then
+# the REAL-loop rows: dispatch/k1 (per-step program + H2D per batch —
+# what a user's main.py sustains with perfect prefetch) and the
+# steps_per_dispatch sweep k8/k4 quantifying how much of the scan-vs-
+# dispatch gap the fused dispatcher closes. Compile cost: dispatch/k8
+# cache-hits scan's fused program (same _fused_k_step trace), but
+# dispatch/k1 and dispatch/k4 are DISTINCT XLA programs — ~2 extra
+# multi-minute cold compiles through a slow tunnel, which is why a
+# manual warm-cache run before the driver's matters (TPU_RUNBOOK item
+# 1); budget-skip honestly drops the tail rows otherwise.
 TPU_CONFIGS = [
-    ("scan", "bfloat16", 16),
-    ("steps", "float32", 1),  # reference default: per-replica batch 1
+    {"mode": "scan", "dtype": "bfloat16", "batch": 16},
+    {"mode": "dispatch", "dtype": "bfloat16", "batch": 16, "k": 1},
+    {"mode": "dispatch", "dtype": "bfloat16", "batch": 16, "k": 8},
+    {"mode": "dispatch", "dtype": "bfloat16", "batch": 16, "k": 4},
+    # reference default: per-replica batch 1
+    {"mode": "steps", "dtype": "float32", "batch": 1},
 ]
 # On CPU the cheap per-step config leads: the scan config's 16-image
 # batches take far too long on host cores to land first.
 CPU_CONFIGS = [
-    ("steps", "float32", 1),
-    ("scan", "bfloat16", 16),
+    {"mode": "steps", "dtype": "float32", "batch": 1},
+    {"mode": "scan", "dtype": "bfloat16", "batch": 16},
 ]
 
 
@@ -485,7 +605,13 @@ def main():
         while True:
             timeout = PROBE_TIMEOUTS_S[min(attempt, len(PROBE_TIMEOUTS_S) - 1)]
             attempt += 1
-            backend = _probe_backend_once(timeout)
+            probe_at = time.perf_counter() - t_start
+            backend, timed_out = _probe_backend_once(timeout)
+            _PROBE_LOG.append({
+                "at_s": round(probe_at, 1),
+                "wait_s": round(time.perf_counter() - t_start - probe_at, 1),
+                "result": backend or ("hung" if timed_out else "failed"),
+            })
             if backend and backend != "cpu":
                 break  # healthy accelerator
             why = "hung/failed" if not backend else "jax fell back to cpu"

@@ -60,17 +60,22 @@ def main(args: argparse.Namespace) -> None:
 
     # The checkpoint on disk is in the SOURCE layout; its architecture
     # (filters, depth, recorded scan_blocks) comes from the sidecar when
-    # present, so non-default models convert without extra flags. The
-    # template uses the source layout; the rewritten sidecar records the
-    # TARGET layout so translate/evaluate keep auto-detecting correctly.
+    # present, so non-default models convert without extra flags — and
+    # from the same legacy override flags translate.py/evaluate.py take
+    # (--filters/--residual_blocks) when the sidecar predates
+    # architecture recording. The template uses the source layout; the
+    # rewritten sidecar records the TARGET layout so translate/evaluate
+    # keep auto-detecting correctly.
     ckpt = Checkpointer(args.output_dir)
     if not ckpt.exists():
         raise SystemExit(f"no checkpoint under {args.output_dir}/checkpoints")
     src_scanned = args.to == "unrolled"
     meta = ckpt.read_meta()
-    model_cfg = Config.model_from_meta(
+    model_cfg = Config.model_from_cli_and_meta(
         meta,
-        **({"image_size": args.image_size} if args.image_size else {}),
+        image_size=args.image_size,
+        filters=args.filters,
+        residual_blocks=args.residual_blocks,
     )
     if "model" in meta and model_cfg.scan_blocks == (args.to == "scanned"):
         raise SystemExit(
@@ -82,7 +87,10 @@ def main(args: argparse.Namespace) -> None:
         train=TrainConfig(output_dir=args.output_dir),
     )
     template = create_state(config, jax.random.PRNGKey(config.train.seed))
-    state, next_epoch = ckpt.restore(template)
+    # restore_for_cli: a structure mismatch (legacy sidecar + non-default
+    # architecture) exits with the legacy-flag hint instead of a raw
+    # orbax structure error.
+    state, next_epoch, _ = ckpt.restore_for_cli(template)
 
     n = config.model.generator.num_residual_blocks
     state = convert_state_trunk(state, n, args.to)
@@ -102,4 +110,9 @@ if __name__ == "__main__":
                    help="override the size recorded in the checkpoint meta "
                         "(fully-convolutional nets: affects nothing but the "
                         "recorded metadata)")
+    p.add_argument("--filters", default=None, type=int,
+                   help="generator/discriminator base filters — only needed "
+                        "for legacy checkpoints without recorded architecture")
+    p.add_argument("--residual_blocks", default=None, type=int,
+                   help="generator trunk depth — legacy checkpoints only")
     main(p.parse_args())

@@ -1,0 +1,156 @@
+"""Analytic weak-scaling model for data-parallel CycleGAN training.
+
+BASELINE.md's scaling bar (>=90% weak-scaling efficiency at global batch
+256 on a v4-32 slice) cannot be measured in this environment — one chip
+behind a tunnel, and virtual CPU devices tell nothing about ICI. This
+model predicts the efficiency from first principles so the target does
+not silently rot (companion to bench_scaling.py, which measures the same
+quantity whenever a real slice is available).
+
+Model
+-----
+Per step, each chip computes the fused train step on its local batch and
+all-reduces the four gradient trees over the "data" mesh axis
+(parallel/dp.py:73-90 — XLA inserts the collective; the reference's
+NCCL analog is /root/reference/main.py:249-260).
+
+- compute time: t_step = counted_images_per_chip / ips_1chip, with
+  ips_1chip measured (docs/BENCHMARKS.md) or scaled across chip
+  generations by peak-FLOPs ratio at equal MFU (conservative for newer
+  chips with more HBM bandwidth per FLOP).
+- comm time (no-overlap lower bound on efficiency): bidirectional-ring
+  all-reduce over ONE torus dimension,
+      t_comm = 2 * (N-1)/N * grad_bytes / B_ring,
+  B_ring = 2 links * per-link one-way bandwidth. This is pessimistic
+  twice over: XLA all-reduces over ALL torus dimensions at once (3 on
+  v4, 2 on v5e), and overlaps the collective with the tail of the
+  backward pass.
+- efficiency = t_step / (t_step + t_comm).
+
+Gradient bytes are counted from the REAL parameter trees (create_state
+under jax.eval_shape — no arrays materialized): 4 trees, f32 grads.
+
+ICI assumptions (overridable via flags; public figures):
+- v4:  3D torus, 45 GB/s one-way per link  (peak 275 bf16 TFLOP/s)
+- v5e: 2D torus, 45 GB/s one-way per link  (peak 197 bf16 TFLOP/s)
+
+Usage:
+  python scaling_model.py                   # the BASELINE v4-32 target
+  python scaling_model.py --chip v5e --devices 16
+  python scaling_model.py --link_gbps 20    # sensitivity: slower ICI
+
+Prints a per-assumption table to stderr and ONE JSON line to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# Measured single-chip throughput (docs/BENCHMARKS.md, scan/bf16/b16 on
+# the v5e chip) used to derive step time; counted images = 2 per pair.
+MEASURED_V5E_IPS = 95.0
+MEASURED_BATCH_PAIRS = 16
+
+CHIPS = {
+    # name: (bf16 peak TFLOP/s, torus dims, per-link one-way GB/s)
+    "v4": (275.0, 3, 45.0),
+    "v5e": (197.0, 2, 45.0),
+}
+
+
+def grad_bytes() -> int:
+    """f32 bytes all-reduced per step: every parameter of all 4 trees
+    (2 generators + 2 discriminators), sized from the real models."""
+    import jax
+
+    from cyclegan_tpu.config import Config
+    from cyclegan_tpu.train import create_state
+
+    cfg = Config()
+    state = jax.eval_shape(lambda: create_state(cfg, jax.random.PRNGKey(0)))
+    n = 0
+    for tree in (state.g_params, state.f_params, state.dx_params, state.dy_params):
+        n += sum(leaf.size for leaf in jax.tree.leaves(tree))
+    return 4 * n
+
+
+def predict(
+    n_devices: int,
+    batch_pairs: int,
+    chip: str,
+    link_gbps: float | None = None,
+    ips_1chip: float | None = None,
+    bytes_per_step: int | None = None,
+) -> dict:
+    """Predicted weak-scaling efficiency for an N-chip DP mesh."""
+    peak, dims, default_link = CHIPS[chip]
+    link = default_link if link_gbps is None else link_gbps
+    if ips_1chip is None:
+        # Equal-MFU scaling from the measured v5e rate.
+        ips_1chip = MEASURED_V5E_IPS * peak / CHIPS["v5e"][0]
+    d_bytes = grad_bytes() if bytes_per_step is None else bytes_per_step
+
+    counted = 2 * batch_pairs
+    t_step = counted / ips_1chip
+    b_ring = 2 * link * 1e9  # bidirectional ring over one torus dimension
+    t_comm = 2 * (n_devices - 1) / n_devices * d_bytes / b_ring
+    eff = t_step / (t_step + t_comm)
+    return {
+        "chip": chip,
+        "n_devices": n_devices,
+        "global_batch_pairs": n_devices * batch_pairs,
+        "grad_bytes_per_step": d_bytes,
+        "ips_1chip": round(ips_1chip, 1),
+        "t_step_ms": round(t_step * 1e3, 2),
+        "t_comm_ms_no_overlap": round(t_comm * 1e3, 3),
+        "predicted_efficiency": round(eff, 4),
+        "assumptions": {
+            "link_gbps_oneway": link,
+            "torus_dims_available": dims,
+            "torus_dims_used": 1,
+            "overlap": "none (lower bound)",
+        },
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--chip", default="v4", choices=sorted(CHIPS))
+    p.add_argument("--devices", default=16, type=int,
+                   help="chips in the DP mesh (v4-32 = 32 TensorCores = "
+                        "16 megacore chips)")
+    p.add_argument("--batch", default=MEASURED_BATCH_PAIRS, type=int,
+                   help="per-chip batch in pairs (16 => global 256 on 16 chips)")
+    p.add_argument("--link_gbps", default=None, type=float,
+                   help="override per-link one-way ICI GB/s (sensitivity)")
+    p.add_argument("--ips", default=None, type=float,
+                   help="override single-chip images/sec (default: measured "
+                        "95.0 on v5e, peak-ratio-scaled to --chip)")
+    args = p.parse_args()
+
+    out = predict(args.devices, args.batch, args.chip,
+                  link_gbps=args.link_gbps, ips_1chip=args.ips)
+    print(
+        f"[scaling_model] {out['chip']} x {out['n_devices']} chips, "
+        f"global batch {out['global_batch_pairs']} pairs: "
+        f"t_step {out['t_step_ms']} ms, all-reduce "
+        f"{out['grad_bytes_per_step'] / 1e6:.1f} MB -> "
+        f"{out['t_comm_ms_no_overlap']} ms (1-dim ring, no overlap) => "
+        f"efficiency {out['predicted_efficiency'] * 100:.1f}%",
+        file=sys.stderr,
+        flush=True,
+    )
+    line = {
+        "metric": "weak_scaling_efficiency_predicted",
+        "value": out["predicted_efficiency"],
+        "unit": "fraction",
+        "vs_baseline": round(out["predicted_efficiency"] / 0.90, 3),
+    }
+    line.update(out)
+    print(json.dumps(line), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -102,6 +102,60 @@ def test_emit_survives_malformed_peak_override(capsys, monkeypatch):
     assert "mfu" not in d
 
 
+def test_config_key_format():
+    assert bench._config_key(
+        {"mode": "scan", "dtype": "bfloat16", "batch": 16}
+    ) == "scan/bfloat16/b16"
+    assert bench._config_key(
+        {"mode": "dispatch", "dtype": "bfloat16", "batch": 16, "k": 8}
+    ) == "dispatch/bfloat16/b16/k8"
+    assert bench._config_key(
+        {"mode": "steps", "dtype": "float32", "batch": 4, "image": 512}
+    ) == "steps/float32/b4/i512"
+
+
+def test_flops_accounting_follows_winning_geometry():
+    """ADVICE r2: a 512^2 winner must be accounted at 512^2 FLOPs, not
+    the default 256^2 (which would overstate MFU ~4x the other way)."""
+    base = bench._flops_accounting(10.0, "cpu", "scan/bfloat16/b16")
+    big = bench._flops_accounting(10.0, "cpu", "steps/bfloat16/b4/i512")
+    assert big["flops_per_image"] > 3.5 * base["flops_per_image"]
+
+
+def test_emit_includes_probe_log(capsys, monkeypatch):
+    """A fallback emission must record the probe attempts (when, how
+    long, and what each saw) so the tunnel outage is on the record."""
+    monkeypatch.setattr(
+        bench, "_PROBE_LOG",
+        [{"at_s": 0.0, "wait_s": 150.0, "result": "hung"}],
+    )
+    bench._emit({}, done=False)
+    d = _last_json(capsys)
+    assert d["probes"][0]["result"] == "hung"
+    bench._emit({"scan/bfloat16/b16": 95.0}, done=True)  # non-empty path too
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert d["probes"][0]["wait_s"] == 150.0
+
+
+def test_bench_dispatch_smoke(monkeypatch):
+    """Flow check for the dispatch mode (host-fed batches, k=1 plain jit
+    vs k>1 fused scan) with a stub step — the real model at 256^2 is a
+    chip job."""
+    import jax.numpy as jnp
+
+    def fake_build(dtype, batch, image, norm):
+        state = jnp.zeros(())
+
+        def step_fn(st, x, y, w):
+            return st + 1.0, {"loss_G/total": st + jnp.mean(x) + jnp.mean(y)}
+
+        return state, step_fn, None
+
+    monkeypatch.setattr(bench, "_build", fake_build)
+    assert bench.bench_dispatch("float32", 2, image=8, k=1, iters=2) > 0
+    assert bench.bench_dispatch("float32", 2, image=8, k=3, iters=2) > 0
+
+
 def test_read_worker_results_tolerates_missing_and_garbage(tmp_path):
     assert bench._read_worker_results(None) == {}
     assert bench._read_worker_results(str(tmp_path / "nope.json")) == {}

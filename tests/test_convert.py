@@ -61,6 +61,43 @@ def test_conversion_preserves_training_trajectory(tiny_config):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
 
 
+def test_convert_legacy_checkpoint_flags(tiny_config, tmp_path):
+    """A pre-meta (legacy) slot holding a NON-default architecture:
+    convert without flags must exit with the legacy-flag hint (not a raw
+    orbax structure error), and must succeed when the training flags are
+    repeated — the same contract translate.py/evaluate.py honor
+    (round-2 ADVICE, convert.py)."""
+    import argparse
+    import json
+
+    import pytest
+
+    from cyclegan_tpu.utils import convert as convert_mod
+    from cyclegan_tpu.utils.checkpoint import Checkpointer
+
+    out = str(tmp_path / "legacy")
+    state = create_state(tiny_config, jax.random.PRNGKey(0))
+    ckpt = Checkpointer(out)
+    ckpt.save(state, 3)  # meta=None: epoch-only sidecar, as pre-meta slots
+    ckpt.close()
+
+    def ns(**kw):
+        base = dict(output_dir=out, to="scanned", image_size=32,
+                    filters=None, residual_blocks=None)
+        base.update(kw)
+        return argparse.Namespace(**base)
+
+    with pytest.raises(SystemExit, match="legacy checkpoint"):
+        convert_mod.main(ns())
+
+    convert_mod.main(ns(filters=4, residual_blocks=1))
+    with open(os.path.join(out, "checkpoints", "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["model"]["scan_blocks"] is True
+    assert meta["model"]["generator"]["filters"] == 4
+    assert meta["epoch"] == 3
+
+
 def test_convert_cli_roundtrip(tmp_path):
     """Train 1 tiny epoch unrolled, convert the on-disk checkpoint to
     scanned, resume with --scan_blocks: the run must pick up cleanly."""
