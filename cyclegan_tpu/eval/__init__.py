@@ -10,12 +10,17 @@ from cyclegan_tpu.eval.fid import (
     frechet_distance,
     matrix_sqrt_newton_schulz,
 )
-from cyclegan_tpu.eval.features import RandomConvFeatures, build_feature_extractor
+from cyclegan_tpu.eval.features import (
+    RandomConvFeatures,
+    RandomInceptionFeatures,
+    build_feature_extractor,
+)
 
 __all__ = [
     "FIDAccumulator",
     "frechet_distance",
     "matrix_sqrt_newton_schulz",
     "RandomConvFeatures",
+    "RandomInceptionFeatures",
     "build_feature_extractor",
 ]

@@ -175,7 +175,8 @@ if __name__ == "__main__":
                    help="legacy checkpoints only")
     p.add_argument("--residual_blocks", default=None, type=int,
                    help="legacy checkpoints only")
-    p.add_argument("--features", default="auto", choices=["auto", "random", "inception"])
+    p.add_argument("--features", default="auto",
+                   choices=["auto", "random", "random_inception", "inception"])
     p.add_argument("--feature_weights", default=None)
     p.add_argument("--synthetic_test_size", default=16, type=int)
     main(p.parse_args())

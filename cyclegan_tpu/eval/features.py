@@ -5,12 +5,21 @@ not shippable in this offline image, so the extractor is pluggable:
 
 - `InceptionFeatures`: loads InceptionV3 weights from a user-provided
   .npz file (keys documented below) when available.
-- `RandomConvFeatures`: a fixed-seed random convolutional network.
-  Random-feature Fréchet distances are a recognized proxy (they rank
-  distribution shifts monotonically even untrained); deterministic
-  across runs/hosts by construction. Scores are NOT comparable to
-  Inception-FID numbers — the harness labels which extractor produced
-  a score.
+- `RandomInceptionFeatures`: the SAME InceptionV3 pool3 architecture
+  with deterministic random (He-normal) weights — the default offline
+  proxy. Random-feature Fréchet distances are a recognized proxy (they
+  rank distribution shifts monotonically even untrained), and 48
+  layers of multi-scale structure discriminate far longer into
+  training than a shallow random net (the round-2 toy runs showed the
+  shallow proxy saturating at ~epoch 100 while the panels kept
+  improving — docs/RESULTS.md).
+- `RandomConvFeatures`: a fixed-seed shallow random CNN; much cheaper
+  per image, still available as `--fid_features random` for quick
+  loops and tests.
+
+All random-feature scores are deterministic across runs/hosts by
+construction and NOT comparable to Inception-FID numbers — the harness
+labels which extractor produced every score.
 """
 
 from __future__ import annotations
@@ -57,6 +66,69 @@ class RandomConvFeatures:
         return self._apply(self._params, images)
 
 
+class RandomInceptionFeatures:
+    """InceptionV3 pool3 with deterministic RANDOM weights (offline
+    default for `--fid_features auto` when no weights file is given).
+
+    Parameters are generated from the architecture's shape template —
+    He-normal conv kernels (variance-preserving through the ReLU
+    stack), identity batch-norm (mean 0 / var 1 / scale 1 / bias 0) —
+    seeded per-leaf by a CRC of the parameter path, so the embedding is
+    identical across processes and hosts without any weight file.
+    Construction is lazy: the ~24M-param tree is built on first use, so
+    merely selecting the extractor (CLI fallback paths) stays cheap.
+    """
+
+    name = "random_inception_v3_pool3"
+    dim = 2048
+
+    def __init__(self, seed: int = 20260731):
+        self._seed = seed
+        self._apply = None
+
+    def _materialize(self):
+        import zlib
+
+        import numpy as np
+
+        from cyclegan_tpu.eval.inception import (
+            _path_key,
+            make_pool3_apply,
+            pool3_template,
+        )
+
+        net, template = pool3_template()
+
+        def fill(path, leaf):
+            # _path_key: the SAME key convention the npz loader uses, so
+            # the per-leaf seeds are pinned to the on-disk naming.
+            key = _path_key(path)
+            kind = key.rsplit("/", 1)[-1]
+            if kind == "kernel":
+                # zlib.crc32 is stable across processes (str hash() is
+                # not under hash randomization).
+                rng = np.random.RandomState(
+                    (self._seed + zlib.crc32(key.encode())) % (2**31)
+                )
+                fan_in = int(np.prod(leaf.shape[:-1]))
+                std = np.sqrt(2.0 / max(fan_in, 1))
+                return jnp.asarray(
+                    rng.randn(*leaf.shape).astype(np.float32) * std
+                )
+            if kind in ("scale", "var"):
+                return jnp.ones(leaf.shape, jnp.float32)
+            return jnp.zeros(leaf.shape, jnp.float32)  # bias, mean
+
+        params = jax.tree_util.tree_map_with_path(fill, template)
+        self._apply = make_pool3_apply(net, params)
+
+    def __call__(self, images: jnp.ndarray) -> jnp.ndarray:
+        """images: [N, H, W, 3] in [-1, 1] -> [N, 2048]."""
+        if self._apply is None:
+            self._materialize()
+        return self._apply(images)
+
+
 class InceptionFeatures:
     """InceptionV3 pool3 features (canonical FID) from an .npz weight file.
 
@@ -72,27 +144,21 @@ class InceptionFeatures:
     dim = 2048
 
     def __init__(self, weights_path: str):
-        from cyclegan_tpu.eval.inception import InceptionV3Pool3, load_params_npz
+        from cyclegan_tpu.eval.inception import (
+            load_params_npz,
+            make_pool3_apply,
+            pool3_template,
+        )
 
         if not weights_path:
             raise NotImplementedError(
                 "InceptionV3 FID requires a weights file (--fid_feature_weights); "
-                "this offline image ships none. Use RandomConvFeatures instead."
+                "this offline image ships none. Use the random-feature "
+                "extractors (auto/random) instead."
             )
-        net = InceptionV3Pool3()
-        template = jax.eval_shape(
-            lambda: net.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
-        )
+        net, template = pool3_template()
         params = load_params_npz(weights_path, template)
-
-        @jax.jit
-        def apply(images):
-            x = jax.image.resize(
-                images, (images.shape[0], 299, 299, images.shape[-1]), "bilinear"
-            )
-            return net.apply(params, x)
-
-        self._apply = apply
+        self._apply = make_pool3_apply(net, params)
 
     def __call__(self, images: jnp.ndarray) -> jnp.ndarray:
         """images: [N, H, W, 3] in [-1, 1] -> [N, 2048]."""
@@ -102,17 +168,19 @@ class InceptionFeatures:
 def build_feature_extractor(kind: str = "auto", weights_path: Optional[str] = None):
     import sys
 
-    if kind in ("auto", "random"):
+    if kind in ("auto", "random_inception"):
         if kind == "auto" and weights_path:
             try:
                 return InceptionFeatures(weights_path)
             except (NotImplementedError, OSError, ValueError, BadZipFile) as e:
                 print(
                     f"WARNING: requested Inception weights unusable ({e}); "
-                    "falling back to random-conv features — scores are NOT "
-                    "comparable to Inception-FID numbers",
+                    "falling back to random-weight Inception features — "
+                    "scores are NOT comparable to Inception-FID numbers",
                     file=sys.stderr,
                 )
+        return RandomInceptionFeatures()
+    if kind == "random":
         return RandomConvFeatures()
     if kind == "inception":
         return InceptionFeatures(weights_path or "")

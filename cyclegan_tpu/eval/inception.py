@@ -208,6 +208,34 @@ class InceptionV3Pool3(nn.Module):
         return jnp.mean(x, axis=(1, 2))  # pool3: [N, 2048]
 
 
+def pool3_template():
+    """(net, abstract variable tree) of InceptionV3Pool3 at the 299^2
+    init geometry — jax.eval_shape, nothing materialized. The single
+    source of the template every consumer (weights loading, converter
+    validation, random-weight generation) keys against."""
+    net = InceptionV3Pool3()
+    template = jax.eval_shape(
+        lambda: net.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
+    )
+    return net, template
+
+
+def make_pool3_apply(net, params):
+    """Jitted [N, H, W, 3] in [-1, 1] -> [N, 2048] pool3 features:
+    bilinear resize to the 299^2 Inception geometry, then the forward.
+    Shared by the real-weights and random-weights extractors so their
+    preprocessing can never diverge."""
+
+    @jax.jit
+    def apply(images):
+        x = jax.image.resize(
+            images, (images.shape[0], 299, 299, images.shape[-1]), "bilinear"
+        )
+        return net.apply(params, x)
+
+    return apply
+
+
 def _path_key(path) -> str:
     """Tree path -> the on-disk '/'-joined key (DictKey/GetAttrKey/
     SequenceKey all compare by their underlying name)."""

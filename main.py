@@ -140,7 +140,8 @@ def main(args: argparse.Namespace) -> None:
     data = build_data(config, global_batch_size, test_batch_size=eval_batch_size)
     if primary:
         print(f"Dataset {data.source.name}: {data.n_train} train / {data.n_test} test pairs, "
-              f"{data.train_steps} train steps, {data.test_steps} test steps per epoch")
+              f"{data.train_steps} train steps, {data.test_steps} test steps per epoch, "
+              f"cache {data.cache_nbytes() / 1e6:.0f}MB")
 
     state = create_state(config, jax.random.PRNGKey(config.train.seed))
 
@@ -313,13 +314,18 @@ if __name__ == "__main__":
                         help="compute FID on the test split every N epochs "
                              "(and at the last) and log fid/* scalars; "
                              "0 disables. Offline images use deterministic "
-                             "random-conv features (not Inception-comparable)")
+                             "random-weight Inception features (not "
+                             "Inception-FID-comparable)")
     parser.add_argument("--fid_features", default="auto",
-                        choices=["auto", "random", "inception"])
+                        choices=["auto", "random", "random_inception",
+                                 "inception"],
+                        help="auto: real Inception weights if provided, else "
+                             "deterministic random-weight Inception; random: "
+                             "cheap shallow random CNN")
     parser.add_argument("--fid_feature_weights", default=None, metavar="NPZ",
                         help="InceptionV3 weights file for --fid_features "
-                             "auto/inception (without it, auto falls back to "
-                             "random-conv features)")
+                             "auto/inception (without it, auto uses "
+                             "random-weight Inception features)")
     parser.add_argument("--expect_partial", action="store_true",
                         help="tolerate checkpoint/model mismatches on resume: "
                              "restore matching leaves, keep fresh init for the "

@@ -85,6 +85,30 @@ def test_npz_validation_errors(tmp_path):
         load_params_npz(p2, variables)
 
 
+def test_random_inception_is_offline_default_and_deterministic():
+    """`auto` with no weights file resolves to the random-weight
+    InceptionV3 proxy (round-3 upgrade from the shallow random conv),
+    whose embedding must be identical across instances (processes/hosts
+    build their own params from the path-CRC seeds) and non-degenerate
+    through all 48 layers."""
+    from cyclegan_tpu.eval.features import (
+        RandomInceptionFeatures,
+        build_feature_extractor,
+    )
+
+    fx = build_feature_extractor("auto", None)
+    assert fx.name == "random_inception_v3_pool3"
+    rng = np.random.RandomState(0)
+    imgs = (rng.rand(2, 64, 64, 3).astype(np.float32) * 2) - 1
+    f1 = np.asarray(fx(imgs))
+    assert f1.shape == (2, 2048)
+    assert np.isfinite(f1).all()
+    assert f1.std() > 1e-4  # not collapsed by the deep ReLU stack
+    assert np.abs(f1[0] - f1[1]).max() > 1e-4  # distinguishes inputs
+    f2 = np.asarray(RandomInceptionFeatures()(imgs))
+    np.testing.assert_array_equal(f1, f2)
+
+
 def test_auto_falls_back_on_unusable_weights(tmp_path):
     """build_feature_extractor('auto', bad_path) must warn and fall back
     to random features, never crash the training run."""
@@ -93,7 +117,7 @@ def test_auto_falls_back_on_unusable_weights(tmp_path):
     p = str(tmp_path / "garbage.npz")
     np.savez(p, foo=np.zeros(3))
     fx = build_feature_extractor("auto", p)
-    assert fx.name == "random_conv_2048"
+    assert fx.name == "random_inception_v3_pool3"
 
     # A truncated/corrupt zip (np.load raises BadZipFile, not ValueError)
     # must also fall back, not abort training at startup.
@@ -101,4 +125,4 @@ def test_auto_falls_back_on_unusable_weights(tmp_path):
     with open(p2, "wb") as f:
         f.write(b"PK\x03\x04corrupt")
     fx = build_feature_extractor("auto", p2)
-    assert fx.name == "random_conv_2048"
+    assert fx.name == "random_inception_v3_pool3"

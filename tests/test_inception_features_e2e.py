@@ -87,7 +87,7 @@ def test_auto_falls_back_on_garbage_weights(tmp_path, capsys):
     bad = tmp_path / "bad.npz"
     bad.write_bytes(b"not an npz")
     fx = build_feature_extractor("auto", str(bad))
-    assert fx.name == "random_conv_2048"
+    assert fx.name == "random_inception_v3_pool3"
     # The not-Inception-comparable warning is the behavior distinguishing
     # "auto" fallback from plain "random" — it must actually be emitted.
     assert "NOT comparable" in capsys.readouterr().err

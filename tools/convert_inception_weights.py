@@ -117,15 +117,10 @@ def main(args: argparse.Namespace) -> None:
 
     ensure_platform_from_env()  # honor JAX_PLATFORMS over the axon plugin
     import os
-    import jax
-    import jax.numpy as jnp
 
-    from cyclegan_tpu.eval.inception import InceptionV3Pool3, load_params_npz
+    from cyclegan_tpu.eval.inception import load_params_npz, pool3_template
 
-    net = InceptionV3Pool3()
-    template = jax.eval_shape(
-        lambda: net.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
-    )
+    _, template = pool3_template()
     tmp = args.output + ".tmp.npz"
     np.savez(tmp, **out)
     try:
