@@ -81,6 +81,42 @@ def test_main_scan_blocks_bf16(tmp_path):
 
 
 @pytest.mark.slow
+def test_main_clear_output_dir(tmp_path):
+    """--clear_output_dir (reference main.py:359-362 rmtree semantics):
+    the output dir is wiped before training, so stale artifacts are
+    gone and the run starts FRESH instead of auto-resuming from the
+    old slot."""
+    out = tmp_path / "run"
+    r = run_main(out)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    sentinel = out / "stale.txt"
+    sentinel.write_text("x")
+    r2 = run_main(out, extra=("--clear_output_dir",))
+    assert r2.returncode == 0, f"stdout:\n{r2.stdout}\nstderr:\n{r2.stderr}"
+    assert not sentinel.exists()
+    assert "Resumed" not in r2.stdout
+
+
+@pytest.mark.slow
+def test_main_steps_per_dispatch_cli(tmp_path):
+    """--steps_per_dispatch K through the CLI: with 4 train samples at
+    batch 2, the epoch is exactly one fused K=2 dispatch (no remainder)
+    — the fused path carries the whole epoch, then a second epoch count
+    exercises resume through the multi-step wiring. Loop-level
+    equivalence to per-step is tests/test_multistep.py; this pins the
+    CLI plumbing (main.py builds BOTH the per-step and fused programs)."""
+    out = tmp_path / "run"
+    r = run_main(out, extra=("--steps_per_dispatch", "2"))
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert (out / "checkpoints" / "checkpoint").is_dir()
+    assert "MAE(X, F(G(X)))" in r.stdout
+
+    r2 = run_main(out, extra=("--steps_per_dispatch", "2", "--epochs", "2"))
+    assert r2.returncode == 0, f"stdout:\n{r2.stdout}\nstderr:\n{r2.stderr}"
+    assert "Resumed" in r2.stdout
+
+
+@pytest.mark.slow
 def test_main_grad_accum_cli(tmp_path):
     """--grad_accum A through the CLI: effective batch = A x batch,
     accumulated updates, normal artifacts; mutually exclusive with

@@ -37,7 +37,7 @@ the EXACT ledger match plus the bounded-transient design
 
 Usage:
   python tools/rehearse_data_scale.py [--data_dir /tmp/h2z_scale]
-      [--rss_budget_mb 4608] [--keep_run]
+      [--rss_budget_mb 4608] [--batch 16] [--keep_run]
 
 Prints one JSON line with the measurements (exit 1 on a failed check).
 """
@@ -155,6 +155,7 @@ def main() -> int:
     ok = ledger_mb == expected_mb and peak_mb < args.rss_budget_mb
     print(json.dumps({
         "ok": ok,
+        "batch": args.batch,
         "n_train_truncated": n_train,
         "ledger_mb": ledger_mb,
         "expected_ledger_mb": expected_mb,
