@@ -28,12 +28,15 @@ Methodology notes:
   shows how much of it the fused dispatcher recovers.
 
 Tunnel-failure handling (the remote-TPU transport can wedge; observed in
-practice): the accelerator is probed in killable subprocesses in a RETRY
-LOOP across the bench window — a tunnel that recovers minutes in still
-gets measured on chip. On the FIRST failed probe a concurrent CPU-worker
-child starts measuring a shrunk workload, so if the chip never appears
-the bench still emits an honest platform="cpu" line without having
-serialized probing behind measuring.
+practice): each probe first checks the axon loopback-relay SOCKETS
+(:8082/:8083/:8093 — jax.devices() is synthesized from the AOT topology
+and succeeds even with the relay dead, so only the sockets are a real
+liveness signal; docs/TUNNEL_POSTMORTEM.md), then inits the backend in a
+killable subprocess, in a RETRY LOOP across the bench window — a tunnel
+that recovers minutes in still gets measured on chip. On the FIRST
+failed probe a concurrent CPU-worker child starts measuring a shrunk
+workload, so if the chip never appears the bench still emits an honest
+platform="cpu" line without having serialized probing behind measuring.
 
 Prints ONE JSON line to stdout; per-config details go to stderr.
 """
@@ -81,7 +84,14 @@ def _probe_backend_once(timeout_s: float) -> tuple:
     """
     fd, path = tempfile.mkstemp(prefix="bench_probe_")
     os.close(fd)
+    repo = os.path.dirname(os.path.abspath(__file__))
     code = (
+        # Local-compile workaround mode: the sitecustomize skipped
+        # registration (PALLAS_AXON_POOL_IPS=''), so the child must
+        # register the local-compile backend itself before jax use.
+        f"import sys; sys.path.insert(0, {repo!r}); "
+        "from cyclegan_tpu.utils.axon_compat import ensure_local_compile; "
+        "ensure_local_compile(); "
         "import jax, pathlib; jax.devices(); "
         f"pathlib.Path({path!r}).write_text(jax.default_backend())"
     )
@@ -111,6 +121,61 @@ def _probe_backend_once(timeout_s: float) -> tuple:
             os.unlink(path)
         except OSError:
             pass
+
+
+def _relay_ports_status() -> dict | None:
+    """TCP-connect status of the axon loopback-relay ports, or None when
+    the env doesn't route through the relay.
+
+    Under the loopback-relay config (sitecustomize sets
+    AXON_POOL_SVC_OVERRIDE=127.0.0.1 + AXON_LOOPBACK_RELAY=1) every
+    terminal leg dials loopback: claim/session :8082, stateless :8083,
+    remote compile :8093. jax.devices() succeeds WITHOUT the relay (the
+    device list is synthesized from the AOT topology), so a backend
+    probe alone is not a liveness signal: with :8093 refused, the first
+    compile dies after a ~30 min connect-retry loop (observed
+    2026-07-31; docs/TUNNEL_POSTMORTEM.md). Checking the sockets up
+    front turns that doomed half hour into an instant, recorded
+    diagnosis."""
+    import socket
+
+    if (os.environ.get("AXON_LOOPBACK_RELAY") != "1"
+            and not os.environ.get("PALLAS_AXON_POOL_IPS")):
+        return None
+    status = {}
+    for port in (8082, 8083, 8093):
+        s = socket.socket()
+        s.settimeout(1.0)
+        try:
+            s.connect(("127.0.0.1", port))
+            status[port] = "open"
+        except OSError as e:
+            status[port] = (
+                "refused" if getattr(e, "errno", None) == 111
+                else type(e).__name__
+            )
+        finally:
+            s.close()
+    return status
+
+
+def _local_compile_mode() -> bool:
+    """Whether this process measures under the local-compile workaround
+    (cyclegan_tpu/utils/axon_compat.py): XLA compiles against the
+    in-image libtpu, only claim/execute ride the relay — so :8093 (the
+    remote-compile service) is NOT required."""
+    return os.environ.get("CYCLEGAN_AXON_LOCAL_COMPILE") == "1"
+
+
+def _relay_ok(status: dict | None) -> bool:
+    """Whether the relay legs the bench will actually use are up."""
+    if status is None:
+        return True  # not a loopback-relay environment
+    if (os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "1"
+            and not _local_compile_mode()):
+        # compile leg (:8093) + claim/execute leg (:8082)
+        return status.get(8093) == "open" and status.get(8082) == "open"
+    return status.get(8082) == "open" and status.get(8083) == "open"
 
 
 def _spawn_cpu_worker(results_path: str) -> subprocess.Popen:
@@ -602,19 +667,47 @@ def main():
         cpu_worker = None
         backend = ""
         attempt = 0
+        if _local_compile_mode() and os.environ.get("PALLAS_AXON_POOL_IPS"):
+            # Probe children would die on axon_compat's frozen-registration
+            # guard with their stderr DEVNULLed — surface the guidance
+            # here, once, where it can be seen.
+            print(
+                "[bench] CYCLEGAN_AXON_LOCAL_COMPILE=1 requires "
+                "PALLAS_AXON_POOL_IPS='' (the sitecustomize already "
+                "registered the remote-compile backend); probes will fail "
+                "until the env is fixed.",
+                file=sys.stderr, flush=True,
+            )
         while True:
             timeout = PROBE_TIMEOUTS_S[min(attempt, len(PROBE_TIMEOUTS_S) - 1)]
             attempt += 1
             probe_at = time.perf_counter() - t_start
-            backend, timed_out = _probe_backend_once(timeout)
-            _PROBE_LOG.append({
+            relay = _relay_ports_status()
+            if _relay_ok(relay):
+                backend, timed_out = _probe_backend_once(timeout)
+            else:
+                # Relay down: the backend probe would "succeed" (synthetic
+                # devices) yet every chip leg is unreachable — don't even
+                # pay the probe subprocess, record the socket states.
+                backend, timed_out = "", False
+            entry = {
                 "at_s": round(probe_at, 1),
                 "wait_s": round(time.perf_counter() - t_start - probe_at, 1),
                 "result": backend or ("hung" if timed_out else "failed"),
-            })
-            if backend and backend != "cpu":
+            }
+            if relay is not None:
+                entry["relay"] = {str(p): s for p, s in relay.items()}
+                if not _relay_ok(relay):
+                    entry["result"] = "relay-down"
+            _PROBE_LOG.append(entry)
+            if backend and backend != "cpu" and _relay_ok(relay):
                 break  # healthy accelerator
-            why = "hung/failed" if not backend else "jax fell back to cpu"
+            if relay is not None and not _relay_ok(relay):
+                why = f"loopback relay down: {relay}"
+            elif not backend:
+                why = "hung/failed"
+            else:
+                why = "jax fell back to cpu"
             print(f"[bench] probe {attempt} ({timeout:.0f}s): {why}",
                   file=sys.stderr, flush=True)
             if cpu_worker is None:
@@ -638,6 +731,12 @@ def main():
             # _emit uses them only if no chip config completes (tunnel
             # re-wedging mid-compile is the observed failure mode), and
             # labels that emission cpu.
+            if _local_compile_mode():
+                from cyclegan_tpu.utils.axon_compat import (
+                    ensure_local_compile,
+                )
+
+                ensure_local_compile()
             _run_configs(results, TPU_CONFIGS, t_start)
         else:
             print("[bench] accelerator unavailable for the whole probe "

@@ -1,0 +1,117 @@
+"""Relay-socket probing and local-compile gating in bench.py.
+
+The axon loopback relay (docs/TUNNEL_POSTMORTEM.md) carries every
+terminal leg; jax.devices() succeeds even with the relay dead (device
+list synthesized from the AOT topology), so bench.py's probe gates on
+the relay SOCKETS. These tests pin that gate's semantics: which ports
+each mode requires, what a non-relay environment looks like, and that
+the status reader reports real listeners as open.
+"""
+
+import os
+import socket
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def _clear_env(monkeypatch):
+    for var in ("AXON_LOOPBACK_RELAY", "PALLAS_AXON_POOL_IPS",
+                "PALLAS_AXON_REMOTE_COMPILE", "CYCLEGAN_AXON_LOCAL_COMPILE"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def test_status_none_outside_relay_env(monkeypatch):
+    _clear_env(monkeypatch)
+    assert bench._relay_ports_status() is None
+    assert bench._relay_ok(None) is True
+
+
+def test_status_reports_refused_ports(monkeypatch):
+    _clear_env(monkeypatch)
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    status = bench._relay_ports_status()
+    assert status is not None and set(status) == {8082, 8083, 8093}
+    # Every port gets a definite state string (open/refused/errno name).
+    assert all(isinstance(v, str) and v for v in status.values())
+
+
+def test_status_sees_real_listener(monkeypatch):
+    """A live listener on one relay port must be reported 'open'."""
+    _clear_env(monkeypatch)
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    srv = socket.socket()
+    try:
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            srv.bind(("127.0.0.1", 8093))
+        except OSError:
+            import pytest
+
+            pytest.skip("port 8093 unavailable in this environment")
+        srv.listen(4)
+        accepted = []
+
+        def accept_loop():
+            try:
+                while True:
+                    c, _ = srv.accept()
+                    accepted.append(c)
+            except OSError:
+                pass
+
+        t = threading.Thread(target=accept_loop, daemon=True)
+        t.start()
+        status = bench._relay_ports_status()
+        assert status[8093] == "open"
+    finally:
+        srv.close()
+        for c in accepted:
+            c.close()
+
+
+def test_relay_ok_remote_compile_requires_8093_and_8082(monkeypatch):
+    _clear_env(monkeypatch)
+    monkeypatch.setenv("PALLAS_AXON_REMOTE_COMPILE", "1")
+    ok = {8082: "open", 8083: "open", 8093: "open"}
+    assert bench._relay_ok(ok) is True
+    assert bench._relay_ok({**ok, 8093: "refused"}) is False
+    assert bench._relay_ok({**ok, 8082: "refused"}) is False
+    # stateless leg not required for the bench's measurement path
+    assert bench._relay_ok({**ok, 8083: "refused"}) is True
+
+
+def test_relay_ok_local_compile_skips_8093(monkeypatch):
+    """Under the local-compile workaround the remote-compile service is
+    not needed: claim (:8082) + stateless (:8083) suffice."""
+    _clear_env(monkeypatch)
+    monkeypatch.setenv("PALLAS_AXON_REMOTE_COMPILE", "1")
+    monkeypatch.setenv("CYCLEGAN_AXON_LOCAL_COMPILE", "1")
+    up_except_compile = {8082: "open", 8083: "open", 8093: "refused"}
+    assert bench._relay_ok(up_except_compile) is True
+    assert bench._relay_ok({**up_except_compile, 8082: "refused"}) is False
+    assert bench._relay_ok({**up_except_compile, 8083: "refused"}) is False
+
+
+def test_ensure_local_compile_noop_without_request(monkeypatch):
+    _clear_env(monkeypatch)
+    from cyclegan_tpu.utils import axon_compat
+
+    assert axon_compat.local_compile_requested() is False
+    assert axon_compat.ensure_local_compile() is False
+
+
+def test_register_axon_local_guards_frozen_registration(monkeypatch):
+    """With the sitecustomize's env still present, registering a second
+    (different) backend config would hit the process-wide OnceLock —
+    the helper must refuse up front with actionable guidance."""
+    from cyclegan_tpu.utils import axon_compat
+
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    import pytest
+
+    with pytest.raises(RuntimeError, match="PALLAS_AXON_POOL_IPS"):
+        axon_compat.register_axon_local(local_only=True)

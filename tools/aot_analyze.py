@@ -1,0 +1,197 @@
+"""TPU-compiler ground truth for the bench programs, obtained OFFLINE.
+
+The image carries the real XLA:TPU compiler (site-packages/libtpu). The
+axon plugin's ``local_only`` mode registers a chipless "TPU v5e" backend
+that compiles genuine TPU executables locally — no terminal, no claim,
+no network (docs/TUNNEL_POSTMORTEM.md). That turns this host into a TPU
+*compiler* workbench even while the execute tunnel is down:
+
+- ``Compiled.cost_analysis()``   — the TPU compiler's own FLOP /
+  bytes-accessed accounting for the exact programs bench.py times,
+  cross-checking cyclegan_tpu/utils/flops.py's analytic model.
+- ``Compiled.memory_analysis()`` — argument/output/temp/peak HBM sizes
+  from the compiler, replacing the hand-built 512² memory ledger in
+  docs/BENCHMARKS.md with compiler-reported numbers (is 512²/b4+remat
+  under 16G? does b6 exceed it?).
+- optimized HLO (``as_text``)    — fusion structure: how many fusions,
+  whether instance-norm moments fuse into conv epilogues (the
+  mechanism behind the 95.0-vs-86.1 img/s custom-VJP-vs-Pallas result).
+
+Run: PALLAS_AXON_POOL_IPS= python tools/aot_analyze.py [--fast]
+(the env override stops the sitecustomize from registering the
+remote-compile backend first; registration is process-frozen).
+
+Writes a JSON report to docs/aot_analysis.json and prints a summary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+T0 = time.perf_counter()
+
+
+def say(msg: str) -> None:
+    print(f"[{time.perf_counter() - T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def register_local_only() -> None:
+    from cyclegan_tpu.utils.axon_compat import register_axon_local
+
+    if not register_axon_local(local_only=True):
+        raise RuntimeError("axon plugin not present in this environment")
+
+
+def build_step(compute_dtype: str, batch: int, image: int, remat: bool = False):
+    import jax
+
+    from cyclegan_tpu.config import Config, ModelConfig, TrainConfig
+    from cyclegan_tpu.train import create_state, make_train_step
+
+    cfg = Config(
+        model=ModelConfig(
+            compute_dtype=compute_dtype, image_size=image, remat=remat
+        ),
+        train=TrainConfig(batch_size=batch),
+    )
+    # Init on CPU: local_only has no executing device, and init-time
+    # eager ops would otherwise need one. The abstract pytree is all
+    # lower() needs.
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        state = create_state(cfg, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, batch)
+    return cfg, state, step
+
+
+def analyze(tag: str, compute_dtype: str, batch: int, image: int,
+            remat: bool = False, hlo_excerpt: bool = False) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    say(f"{tag}: building")
+    cfg, state, step = build_step(compute_dtype, batch, image, remat)
+    x = jax.ShapeDtypeStruct((batch, image, image, 3), jnp.float32)
+    y = jax.ShapeDtypeStruct((batch, image, image, 3), jnp.float32)
+    w = jax.ShapeDtypeStruct((batch,), jnp.float32)
+    say(f"{tag}: lowering")
+    lowered = jax.jit(step, donate_argnums=(0,)).lower(state, x, y, w)
+    say(f"{tag}: compiling (XLA:TPU via local libtpu)")
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    compile_s = time.perf_counter() - t0
+    say(f"{tag}: compiled in {compile_s:.1f}s")
+
+    out: dict = {
+        "config": {
+            "dtype": compute_dtype, "batch": batch, "image": image,
+            "remat": remat,
+        },
+        "compile_seconds": round(compile_s, 1),
+    }
+
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        out["cost_analysis"] = {
+            k: float(v)
+            for k, v in sorted(ca.items())
+            if k in ("flops", "bytes accessed", "transcendentals")
+            or k.startswith("bytes accessed")
+        }
+    except Exception as e:  # pragma: no cover - informational tool
+        out["cost_analysis_error"] = f"{type(e).__name__}: {e}"
+
+    try:
+        ma = compiled.memory_analysis()
+        for name in (
+            "argument_size_in_bytes", "output_size_in_bytes",
+            "temp_size_in_bytes", "generated_code_size_in_bytes",
+            "alias_size_in_bytes",
+        ):
+            v = getattr(ma, name, None)
+            if v is not None:
+                out.setdefault("memory_analysis", {})[name] = int(v)
+    except Exception as e:  # pragma: no cover
+        out["memory_analysis_error"] = f"{type(e).__name__}: {e}"
+
+    # Analytic cross-check from our FLOPs model (per counted image;
+    # bench counts 2 images per pair-step).
+    try:
+        from cyclegan_tpu.utils.flops import train_step_flops_per_image
+
+        analytic = train_step_flops_per_image(cfg) * 2 * batch
+        out["analytic_flops_per_step"] = float(analytic)
+        if "cost_analysis" in out and out["cost_analysis"].get("flops"):
+            out["compiler_vs_analytic_flops"] = round(
+                out["cost_analysis"]["flops"] / analytic, 4
+            )
+    except Exception as e:  # pragma: no cover
+        out["analytic_flops_error"] = f"{type(e).__name__}: {e}"
+
+    if hlo_excerpt:
+        try:
+            txt = compiled.as_text()
+            out["hlo_stats"] = {
+                "n_fusions": txt.count(" fusion("),
+                "n_convs": txt.count("convolution("),
+                "n_custom_calls": txt.count("custom-call("),
+                "n_all_reduce": txt.count("all-reduce("),
+                "chars": len(txt),
+            }
+        except Exception as e:  # pragma: no cover
+            out["hlo_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def main() -> None:
+    register_local_only()
+    say("registered local_only AOT backend")
+    import jax
+
+    say(f"devices: {jax.devices()}")
+
+    fast = "--fast" in sys.argv
+    jobs = [
+        ("scan-headline-equivalent step/bf16/b16/256", "bfloat16", 16, 256,
+         False, True),
+        ("reference-default step/f32/b1/256", "float32", 1, 256, False, False),
+    ]
+    if not fast:
+        jobs += [
+            ("longctx step/bf16/b4/512/remat", "bfloat16", 4, 512, True, False),
+            ("longctx-oom-probe step/bf16/b6/512/remat", "bfloat16", 6, 512,
+             True, False),
+        ]
+
+    report = {"host": "local libtpu AOT (chipless)", "jobs": {}}
+    for tag, dt, b, im, rm, hlo in jobs:
+        try:
+            report["jobs"][tag] = analyze(tag, dt, b, im, remat=rm,
+                                          hlo_excerpt=hlo)
+        except Exception as e:
+            say(f"{tag}: FAILED {type(e).__name__}: {e}")
+            report["jobs"][tag] = {"error": f"{type(e).__name__}: {e}"}
+
+    all_failed = all("error" in j for j in report["jobs"].values())
+    if all_failed:
+        # Never overwrite a (possibly good) committed report with pure
+        # failures, and exit nonzero so a caller can't mistake this for
+        # analysis having happened.
+        print(json.dumps(report, indent=2))
+        say("every job failed — report NOT written")
+        sys.exit(1)
+    path = os.path.join(os.path.dirname(__file__), "..", "docs",
+                        "aot_analysis.json")
+    with open(os.path.abspath(path), "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Staged TPU tunnel diagnostic: init -> tiny op -> small conv -> report.
+
+Run as the ONLY TPU process. Each stage prints a timestamped line BEFORE
+it starts, so a hang is attributable to a specific stage (init vs tiny
+compile vs realistic compile) — bench.py only reports after a whole
+config finishes, which cannot distinguish those.
+
+Usage: python tools/tpu_diag.py [--full]
+  --full additionally builds the real generator and times one forward.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+T0 = time.perf_counter()
+
+
+def say(msg: str) -> None:
+    print(f"[{time.perf_counter() - T0:8.1f}s] {msg}", flush=True)
+
+
+def main() -> None:
+    import os as _os
+    import sys as _sys
+
+    _sys.path.insert(
+        0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+    from cyclegan_tpu.utils.axon_compat import (
+        ensure_local_compile,
+        local_compile_requested,
+    )
+
+    if local_compile_requested():
+        say("registering axon LOCAL-compile backend (libtpu AOT)...")
+    if ensure_local_compile():
+        say("registered axon LOCAL-compile backend (libtpu AOT)")
+    say("importing jax")
+    import jax
+    import jax.numpy as jnp
+
+    say("jax imported; calling jax.devices() (client init / chip claim)")
+    devs = jax.devices()
+    say(f"init ok: {devs} backend={jax.default_backend()}")
+
+    say("tiny op: jit(x+1) on scalar (first compile through tunnel)")
+    f = jax.jit(lambda x: x + 1)
+    out = f(jnp.float32(1.0))
+    say("tiny op dispatched; fetching result")
+    say(f"tiny op done: {float(out)}")
+
+    say("small matmul: jit 256x256 @ 256x256 bf16")
+    g = jax.jit(lambda a, b: a @ b)
+    a = jnp.ones((256, 256), jnp.bfloat16)
+    out = g(a, a)
+    say(f"matmul done: sum={float(jnp.sum(out))}")
+
+    say("small conv: jit 1x64x64x32 NHWC conv 3x3")
+    import jax.lax as lax
+
+    def conv(x, k):
+        return lax.conv_general_dilated(
+            x, k, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    h = jax.jit(conv)
+    x = jnp.ones((1, 64, 64, 32), jnp.bfloat16)
+    k = jnp.ones((3, 3, 32, 32), jnp.bfloat16)
+    out = h(x, k)
+    say(f"conv done: mean={float(jnp.mean(out)):.2f}")
+
+    if "--full" in sys.argv:
+        say("full: building real generator fwd (batch 1, 256^2)")
+        import numpy as np
+
+        from cyclegan_tpu.config import Config, ModelConfig, TrainConfig
+        from cyclegan_tpu.train.state import build_models, create_state
+
+        cfg = Config(model=ModelConfig(compute_dtype="bfloat16"),
+                     train=TrainConfig(batch_size=1))
+        say("create_state (init programs)")
+        state = create_state(cfg, jax.random.PRNGKey(0))
+        say("state created; jit generator apply")
+        gen, _ = build_models(cfg)
+
+        @jax.jit
+        def fwd(p, x):
+            return gen.apply(p, x)
+
+        x = jnp.asarray(np.zeros((1, 256, 256, 3), np.float32))
+        out = fwd(state.g_params, x)
+        say(f"generator fwd done: {out.shape} mean={float(jnp.mean(out)):.4f}")
+
+    say("ALL STAGES OK")
+
+
+if __name__ == "__main__":
+    main()
