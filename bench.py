@@ -124,39 +124,12 @@ def _probe_backend_once(timeout_s: float) -> tuple:
 
 
 def _relay_ports_status() -> dict | None:
-    """TCP-connect status of the axon loopback-relay ports, or None when
-    the env doesn't route through the relay.
+    """Relay-socket states (see axon_compat.relay_ports_status — shared
+    with main.py's startup health check). Lazy import keeps this file's
+    probe section import-light."""
+    from cyclegan_tpu.utils.axon_compat import relay_ports_status
 
-    Under the loopback-relay config (sitecustomize sets
-    AXON_POOL_SVC_OVERRIDE=127.0.0.1 + AXON_LOOPBACK_RELAY=1) every
-    terminal leg dials loopback: claim/session :8082, stateless :8083,
-    remote compile :8093. jax.devices() succeeds WITHOUT the relay (the
-    device list is synthesized from the AOT topology), so a backend
-    probe alone is not a liveness signal: with :8093 refused, the first
-    compile dies after a ~30 min connect-retry loop (observed
-    2026-07-31; docs/TUNNEL_POSTMORTEM.md). Checking the sockets up
-    front turns that doomed half hour into an instant, recorded
-    diagnosis."""
-    import socket
-
-    if (os.environ.get("AXON_LOOPBACK_RELAY") != "1"
-            and not os.environ.get("PALLAS_AXON_POOL_IPS")):
-        return None
-    status = {}
-    for port in (8082, 8083, 8093):
-        s = socket.socket()
-        s.settimeout(1.0)
-        try:
-            s.connect(("127.0.0.1", port))
-            status[port] = "open"
-        except OSError as e:
-            status[port] = (
-                "refused" if getattr(e, "errno", None) == 111
-                else type(e).__name__
-            )
-        finally:
-            s.close()
-    return status
+    return relay_ports_status()
 
 
 def _local_compile_mode() -> bool:
@@ -164,18 +137,16 @@ def _local_compile_mode() -> bool:
     (cyclegan_tpu/utils/axon_compat.py): XLA compiles against the
     in-image libtpu, only claim/execute ride the relay — so :8093 (the
     remote-compile service) is NOT required."""
-    return os.environ.get("CYCLEGAN_AXON_LOCAL_COMPILE") == "1"
+    from cyclegan_tpu.utils.axon_compat import local_compile_requested
+
+    return local_compile_requested()
 
 
 def _relay_ok(status: dict | None) -> bool:
     """Whether the relay legs the bench will actually use are up."""
-    if status is None:
-        return True  # not a loopback-relay environment
-    if (os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "1"
-            and not _local_compile_mode()):
-        # compile leg (:8093) + claim/execute leg (:8082)
-        return status.get(8093) == "open" and status.get(8082) == "open"
-    return status.get(8082) == "open" and status.get(8083) == "open"
+    from cyclegan_tpu.utils.axon_compat import relay_ok
+
+    return relay_ok(status)
 
 
 def _spawn_cpu_worker(results_path: str) -> subprocess.Popen:

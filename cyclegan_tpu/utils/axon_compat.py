@@ -51,6 +51,77 @@ def local_compile_requested() -> bool:
     return os.environ.get("CYCLEGAN_AXON_LOCAL_COMPILE") == "1"
 
 
+def relay_ports_status() -> dict | None:
+    """TCP-connect status of the axon loopback-relay ports, or None when
+    the env doesn't route through the relay.
+
+    Under the loopback-relay config (sitecustomize sets
+    AXON_POOL_SVC_OVERRIDE=127.0.0.1 + AXON_LOOPBACK_RELAY=1) every
+    terminal leg dials loopback: claim/session :8082, stateless :8083,
+    remote compile :8093. jax.devices() succeeds WITHOUT the relay (the
+    device list is synthesized from the AOT topology), so a backend
+    probe alone is not a liveness signal: with :8093 refused, the first
+    compile dies only after a ~30 min connect-retry loop (observed
+    2026-07-31; docs/TUNNEL_POSTMORTEM.md). Checking the sockets up
+    front turns that doomed half hour into an instant diagnosis.
+    """
+    import socket
+
+    if (os.environ.get("AXON_LOOPBACK_RELAY") != "1"
+            and not os.environ.get("PALLAS_AXON_POOL_IPS")):
+        return None
+    status = {}
+    for port in (8082, 8083, 8093):
+        s = socket.socket()
+        s.settimeout(1.0)
+        try:
+            s.connect(("127.0.0.1", port))
+            status[port] = "open"
+        except OSError as e:
+            status[port] = (
+                "refused" if getattr(e, "errno", None) == 111
+                else type(e).__name__
+            )
+        finally:
+            s.close()
+    return status
+
+
+def relay_ok(status: dict | None) -> bool:
+    """Whether the relay legs chip work will actually use are up."""
+    if status is None:
+        return True  # not a loopback-relay environment
+    if (os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "1"
+            and not local_compile_requested()):
+        # compile leg (:8093) + claim/execute leg (:8082)
+        return status.get(8093) == "open" and status.get(8082) == "open"
+    return status.get(8082) == "open" and status.get(8083) == "open"
+
+
+def warn_if_relay_down(print_fn=print) -> bool:
+    """One-shot startup health check for chip-targeting CLIs.
+
+    Returns True when chip work looks viable (non-relay env, or the
+    needed relay legs are up). Otherwise prints a prominent diagnosis —
+    without it, the first jit compile appears to hang for ~30 minutes —
+    and returns False. Callers should continue anyway (the user may
+    know better; a late-starting relay is also possible).
+    """
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return True
+    status = relay_ports_status()
+    if relay_ok(status):
+        return True
+    print_fn(
+        "WARNING: the TPU loopback relay looks DOWN "
+        f"(socket states: {status}). Chip compiles/executes will hang "
+        "in multi-minute connect-retry loops. See docs/TUNNEL_POSTMORTEM.md; "
+        "run tools/tpu_diag.py to attribute, or set JAX_PLATFORMS=cpu to "
+        "train on host."
+    )
+    return False
+
+
 def register_axon_local(*, local_only: bool) -> bool:
     """Register the axon backend with LOCAL libtpu-AOT compilation.
 
