@@ -29,6 +29,13 @@ NCCL analog is /root/reference/main.py:249-260).
 
 Gradient bytes are counted from the REAL parameter trees (create_state
 under jax.eval_shape — no arrays materialized): 4 trees, f32 grads.
+Compiler cross-check (round 3): the real XLA:TPU SPMD compile of the
+sharded step on a 4-chip AOT topology emits 3 fused all-reduces with a
+158.7 MB total payload — 1.40x this model's 113.2 MB parameter-exact
+count (tools/aot_multichip.py; docs/aot_analysis.json). Use
+`--grad_bytes 158684236` to reproduce the compiler-payload variant:
+predicted v4-32 efficiency moves 99.0% -> 98.7%, comfortably above the
+>=90% bar either way (docs/BENCHMARKS.md).
 
 ICI assumptions (overridable via flags; public figures):
 - v4:  3D torus, 45 GB/s one-way per link  (peak 275 bf16 TFLOP/s)
@@ -128,10 +135,16 @@ def main() -> None:
     p.add_argument("--ips", default=None, type=float,
                    help="override single-chip images/sec (default: measured "
                         "95.0 on v5e, peak-ratio-scaled to --chip)")
+    p.add_argument("--grad_bytes", default=None, type=int,
+                   help="override all-reduced bytes/step (default: "
+                        "parameter-exact count from the real trees; pass "
+                        "158684236 for the compiler-measured payload, "
+                        "tools/aot_multichip.py)")
     args = p.parse_args()
 
     out = predict(args.devices, args.batch, args.chip,
-                  link_gbps=args.link_gbps, ips_1chip=args.ips)
+                  link_gbps=args.link_gbps, ips_1chip=args.ips,
+                  bytes_per_step=args.grad_bytes)
     print(
         f"[scaling_model] {out['chip']} x {out['n_devices']} chips, "
         f"global batch {out['global_batch_pairs']} pairs: "

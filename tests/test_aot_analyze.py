@@ -1,0 +1,49 @@
+"""Report-merge semantics of tools/aot_analyze.py.
+
+Each analysis job costs tens of minutes of XLA:TPU compile on this
+host, so the merge rules protect measured data: partial runs add to the
+report, failures never displace good entries.
+"""
+
+import importlib.util
+import os
+import sys
+
+_TOOL = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools", "aot_analyze.py")
+_spec = importlib.util.spec_from_file_location("aot_analyze", _TOOL)
+aot_analyze = importlib.util.module_from_spec(_spec)
+sys.modules["aot_analyze"] = aot_analyze
+_spec.loader.exec_module(aot_analyze)
+
+GOOD_A = {"config": {}, "compile_seconds": 1.0, "cost_analysis": {"flops": 1.0}}
+GOOD_B = {"config": {}, "compile_seconds": 2.0}
+FAIL = {"error": "Boom"}
+
+
+def test_new_jobs_are_added():
+    out = aot_analyze.merge_jobs({"a": GOOD_A}, {"b": GOOD_B})
+    assert out == {"a": GOOD_A, "b": GOOD_B}
+
+
+def test_fresh_success_replaces_prior_entry():
+    newer = dict(GOOD_A, compile_seconds=9.0)
+    out = aot_analyze.merge_jobs({"a": GOOD_A}, {"a": newer})
+    assert out["a"]["compile_seconds"] == 9.0
+
+
+def test_failure_does_not_displace_good_entry():
+    out = aot_analyze.merge_jobs({"a": GOOD_A}, {"a": FAIL})
+    assert out["a"] == GOOD_A
+
+
+def test_failure_recorded_when_no_prior_or_prior_failed():
+    assert aot_analyze.merge_jobs({}, {"a": FAIL})["a"] == FAIL
+    newer_fail = {"error": "Other"}
+    out = aot_analyze.merge_jobs({"a": FAIL}, {"a": newer_fail})
+    assert out["a"] == newer_fail
+
+
+def test_partial_run_keeps_unrun_jobs():
+    out = aot_analyze.merge_jobs({"a": GOOD_A, "b": GOOD_B}, {"a": GOOD_A})
+    assert set(out) == {"a", "b"}

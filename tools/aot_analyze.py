@@ -188,24 +188,31 @@ def main() -> None:
         sys.exit(1)
     path = os.path.abspath(os.path.join(os.path.dirname(__file__), "..",
                                         "docs", "aot_analysis.json"))
-    # MERGE into any existing report: each job costs ~10-30 min of
-    # compile; a --fast or partially-failed run must not drop
-    # previously-measured jobs, and a failed job must not replace a
-    # good entry of the same name.
     try:
         with open(path) as f:
             existing = json.load(f).get("jobs", {})
     except (OSError, ValueError):
         existing = {}
-    merged = dict(existing)
-    for tag, job in report["jobs"].items():
-        if "error" in job and "error" not in merged.get(tag, {"error": 1}):
-            continue  # keep the good prior entry
-        merged[tag] = job
-    report["jobs"] = merged
+    report["jobs"] = merge_jobs(existing, report["jobs"])
     with open(path, "w") as f:
         json.dump(report, f, indent=2)
     print(json.dumps(report, indent=2))
+
+
+def merge_jobs(existing: dict, new: dict) -> dict:
+    """Merge a run's jobs into the prior report's jobs.
+
+    Each job costs ~10-30 min of compile, so a --fast or
+    partially-failed run must not drop previously-measured jobs, and a
+    failed job must not replace a good prior entry of the same name
+    (tests/test_aot_analyze.py).
+    """
+    merged = dict(existing)
+    for tag, job in new.items():
+        if "error" in job and "error" not in merged.get(tag, {"error": 1}):
+            continue  # keep the good prior entry
+        merged[tag] = job
+    return merged
 
 
 if __name__ == "__main__":
