@@ -164,6 +164,12 @@ def register_axon_local(*, local_only: bool,
         local_only=local_only,
     )
     os.environ["JAX_PLATFORMS"] = "axon"
+    # Local AOT compiles of the big fused programs take 10-30 min on
+    # this 1-core host; the persistent cache makes every repeat (and a
+    # later chip session's local-compile path) start hot.
+    from cyclegan_tpu.utils.platform import enable_compilation_cache
+
+    enable_compilation_cache()
     return True
 
 

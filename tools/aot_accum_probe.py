@@ -1,0 +1,97 @@
+"""Compiler check of the grad-accumulation memory contract at 512².
+
+The designed use case (docs/BENCHMARKS.md memory ledger, TPU_RUNBOOK
+item 5): `--grad_accum 8` with microbatch 1 at 512² should train where
+plain batch-8 OOMs, because peak activation memory tracks the
+MICRObatch while the update sees the full effective batch
+(train/steps.py:make_accum_train_step). With the chip unreachable, the
+real XLA:TPU compiler can still adjudicate the contract offline: the
+accumulation program's compiler-reported temp HBM must sit near the
+plain microbatch program's, far below the (un-compilable-on-16G)
+big-batch program's.
+
+Run: PALLAS_AXON_POOL_IPS= python tools/aot_accum_probe.py
+Merges jobs into docs/aot_analysis.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+T0 = time.perf_counter()
+
+
+def say(msg: str) -> None:
+    print(f"[{time.perf_counter() - T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def compile_job(build):
+    from tools.aot_analyze import extract_analysis
+
+    lowered = build()
+    say("compiling")
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    compile_s = time.perf_counter() - t0
+    say(f"compiled in {compile_s:.1f}s")
+    job = {"compile_seconds": round(compile_s, 1)}
+    job.update(extract_analysis(compiled))
+    return job
+
+
+def main() -> None:
+    from cyclegan_tpu.utils.axon_compat import register_axon_local
+
+    if not register_axon_local(local_only=True):
+        raise RuntimeError("axon plugin not present in this environment")
+    say("registered local_only AOT backend")
+
+    import jax
+    import jax.numpy as jnp
+
+    from cyclegan_tpu.config import Config, ModelConfig, TrainConfig
+    from cyclegan_tpu.train import create_state
+    from cyclegan_tpu.train.steps import make_accum_train_step, make_train_step
+
+    image, accum, micro = 512, 8, 1
+    effective = accum * micro
+    cfg = Config(
+        model=ModelConfig(compute_dtype="bfloat16", image_size=image),
+        train=TrainConfig(batch_size=effective, grad_accum=accum),
+    )
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        state = create_state(cfg, jax.random.PRNGKey(0))
+
+    jobs = {}
+
+    def accum_build():
+        say("building accum program: 8 microbatches of 1 @ 512^2")
+        step = make_accum_train_step(cfg, effective, accum)
+        xs = jax.ShapeDtypeStruct((accum, micro, image, image, 3), jnp.float32)
+        ws = jax.ShapeDtypeStruct((accum, micro), jnp.float32)
+        return jax.jit(step, donate_argnums=(0,)).lower(state, xs, xs, ws)
+
+    jobs["accum-probe step/bf16/accum8xmicro1/512"] = compile_job(accum_build)
+
+    def micro_build():
+        say("building plain microbatch program: b1 @ 512^2")
+        step = make_train_step(cfg, 1)
+        x = jax.ShapeDtypeStruct((1, image, image, 3), jnp.float32)
+        w = jax.ShapeDtypeStruct((1,), jnp.float32)
+        return jax.jit(step, donate_argnums=(0,)).lower(state, x, x, w)
+
+    jobs["accum-baseline step/bf16/b1/512"] = compile_job(micro_build)
+
+    from tools.aot_analyze import merge_into_report
+
+    merge_into_report(jobs)
+    print(json.dumps(jobs, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -186,17 +186,51 @@ def main() -> None:
         print(json.dumps(report, indent=2))
         say("every job failed — report NOT written")
         sys.exit(1)
-    path = os.path.abspath(os.path.join(os.path.dirname(__file__), "..",
+    print(json.dumps(merge_into_report(report["jobs"]), indent=2))
+
+
+def extract_analysis(compiled) -> dict:
+    """Compiler cost/memory accounting for a Compiled, as report dicts.
+
+    Shared by the sibling AOT tools (aot_multichip.py,
+    aot_accum_probe.py) so the report schema has one author.
+    """
+    out: dict = {}
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    out["cost_analysis"] = {
+        k: float(v) for k, v in sorted(ca.items())
+        if k in ("flops", "bytes accessed", "transcendentals")
+    }
+    ma = compiled.memory_analysis()
+    out["memory_analysis"] = {
+        name: int(getattr(ma, name))
+        for name in ("argument_size_in_bytes", "output_size_in_bytes",
+                     "temp_size_in_bytes", "generated_code_size_in_bytes")
+        if getattr(ma, name, None) is not None
+    }
+    return out
+
+
+def report_path() -> str:
+    return os.path.abspath(os.path.join(os.path.dirname(__file__), "..",
                                         "docs", "aot_analysis.json"))
+
+
+def merge_into_report(jobs: dict, path: str | None = None) -> dict:
+    """Merge `jobs` into docs/aot_analysis.json via merge_jobs; returns
+    the written report."""
+    path = path or report_path()
     try:
         with open(path) as f:
-            existing = json.load(f).get("jobs", {})
+            report = json.load(f)
     except (OSError, ValueError):
-        existing = {}
-    report["jobs"] = merge_jobs(existing, report["jobs"])
+        report = {"host": "local libtpu AOT (chipless)", "jobs": {}}
+    report["jobs"] = merge_jobs(report.get("jobs", {}), jobs)
     with open(path, "w") as f:
         json.dump(report, f, indent=2)
-    print(json.dumps(report, indent=2))
+    return report
 
 
 def merge_jobs(existing: dict, new: dict) -> dict:

@@ -98,7 +98,13 @@ def main() -> None:
     ap.add_argument("--batch-per-chip", type=int, default=4)
     ap.add_argument("--image", type=int, default=256)
     ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--spatial", type=int, default=1,
+                    help="spatial_parallelism: shard H over this many "
+                         "chips (halo/reshard exchanges appear as whatever "
+                         "collective GSPMD picks — all-to-alls on v5e 2x2)")
     args = ap.parse_args()
+    if args.spatial < 1:
+        raise SystemExit(f"--spatial must be >= 1, got {args.spatial}")
 
     from cyclegan_tpu.utils.axon_compat import register_axon_local
 
@@ -113,19 +119,24 @@ def main() -> None:
     devs = jax.devices()
     say(f"devices: {len(devs)} x {devs[0].device_kind}")
     n = len(devs)
-    global_batch = args.batch_per_chip * n
 
-    from cyclegan_tpu.config import Config, ModelConfig, TrainConfig
+    from cyclegan_tpu.config import (
+        Config, ModelConfig, ParallelConfig, TrainConfig,
+    )
     from cyclegan_tpu.parallel import make_mesh_plan, shard_train_step
     from cyclegan_tpu.train import create_state, make_train_step
 
+    if n % args.spatial:
+        raise SystemExit(f"{n} chips not divisible by --spatial {args.spatial}")
+    global_batch = args.batch_per_chip * (n // args.spatial)
     cfg = Config(
         model=ModelConfig(compute_dtype=args.dtype, image_size=args.image),
         train=TrainConfig(batch_size=global_batch),
+        parallel=ParallelConfig(spatial_parallelism=args.spatial),
     )
     with jax.default_device(jax.local_devices(backend="cpu")[0]):
         state = create_state(cfg, jax.random.PRNGKey(0))
-    plan = make_mesh_plan(devices=devs)
+    plan = make_mesh_plan(cfg.parallel, devices=devs)
     step = shard_train_step(plan, make_train_step(cfg, global_batch))
 
     x = jax.ShapeDtypeStruct((global_batch, args.image, args.image, 3),
@@ -139,50 +150,40 @@ def main() -> None:
     compile_s = time.perf_counter() - t0
     say(f"compiled in {compile_s:.1f}s")
 
+    from tools.aot_analyze import extract_analysis, merge_into_report
+
     hlo = compiled.as_text()
-    ca = compiled.cost_analysis()
-    if isinstance(ca, (list, tuple)):
-        ca = ca[0]
-    ma = compiled.memory_analysis()
+    collectives = all_reduce_traffic(hlo)
     job = {
         "config": {
             "dtype": args.dtype, "image": args.image,
             "topology": f"{gen}:{args.topology}", "n_chips": n,
             "batch_per_chip": args.batch_per_chip,
             "global_batch": global_batch,
+            "spatial_parallelism": args.spatial,
         },
         "compile_seconds": round(compile_s, 1),
-        "cost_analysis": {
-            k: float(v) for k, v in sorted(ca.items())
-            if k in ("flops", "bytes accessed", "transcendentals")
-        },
-        "memory_analysis": {
-            name: int(getattr(ma, name))
-            for name in ("argument_size_in_bytes", "output_size_in_bytes",
-                         "temp_size_in_bytes", "generated_code_size_in_bytes")
-        },
-        "collectives": all_reduce_traffic(hlo),
+        "collectives": collectives,
         "hlo_stats": {
             "n_fusions": hlo.count(" fusion("),
             "n_convs": hlo.count("convolution("),
-            "n_all_reduce": hlo.count(" all-reduce("),
+            # Same sync+async accounting as all_reduce_traffic, so the
+            # two reported counts cannot diverge.
+            "n_all_reduce": collectives["n_all_reduce"],
             "n_collective_permute": hlo.count("collective-permute("),
+            "n_all_gather": hlo.count("all-gather("),
+            "n_reduce_scatter": hlo.count("reduce-scatter("),
+            "n_all_to_all": hlo.count("all-to-all("),
         },
     }
+    job.update(extract_analysis(compiled))
 
+    layout = "dp" if args.spatial == 1 else f"dp{n // args.spatial}xsp{args.spatial}"
+    # Topology in the tag: 2x2x1 and 4x1x1 are different programs and
+    # must not overwrite each other's measured entry.
     tag = (f"multichip step/{'bf16' if args.dtype == 'bfloat16' else args.dtype}"
-           f"/b{args.batch_per_chip}x{n}/{args.image}/dp")
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                        "docs", "aot_analysis.json")
-    path = os.path.abspath(path)
-    try:
-        with open(path) as f:
-            report = json.load(f)
-    except (OSError, ValueError):
-        report = {"host": "local libtpu AOT (chipless)", "jobs": {}}
-    report["jobs"][tag] = job
-    with open(path, "w") as f:
-        json.dump(report, f, indent=2)
+           f"/b{args.batch_per_chip}x{n}/{args.image}/{layout}/{args.topology}")
+    merge_into_report({tag: job})
     print(json.dumps({tag: job}, indent=2))
 
 
