@@ -300,9 +300,13 @@ if __name__ == "__main__":
     parser.add_argument("--remat", action="store_true",
                         help="rematerialize residual blocks (512^2 HBM relief)")
     parser.add_argument("--scan_blocks", action="store_true",
-                        help="lax.scan the residual trunk: ~9x less trunk HLO, "
-                             "faster XLA compiles; checkpoints use a stacked "
-                             "param layout (convert with models.stack_trunk_params)")
+                        help="lax.scan the residual trunk: ~1.9x faster cold "
+                             "XLA compiles (2.8x less HLO) but +69%% temp HBM "
+                             "at 256^2/b16 — the stacked loop carries pin all "
+                             "9 blocks' residuals (docs/BENCHMARKS.md); pair "
+                             "with --remat or smaller batches. Checkpoints "
+                             "use a stacked param layout (convert with "
+                             "models.stack_trunk_params)")
     parser.add_argument("--spatial_parallelism", default=1, type=int,
                         help="shard the image H axis over this many mesh columns")
     parser.add_argument("--grad_accum", default=1, type=int, metavar="A",

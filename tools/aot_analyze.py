@@ -47,7 +47,8 @@ def register_local_only() -> None:
         raise RuntimeError("axon plugin not present in this environment")
 
 
-def build_step(compute_dtype: str, batch: int, image: int, remat: bool = False):
+def build_step(compute_dtype: str, batch: int, image: int, remat: bool = False,
+               scan_blocks: bool = False):
     import jax
 
     from cyclegan_tpu.config import Config, ModelConfig, TrainConfig
@@ -55,7 +56,8 @@ def build_step(compute_dtype: str, batch: int, image: int, remat: bool = False):
 
     cfg = Config(
         model=ModelConfig(
-            compute_dtype=compute_dtype, image_size=image, remat=remat
+            compute_dtype=compute_dtype, image_size=image, remat=remat,
+            scan_blocks=scan_blocks,
         ),
         train=TrainConfig(batch_size=batch),
     )
@@ -69,12 +71,14 @@ def build_step(compute_dtype: str, batch: int, image: int, remat: bool = False):
 
 
 def analyze(tag: str, compute_dtype: str, batch: int, image: int,
-            remat: bool = False, hlo_excerpt: bool = False) -> dict:
+            remat: bool = False, scan_blocks: bool = False,
+            hlo_excerpt: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
     say(f"{tag}: building")
-    cfg, state, step = build_step(compute_dtype, batch, image, remat)
+    cfg, state, step = build_step(compute_dtype, batch, image, remat,
+                                  scan_blocks)
     x = jax.ShapeDtypeStruct((batch, image, image, 3), jnp.float32)
     y = jax.ShapeDtypeStruct((batch, image, image, 3), jnp.float32)
     w = jax.ShapeDtypeStruct((batch,), jnp.float32)
@@ -89,7 +93,7 @@ def analyze(tag: str, compute_dtype: str, batch: int, image: int,
     out: dict = {
         "config": {
             "dtype": compute_dtype, "batch": batch, "image": image,
-            "remat": remat,
+            "remat": remat, "scan_blocks": scan_blocks,
         },
         "compile_seconds": round(compile_s, 1),
     }
@@ -142,6 +146,7 @@ def analyze(tag: str, compute_dtype: str, batch: int, image: int,
                 "n_convs": txt.count("convolution("),
                 "n_custom_calls": txt.count("custom-call("),
                 "n_all_reduce": txt.count("all-reduce("),
+                "n_while": txt.count(" while("),
                 "chars": len(txt),
             }
         except Exception as e:  # pragma: no cover
@@ -159,21 +164,25 @@ def main() -> None:
     fast = "--fast" in sys.argv
     jobs = [
         ("scan-headline-equivalent step/bf16/b16/256", "bfloat16", 16, 256,
-         False, True),
-        ("reference-default step/f32/b1/256", "float32", 1, 256, False, False),
+         False, False, True),
+        ("reference-default step/f32/b1/256", "float32", 1, 256, False,
+         False, False),
     ]
     if not fast:
         jobs += [
-            ("longctx step/bf16/b4/512/remat", "bfloat16", 4, 512, True, False),
+            ("longctx step/bf16/b4/512/remat", "bfloat16", 4, 512, True,
+             False, False),
             ("longctx-oom-probe step/bf16/b6/512/remat", "bfloat16", 6, 512,
-             True, False),
+             True, False, False),
+            ("compile-time-probe step/bf16/b16/256/scan-blocks", "bfloat16",
+             16, 256, False, True, True),
         ]
 
     report = {"host": "local libtpu AOT (chipless)", "jobs": {}}
-    for tag, dt, b, im, rm, hlo in jobs:
+    for tag, dt, b, im, rm, sb, hlo in jobs:
         try:
             report["jobs"][tag] = analyze(tag, dt, b, im, remat=rm,
-                                          hlo_excerpt=hlo)
+                                          scan_blocks=sb, hlo_excerpt=hlo)
         except Exception as e:
             say(f"{tag}: FAILED {type(e).__name__}: {e}")
             report["jobs"][tag] = {"error": f"{type(e).__name__}: {e}"}
