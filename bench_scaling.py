@@ -134,6 +134,9 @@ def _emit(results, n_all, args) -> None:
 
 def main(args) -> None:
     ensure_platform_from_env()
+    from cyclegan_tpu.utils.axon_compat import cli_startup
+
+    cli_startup()  # local-compile workaround + relay diagnosis
     from cyclegan_tpu.utils.platform import enable_compilation_cache
 
     enable_compilation_cache()

@@ -117,6 +117,9 @@ def evaluate_fid(config, state, data, feature_extractor) -> Dict[str, float]:
 
 def main(args: argparse.Namespace) -> None:
     ensure_platform_from_env()
+    from cyclegan_tpu.utils.axon_compat import cli_startup
+
+    cli_startup()  # local-compile workaround + relay diagnosis
     from cyclegan_tpu.config import Config, DataConfig, TrainConfig
     from cyclegan_tpu.data import build_data
     from cyclegan_tpu.eval.features import build_feature_extractor

@@ -98,6 +98,16 @@ def relay_ok(status: dict | None) -> bool:
     return status.get(8082) == "open" and status.get(8083) == "open"
 
 
+def cli_startup() -> None:
+    """Chip-targeting CLI preamble: register the local-compile backend
+    when the workaround env requests it (no-op otherwise) and print the
+    relay diagnosis instead of letting the first compile hang ~30 min.
+    One call shared by main.py / translate.py / evaluate.py /
+    bench_scaling.py."""
+    ensure_local_compile()
+    warn_if_relay_down()
+
+
 def warn_if_relay_down(print_fn=print) -> bool:
     """One-shot startup health check for chip-targeting CLIs.
 

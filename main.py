@@ -31,17 +31,9 @@ from cyclegan_tpu.utils.platform import (
 def main(args: argparse.Namespace) -> None:
     ensure_platform_from_env()
     enable_compilation_cache()
-    # Chip-targeting runs: register the local-compile backend when the
-    # workaround env requests it (no-op otherwise), and diagnose a dead
-    # loopback relay NOW instead of letting the first jit compile hang
-    # ~30 min (docs/TUNNEL_POSTMORTEM.md).
-    from cyclegan_tpu.utils.axon_compat import (
-        ensure_local_compile,
-        warn_if_relay_down,
-    )
+    from cyclegan_tpu.utils.axon_compat import cli_startup
 
-    ensure_local_compile()
-    warn_if_relay_down()
+    cli_startup()  # local-compile workaround + relay diagnosis
     from cyclegan_tpu.config import (
         Config,
         DataConfig,

@@ -162,27 +162,27 @@ def main() -> None:
     say(f"devices: {jax.devices()}")
 
     fast = "--fast" in sys.argv
-    jobs = [
-        ("scan-headline-equivalent step/bf16/b16/256", "bfloat16", 16, 256,
-         False, False, True),
-        ("reference-default step/f32/b1/256", "float32", 1, 256, False,
-         False, False),
-    ]
+    jobs = {
+        "scan-headline-equivalent step/bf16/b16/256": dict(
+            compute_dtype="bfloat16", batch=16, image=256, hlo_excerpt=True),
+        "reference-default step/f32/b1/256": dict(
+            compute_dtype="float32", batch=1, image=256),
+    }
     if not fast:
-        jobs += [
-            ("longctx step/bf16/b4/512/remat", "bfloat16", 4, 512, True,
-             False, False),
-            ("longctx-oom-probe step/bf16/b6/512/remat", "bfloat16", 6, 512,
-             True, False, False),
-            ("compile-time-probe step/bf16/b16/256/scan-blocks", "bfloat16",
-             16, 256, False, True, True),
-        ]
+        jobs.update({
+            "longctx step/bf16/b4/512/remat": dict(
+                compute_dtype="bfloat16", batch=4, image=512, remat=True),
+            "longctx-oom-probe step/bf16/b6/512/remat": dict(
+                compute_dtype="bfloat16", batch=6, image=512, remat=True),
+            "compile-time-probe step/bf16/b16/256/scan-blocks": dict(
+                compute_dtype="bfloat16", batch=16, image=256,
+                scan_blocks=True, hlo_excerpt=True),
+        })
 
     report = {"host": "local libtpu AOT (chipless)", "jobs": {}}
-    for tag, dt, b, im, rm, sb, hlo in jobs:
+    for tag, kwargs in jobs.items():
         try:
-            report["jobs"][tag] = analyze(tag, dt, b, im, remat=rm,
-                                          scan_blocks=sb, hlo_excerpt=hlo)
+            report["jobs"][tag] = analyze(tag, **kwargs)
         except Exception as e:
             say(f"{tag}: FAILED {type(e).__name__}: {e}")
             report["jobs"][tag] = {"error": f"{type(e).__name__}: {e}"}

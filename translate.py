@@ -47,6 +47,9 @@ def save_image(path: str, x: np.ndarray) -> None:
 
 def main(args: argparse.Namespace) -> None:
     ensure_platform_from_env()
+    from cyclegan_tpu.utils.axon_compat import cli_startup
+
+    cli_startup()  # local-compile workaround + relay diagnosis
     import jax
 
     from cyclegan_tpu.config import Config, TrainConfig
