@@ -47,3 +47,20 @@ def test_failure_recorded_when_no_prior_or_prior_failed():
 def test_partial_run_keeps_unrun_jobs():
     out = aot_analyze.merge_jobs({"a": GOOD_A, "b": GOOD_B}, {"a": GOOD_A})
     assert set(out) == {"a", "b"}
+
+
+def test_warm_rerun_preserves_cold_compile_seconds():
+    """A cache-hit rerun (tiny compile_seconds) must not clobber the
+    recorded cold figure: it survives as cold_compile_seconds."""
+    prior = {"config": {}, "compile_seconds": 488.7}
+    warm = {"config": {}, "compile_seconds": 2.9}
+    out = aot_analyze.merge_jobs({"a": prior}, {"a": warm})
+    assert out["a"]["compile_seconds"] == 2.9
+    assert out["a"]["cold_compile_seconds"] == 488.7
+    # and a later, even warmer rerun keeps the original cold figure
+    out2 = aot_analyze.merge_jobs(out, {"a": {"config": {}, "compile_seconds": 1.1}})
+    assert out2["a"]["cold_compile_seconds"] == 488.7
+    # a slower (colder) rerun becomes the new reference
+    out3 = aot_analyze.merge_jobs(out, {"a": {"config": {}, "compile_seconds": 600.0}})
+    assert "cold_compile_seconds" not in out3["a"]
+    assert out3["a"]["compile_seconds"] == 600.0

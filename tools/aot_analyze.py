@@ -247,13 +247,26 @@ def merge_jobs(existing: dict, new: dict) -> dict:
 
     Each job costs ~10-30 min of compile, so a --fast or
     partially-failed run must not drop previously-measured jobs, and a
-    failed job must not replace a good prior entry of the same name
-    (tests/test_aot_analyze.py).
+    failed job must not replace a good prior entry of the same name.
+    ``compile_seconds`` records whatever cache state THIS run had; the
+    cold figure the docs cite survives reruns as
+    ``cold_compile_seconds`` (the max ever recorded — a cache-hit
+    rerun cannot clobber it). tests/test_aot_analyze.py pins all of
+    this.
     """
     merged = dict(existing)
     for tag, job in new.items():
-        if "error" in job and "error" not in merged.get(tag, {"error": 1}):
+        prior = merged.get(tag)
+        if "error" in job and not (prior is None or "error" in prior):
             continue  # keep the good prior entry
+        if prior is not None and "compile_seconds" in job:
+            cold = max(
+                job["compile_seconds"],
+                prior.get("compile_seconds", 0.0),
+                prior.get("cold_compile_seconds", 0.0),
+            )
+            if cold > job["compile_seconds"]:
+                job = dict(job, cold_compile_seconds=cold)
         merged[tag] = job
     return merged
 

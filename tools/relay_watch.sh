@@ -1,0 +1,35 @@
+#!/bin/bash
+# Passive TPU-relay watch: check the loopback relay sockets every
+# INTERVAL seconds (default 300) and log TRANSITIONS, so a session can
+# notice recovery without spawning axon clients (socket connects only —
+# never counts against the one-TPU-process rule).
+#
+# "up" means the legs chip work actually needs (axon_compat.relay_ok):
+# the claim/execute leg :8082 AND the remote-compile leg :8093 — 8093
+# alone is not enough (tests/test_relay_probe.py).
+#
+# Usage: nohup tools/relay_watch.sh [logfile] [interval_s] &
+# First healthy signal: a "RELAY UP" line — then follow
+# docs/TPU_RUNBOOK.md's queue.
+LOG="${1:-/tmp/relay_watch.log}"
+INTERVAL="${2:-300}"
+
+port_open() {
+  (exec 3<>"/dev/tcp/127.0.0.1/$1") 2>/dev/null
+}
+
+prev=""
+while true; do
+  up=down
+  if port_open 8082 && port_open 8093; then
+    up=up
+  fi
+  if [ "$up" != "$prev" ]; then
+    echo "$(date +%F\ %T) relay:$up" >> "$LOG"
+    if [ "$up" = up ]; then
+      echo "$(date +%F\ %T) RELAY UP — run docs/TPU_RUNBOOK.md queue" >> "$LOG"
+    fi
+    prev="$up"
+  fi
+  sleep "$INTERVAL"
+done
