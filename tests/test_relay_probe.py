@@ -115,3 +115,40 @@ def test_register_axon_local_guards_frozen_registration(monkeypatch):
 
     with pytest.raises(RuntimeError, match="PALLAS_AXON_POOL_IPS"):
         axon_compat.register_axon_local(local_only=True)
+
+
+def test_warn_if_relay_down_noop_on_cpu(monkeypatch):
+    from cyclegan_tpu.utils import axon_compat
+
+    _clear_env(monkeypatch)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    lines = []
+    assert axon_compat.warn_if_relay_down(print_fn=lines.append) is True
+    assert lines == []
+
+
+def test_warn_if_relay_down_diagnoses_dead_relay(monkeypatch):
+    from cyclegan_tpu.utils import axon_compat
+
+    _clear_env(monkeypatch)
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    lines = []
+    viable = axon_compat.warn_if_relay_down(print_fn=lines.append)
+    status = axon_compat.relay_ports_status()
+    if axon_compat.relay_ok(status):
+        assert viable is True and lines == []  # relay healthy in this env
+    else:
+        assert viable is False
+        assert len(lines) == 1 and "relay" in lines[0]
+        assert "TUNNEL_POSTMORTEM" in lines[0]
+
+
+def test_cli_startup_is_safe_without_axon_request(monkeypatch):
+    """cli_startup must be a no-op (no registration, no raise) in the
+    plain CPU test environment."""
+    from cyclegan_tpu.utils import axon_compat
+
+    _clear_env(monkeypatch)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    axon_compat.cli_startup()  # must not raise
