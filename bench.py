@@ -670,7 +670,26 @@ def main():
                 entry["relay"] = {str(p): s for p, s in relay.items()}
                 if not _relay_ok(relay):
                     entry["result"] = "relay-down"
-            _PROBE_LOG.append(entry)
+            # Collapse identical consecutive relay-down outcomes (instant
+            # socket probes repeat every 15 s — ~24 copies would bloat
+            # the JSON line). ONLY relay-down collapses: hung probes have
+            # escalating per-attempt waits worth recording individually.
+            # Concurrency: emitters (watchdog thread, signal handlers)
+            # shallow-copy _PROBE_LOG and serialize its dicts, so never
+            # mutate an appended entry — REPLACE the last element with a
+            # fresh dict (single atomic list-item store under the GIL;
+            # an in-flight snapshot keeps the old, never-again-touched
+            # dict).
+            prev = _PROBE_LOG[-1] if _PROBE_LOG else None
+            if (prev is not None and entry["result"] == "relay-down"
+                    and prev.get("result") == "relay-down"
+                    and prev.get("relay") == entry.get("relay")):
+                merged = dict(prev)
+                merged["repeats"] = prev.get("repeats", 1) + 1
+                merged["last_at_s"] = entry["at_s"]
+                _PROBE_LOG[-1] = merged
+            else:
+                _PROBE_LOG.append(entry)
             if backend and backend != "cpu" and _relay_ok(relay):
                 break  # healthy accelerator
             if relay is not None and not _relay_ok(relay):
