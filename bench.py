@@ -214,7 +214,8 @@ def _default_config():
     return Config(model=ModelConfig(), train=TrainConfig())
 
 
-def _build(compute_dtype: str, batch: int, image: int, norm_impl: str):
+def _build(compute_dtype: str, batch: int, image: int, norm_impl: str,
+           pad_mode: str = "reflect"):
     from cyclegan_tpu.config import Config, ModelConfig, TrainConfig
     from cyclegan_tpu.train import create_state, make_train_step
 
@@ -223,6 +224,7 @@ def _build(compute_dtype: str, batch: int, image: int, norm_impl: str):
             compute_dtype=compute_dtype,
             image_size=image,
             instance_norm_impl=norm_impl,
+            pad_mode=pad_mode,
         ),
         train=TrainConfig(batch_size=batch),
     )
@@ -281,7 +283,7 @@ def _fused_k_step(step_fn, k: int):
 
 def bench_dispatch(compute_dtype: str, batch: int, image: int = 256,
                    norm_impl: str = "auto", k: int = 1, warmup: int = 1,
-                   iters: int = 10):
+                   iters: int = 10, pad_mode: str = "reflect"):
     """Epoch-loop semantics INCLUDING the input pipeline's host->device
     transfer: every timed dispatch feeds fresh float32 NUMPY batches (the
     dtype the prefetch thread emits, data/pipeline.py), so each dispatch
@@ -289,7 +291,8 @@ def bench_dispatch(compute_dtype: str, batch: int, image: int = 256,
     program; k > 1 stacks k batches and runs the fused lax.scan K-step
     program (`--steps_per_dispatch`, parallel/dp.py:109-134) — one
     dispatch + one (k x batch) transfer per k steps."""
-    state, step_fn, _ = _build(compute_dtype, batch, image, norm_impl)
+    state, step_fn, _ = _build(compute_dtype, batch, image, norm_impl,
+                               pad_mode)
     rng = np.random.RandomState(1)
     lead = () if k == 1 else (k,)
     # Two host copies alternated so the runtime can't alias/cache one
@@ -320,9 +323,10 @@ def bench_dispatch(compute_dtype: str, batch: int, image: int = 256,
 
 def bench_scan(compute_dtype: str, batch: int, image: int = 256,
                norm_impl: str = "auto", warmup: int = 1, iters: int = 3,
-               k: int = 8):
+               k: int = 8, pad_mode: str = "reflect"):
     """Device-resident: K steps per jitted scan over K pre-staged batches."""
-    state, step_fn, (x, y, w) = _build(compute_dtype, batch, image, norm_impl)
+    state, step_fn, (x, y, w) = _build(compute_dtype, batch, image, norm_impl,
+                                       pad_mode)
     rng = np.random.RandomState(1)
     xs = jnp.asarray(rng.rand(k, batch, image, image, 3).astype(np.float32) * 2 - 1)
     ys = jnp.asarray(rng.rand(k, batch, image, image, 3).astype(np.float32) * 2 - 1)

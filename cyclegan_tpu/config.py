@@ -52,6 +52,12 @@ class ModelConfig:
     remat: bool = False  # jax.checkpoint residual blocks (512^2 HBM relief)
     scan_blocks: bool = False  # lax.scan the residual trunk (smaller HLO, faster compiles)
     instance_norm_impl: str = "auto"  # "xla" | "pallas" | "auto"
+    # "reflect" = reference parity (ReflectionPadding2D, model.py:14-33);
+    # "zero" = conv built-in SAME padding: same parameter tree (checkpoint
+    # compatible), different border semantics — a TPU perf option whose
+    # traffic cost/benefit is quantified by tools/aot_analyze.py
+    # (pad-probe jobs) and documented in docs/BENCHMARKS.md.
+    pad_mode: str = "reflect"  # "reflect" | "zero"
 
     @property
     def input_shape(self) -> Tuple[int, int, int]:

@@ -36,12 +36,14 @@ class _TrunkBody(nn.Module):
     dtype: Optional[Any] = None
     norm_impl: str = "auto"
     remat: bool = False
+    pad_mode: str = "reflect"
 
     @nn.compact
     def __call__(self, carry, _):
         block_cls = nn.remat(ResidualBlock) if self.remat else ResidualBlock
         y = block_cls(
-            dtype=self.dtype, norm_impl=self.norm_impl, name="ResidualBlock_0"
+            dtype=self.dtype, norm_impl=self.norm_impl,
+            pad_mode=self.pad_mode, name="ResidualBlock_0"
         )(carry)
         return y, None
 
@@ -53,6 +55,7 @@ class ResNetGenerator(nn.Module):
     remat: bool = False
     scan_blocks: bool = False
     norm_impl: str = "auto"
+    pad_mode: str = "reflect"  # "zero": conv built-in SAME (same param tree)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -63,13 +66,14 @@ class ResNetGenerator(nn.Module):
         if self.dtype is not None:
             x = x.astype(self.dtype)
 
+        reflect = self.pad_mode == "reflect"
         filters = cfg.filters
         # c7s1-64 (model.py:138-145)
-        y = reflect_pad(x, 3)
+        y = reflect_pad(x, 3) if reflect else x
         y = nn.Conv(
             filters,
             (7, 7),
-            padding="VALID",
+            padding="VALID" if reflect else "SAME",
             use_bias=False,
             kernel_init=init_normal,
             dtype=self.dtype,
@@ -101,6 +105,7 @@ class ResNetGenerator(nn.Module):
                 dtype=self.dtype,
                 norm_impl=self.norm_impl,
                 remat=self.remat,
+                pad_mode=self.pad_mode,
                 name="ScannedTrunk",
             )
             y, _ = trunk(y, None)
@@ -112,6 +117,7 @@ class ResNetGenerator(nn.Module):
                 y = block_cls(
                     dtype=self.dtype,
                     norm_impl=self.norm_impl,
+                    pad_mode=self.pad_mode,
                     name=f"ResidualBlock_{i}",
                 )(y)
 
@@ -121,11 +127,11 @@ class ResNetGenerator(nn.Module):
             y = Upsample(filters, dtype=self.dtype, norm_impl=self.norm_impl)(y)
 
         # Final block (model.py:164-167): bias on, tanh
-        y = reflect_pad(y, 3)
+        y = reflect_pad(y, 3) if reflect else y
         y = nn.Conv(
             self.out_channels,
             (7, 7),
-            padding="VALID",
+            padding="VALID" if reflect else "SAME",
             use_bias=True,
             kernel_init=init_normal,
             dtype=self.dtype,

@@ -48,30 +48,37 @@ class ResidualBlock(nn.Module):
     """reflect-pad(1) > Conv3x3 valid > IN > ReLU > reflect-pad(1) > Conv3x3
     > IN > +skip  (reference model.py:36-74). Filters inferred from input
     channels (model.py:46); convs have no bias (model.py:44).
+
+    pad_mode="zero" swaps each reflect-pad+VALID conv for the conv's
+    built-in SAME zero padding: identical kernel shapes (checkpoints
+    interchange), different border semantics — the TPU perf option
+    (ModelConfig.pad_mode).
     """
 
     dtype: Optional[Dtype] = None
     norm_impl: str = "auto"
+    pad_mode: str = "reflect"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         filters = x.shape[-1]
-        y = reflect_pad(x, 1)
+        reflect = self.pad_mode == "reflect"
+        y = reflect_pad(x, 1) if reflect else x
         y = nn.Conv(
             filters,
             (3, 3),
-            padding="VALID",
+            padding="VALID" if reflect else "SAME",
             use_bias=False,
             kernel_init=init_normal,
             dtype=self.dtype,
         )(y)
         y = InstanceNorm(impl=self.norm_impl)(y)
         y = nn.relu(y)
-        y = reflect_pad(y, 1)
+        y = reflect_pad(y, 1) if reflect else y
         y = nn.Conv(
             filters,
             (3, 3),
-            padding="VALID",
+            padding="VALID" if reflect else "SAME",
             use_bias=False,
             kernel_init=init_normal,
             dtype=self.dtype,

@@ -5,7 +5,14 @@ TPU-native equivalent of the reference's ReflectionPadding2D Keras layer
 tf.pad(mode="REFLECT") with paddings [[0,0],[p,p],[p,p],[0,0]].
 
 Here it is a pure function; `jnp.pad(mode="reflect")` lowers to XLA
-slice+reverse+concat which fuses into the consumer conv's input.
+slice+reverse+concat. NOTE (compiler-measured, 2026-07-31): on XLA:TPU
+these chains do NOT fuse into the consumer conv — each pad materializes
+a padded copy and cuts a producer/consumer fusion chain, and together
+the 22 pads per generator apply account for ~32% of the fused train
+step's HBM traffic (docs/BENCHMARKS.md "what does reflection padding
+cost", docs/aot_analysis.json pad-probe). `ModelConfig.pad_mode="zero"`
+is the non-parity perf option that avoids them (conv built-in SAME,
+same parameter tree).
 """
 
 from __future__ import annotations

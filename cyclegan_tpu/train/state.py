@@ -65,6 +65,7 @@ def build_models(
         remat=m.remat,
         scan_blocks=m.scan_blocks,
         norm_impl=m.instance_norm_impl,
+        pad_mode=m.pad_mode,
     )
     disc = PatchGANDiscriminator(
         config=m.discriminator, dtype=dtype, norm_impl=m.instance_norm_impl

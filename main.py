@@ -76,6 +76,7 @@ def main(args: argparse.Namespace) -> None:
             compute_dtype="bfloat16" if args.bf16 else "float32",
             remat=args.remat,
             scan_blocks=args.scan_blocks,
+            pad_mode=args.pad_mode,
             image_size=args.image_size,
         ),
         data=DataConfig(
@@ -299,6 +300,14 @@ if __name__ == "__main__":
                              "with --remat or smaller batches. Checkpoints "
                              "use a stacked param layout (convert with "
                              "models.stack_trunk_params)")
+    parser.add_argument("--pad_mode", default="reflect",
+                        choices=["reflect", "zero"],
+                        help="conv border handling: 'reflect' is reference "
+                             "parity (ReflectionPadding2D); 'zero' uses the "
+                             "convs' built-in SAME padding — same parameter "
+                             "tree (checkpoints interchange), different "
+                             "border semantics; traffic trade quantified in "
+                             "docs/BENCHMARKS.md (pad-probe)")
     parser.add_argument("--spatial_parallelism", default=1, type=int,
                         help="shard the image H axis over this many mesh columns")
     parser.add_argument("--grad_accum", default=1, type=int, metavar="A",

@@ -143,7 +143,7 @@ def test_bench_dispatch_smoke(monkeypatch):
     chip job."""
     import jax.numpy as jnp
 
-    def fake_build(dtype, batch, image, norm):
+    def fake_build(dtype, batch, image, norm, pad_mode="reflect"):
         state = jnp.zeros(())
 
         def step_fn(st, x, y, w):

@@ -1,0 +1,133 @@
+"""Guard semantics of tools/chip_sweep.py (the on-chip sweep tool).
+
+These pin the safety rails, not measurements: the spec grammar rejects
+malformed/zero-valued specs before any compile, pallas specs off-CPU
+are recorded as refusals without aborting the rest of the sweep
+(remote-compiling the Mosaic program is tunnel-lethal —
+docs/TUNNEL_POSTMORTEM.md incident 2), and a corrupt record file aborts
+BEFORE any compile instead of being silently reset (each record cost
+minutes of tunnel compile time). Grammar tests import the tool's own
+parse_spec so regex drift cannot silently diverge from the tests.
+All subprocess runs avoid initializing a TPU backend.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "chip_sweep.py")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import chip_sweep  # noqa: E402  (parse_spec is importable without jax)
+
+
+def _run(args, record_path, platforms="cpu", extra_env=None):
+    env = dict(os.environ)
+    env["CYCLEGAN_SWEEP_RECORD"] = str(record_path)
+    if platforms is None:
+        env.pop("JAX_PLATFORMS", None)
+    else:
+        env["JAX_PLATFORMS"] = platforms
+    env.update(extra_env or {})
+    return subprocess.run([sys.executable, TOOL, *args],
+                          capture_output=True, text=True, env=env,
+                          cwd=REPO, timeout=120)
+
+
+def test_bad_spec_rejected(tmp_path):
+    r = _run(["scan:i512b8"], tmp_path / "rec.json")  # parts out of order
+    assert r.returncode != 0
+    assert "bad spec" in (r.stdout + r.stderr)
+
+
+def test_zero_k_rejected_not_coerced(tmp_path):
+    # the regex's \d+ admits 0; `k or 8` would silently measure K=8 and
+    # record it under the k0 key — must be rejected up front instead
+    rec = tmp_path / "rec.json"
+    r = _run(["scan:b16k0"], rec)
+    assert r.returncode != 0
+    assert "must be >= 1" in (r.stdout + r.stderr)
+    assert not rec.exists()
+
+
+def test_whole_spec_list_validated_before_any_run(tmp_path):
+    # a bad spec LATER in the list aborts before the first (expensive)
+    # spec starts measuring
+    rec = tmp_path / "rec.json"
+    r = _run(["scan:b2i64", "scan:b0"], rec)
+    assert r.returncode != 0
+    assert "must be >= 1" in (r.stdout + r.stderr)
+    assert not rec.exists()  # nothing measured, nothing recorded
+
+
+def test_no_args_prints_usage(tmp_path):
+    r = _run([], tmp_path / "rec.json")
+    assert r.returncode != 0
+    assert "Spec grammar" in (r.stdout + r.stderr)
+
+
+def test_pallas_off_cpu_records_refusal_and_continues(tmp_path):
+    # refusal is a recorded RESULT (exit 0), not an abort: an unattended
+    # multi-spec sweep must not lose its remaining specs. Use a bad
+    # FOLLOWING spec? No — use only refusal specs so no compile runs.
+    rec = tmp_path / "rec.json"
+    r = _run(["scan:b16pallas", "scan:b8pallas"], rec, platforms=None)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    out = r.stdout + r.stderr
+    assert "refusing to send" in out
+    assert "CYCLEGAN_ALLOW_PALLAS_REMOTE" in out
+    rows = json.loads(rec.read_text())
+    assert [row["key"] for row in rows] == ["scan:b16pallas", "scan:b8pallas"]
+    assert all(row["error"].startswith("refused:") for row in rows)
+
+
+def test_pallas_allowed_on_cpu_platform(tmp_path):
+    # JAX_PLATFORMS=cpu (re-asserted into jax.config) makes pallas specs
+    # legal: they never touch the remote-compile leg. Parse-only check —
+    # _pallas_blocked must return None — via a tiny in-process probe.
+    code = (
+        "import os; os.environ['JAX_PLATFORMS']='cpu';"
+        "import sys; sys.path.insert(0, 'tools'); sys.path.insert(0, '.');"
+        "from cyclegan_tpu.utils.platform import ensure_platform_from_env;"
+        "ensure_platform_from_env();"
+        "import chip_sweep; assert chip_sweep._pallas_blocked() is None;"
+        "print('ok')"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, cwd=REPO, timeout=120)
+    assert r.returncode == 0 and "ok" in r.stdout, (r.stdout, r.stderr)
+
+
+def test_corrupt_record_aborts_before_measuring(tmp_path):
+    rec = tmp_path / "rec.json"
+    rec.write_text("{corrupt")
+    r = _run(["scan:b2i64"], rec)
+    assert r.returncode != 0
+    assert "refusing to overwrite" in (r.stdout + r.stderr)
+    # the corrupt file is untouched, and the abort beat any compile
+    assert rec.read_text() == "{corrupt"
+
+
+@pytest.mark.parametrize("spec,expect", [
+    ("scan:b8", ("scan", 8, 8, False, "reflect", 256)),
+    ("scan:b16k16", ("scan", 16, 16, False, "reflect", 256)),
+    ("dispatch:b16", ("dispatch", 16, 1, False, "reflect", 256)),
+    ("dispatch:b1k1i64", ("dispatch", 1, 1, False, "reflect", 64)),
+    ("scan:b16pallasi512", ("scan", 16, 8, True, "reflect", 512)),
+    ("scan:b16zero", ("scan", 16, 8, False, "zero", 256)),
+    ("dispatch:b16k8zeroi512", ("dispatch", 16, 8, False, "zero", 512)),
+])
+def test_spec_grammar(spec, expect):
+    assert chip_sweep.parse_spec(spec) == expect
+
+
+@pytest.mark.parametrize("bad", ["scan:i512b8", "scan:b0", "scan:b16k0",
+                                 "steps:b1", "scan:b8i0", "scan", "",
+                                 "scan:b16zeropallas"])
+def test_spec_grammar_rejects(bad):
+    with pytest.raises(SystemExit):
+        chip_sweep.parse_spec(bad)

@@ -48,7 +48,7 @@ def register_local_only() -> None:
 
 
 def build_step(compute_dtype: str, batch: int, image: int, remat: bool = False,
-               scan_blocks: bool = False):
+               scan_blocks: bool = False, pad_mode: str = "reflect"):
     import jax
 
     from cyclegan_tpu.config import Config, ModelConfig, TrainConfig
@@ -57,7 +57,7 @@ def build_step(compute_dtype: str, batch: int, image: int, remat: bool = False,
     cfg = Config(
         model=ModelConfig(
             compute_dtype=compute_dtype, image_size=image, remat=remat,
-            scan_blocks=scan_blocks,
+            scan_blocks=scan_blocks, pad_mode=pad_mode,
         ),
         train=TrainConfig(batch_size=batch),
     )
@@ -72,13 +72,13 @@ def build_step(compute_dtype: str, batch: int, image: int, remat: bool = False,
 
 def analyze(tag: str, compute_dtype: str, batch: int, image: int,
             remat: bool = False, scan_blocks: bool = False,
-            hlo_excerpt: bool = False) -> dict:
+            pad_mode: str = "reflect", hlo_excerpt: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
     say(f"{tag}: building")
     cfg, state, step = build_step(compute_dtype, batch, image, remat,
-                                  scan_blocks)
+                                  scan_blocks, pad_mode)
     x = jax.ShapeDtypeStruct((batch, image, image, 3), jnp.float32)
     y = jax.ShapeDtypeStruct((batch, image, image, 3), jnp.float32)
     w = jax.ShapeDtypeStruct((batch,), jnp.float32)
@@ -93,7 +93,7 @@ def analyze(tag: str, compute_dtype: str, batch: int, image: int,
     out: dict = {
         "config": {
             "dtype": compute_dtype, "batch": batch, "image": image,
-            "remat": remat, "scan_blocks": scan_blocks,
+            "remat": remat, "scan_blocks": scan_blocks, "pad_mode": pad_mode,
         },
         "compile_seconds": round(compile_s, 1),
     }
@@ -162,6 +162,9 @@ def main() -> None:
     say(f"devices: {jax.devices()}")
 
     fast = "--fast" in sys.argv
+    only = None
+    if "--only" in sys.argv:
+        only = sys.argv[sys.argv.index("--only") + 1]
     jobs = {
         "scan-headline-equivalent step/bf16/b16/256": dict(
             compute_dtype="bfloat16", batch=16, image=256, hlo_excerpt=True),
@@ -177,7 +180,20 @@ def main() -> None:
             "compile-time-probe step/bf16/b16/256/scan-blocks": dict(
                 compute_dtype="bfloat16", batch=16, image=256,
                 scan_blocks=True, hlo_excerpt=True),
+            # pad-probe: conv built-in zero padding vs the default
+            # reflect-pad+VALID — quantifies what the reflect pads cost
+            # in compiler-counted traffic at the headline config
+            # (ModelConfig.pad_mode; border-semantics trade documented
+            # in docs/BENCHMARKS.md).
+            "pad-probe step/bf16/b16/256/zero-pad": dict(
+                compute_dtype="bfloat16", batch=16, image=256,
+                pad_mode="zero", hlo_excerpt=True),
         })
+
+    if only is not None:
+        jobs = {t: kw for t, kw in jobs.items() if only in t}
+        if not jobs:
+            raise SystemExit(f"--only {only!r} matches no job")
 
     report = {"host": "local libtpu AOT (chipless)", "jobs": {}}
     for tag, kwargs in jobs.items():

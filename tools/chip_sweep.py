@@ -1,0 +1,166 @@
+"""Ad-hoc on-chip config sweeps reusing bench.py's measurement core.
+
+TPU_RUNBOOK item 2: re-sweep scan batches under the custom-VJP norm
+(the r1 sweep predates it for every batch but 16) and probe k=16 vs
+k=8 scan. Each result is appended to docs/bench_sweeps.json (override
+with CYCLEGAN_SWEEP_RECORD) as {"key", "img_per_sec" | "error", "ts"}
+so the record is regenerable:
+
+    python tools/chip_sweep.py scan:b8 scan:b24 scan:b32 scan:b16k16
+
+Spec grammar: <scan|dispatch>:b<batch>[k<K>][pallas][zero][i<image>] —
+parts in that order; k defaults to 8 for scan / 1 for dispatch, image
+to 256; `zero` selects pad_mode="zero" (conv built-in SAME padding, the
+compiler-certified −32% traffic variant — docs/BENCHMARKS.md pad-probe).
+Runs ONE config per spec sequentially in this process (ground rule:
+one axon client at a time). A failed measurement — an OOM, or a pallas
+spec refused off-CPU — is recorded as an error row and the sweep
+continues; only a malformed spec or a corrupt record file aborts (both
+before any compile).
+
+`pallas` specs are REFUSED off the CPU backend unless
+CYCLEGAN_ALLOW_PALLAS_REMOTE=1: remote-compiling the Mosaic program
+hung the compile service and cost the session its tunnel
+(docs/TUNNEL_POSTMORTEM.md incident 2, runbook ground rule 2b). The
+kernel's characterization lives in docs/aot_analysis.json instead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RECORD_PATH = os.environ.get("CYCLEGAN_SWEEP_RECORD") or os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs", "bench_sweeps.json")
+
+SPEC_RE = re.compile(
+    r"(scan|dispatch):b(\d+)(?:k(\d+))?(pallas)?(zero)?(?:i(\d+))?")
+
+
+def parse_spec(spec: str):
+    """spec -> (mode, batch, k, pallas, pad_mode, image). Raises
+    SystemExit on a malformed spec or zero batch/k/image (the regex's
+    \\d+ admits 0, which `k or default` would silently coerce to the
+    default — a mislabeled record in a file the docs treat as ground
+    truth)."""
+    m = SPEC_RE.fullmatch(spec)
+    if not m:
+        raise SystemExit(f"bad spec: {spec}")
+    mode, batch, k, pallas, pad_mode, image = (
+        m.group(1), int(m.group(2)),
+        int(m.group(3)) if m.group(3) else None,
+        bool(m.group(4)), "zero" if m.group(5) else "reflect",
+        int(m.group(6)) if m.group(6) else 256)
+    if batch < 1 or image < 1 or (k is not None and k < 1):
+        raise SystemExit(f"bad spec: {spec} (batch/k/image must be >= 1)")
+    if k is None:
+        k = 8 if mode == "scan" else 1
+    return mode, batch, k, pallas, pad_mode, image
+
+
+def _load_records() -> list:
+    try:
+        with open(RECORD_PATH) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return []
+    except ValueError as e:
+        # A corrupt record file must ABORT, not silently reset: each row
+        # cost minutes of tunnel compile time and may be unreproducible.
+        raise SystemExit(
+            f"{RECORD_PATH} is corrupt ({e}); refusing to overwrite — "
+            "repair or move it, then re-run") from e
+
+
+def _append_record(rec: dict) -> None:
+    records = _load_records()
+    records.append(rec)
+    tmp = RECORD_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(records, f, indent=1)
+    os.replace(tmp, RECORD_PATH)
+
+
+def _pallas_blocked() -> str | None:
+    """Return a refusal reason when a pallas spec may not run here.
+
+    The check reads jax's EFFECTIVE platform config (after
+    ensure_platform_from_env), not the env var: the axon sitecustomize
+    force-overrides jax_platforms at interpreter start and
+    ensure_platform_from_env swallows update failures, so the env var
+    alone can say "cpu" while the process would still compile through
+    the tunnel. Reading the config does not initialize a backend."""
+    if os.environ.get("CYCLEGAN_ALLOW_PALLAS_REMOTE") == "1":
+        return None
+    import jax
+
+    effective = str(getattr(jax.config, "jax_platforms", None) or "")
+    if effective.split(",")[0] == "cpu":
+        return None
+    return ("refusing to send a Mosaic/pallas program through the "
+            f"remote-compile leg (effective platforms={effective!r}; "
+            "tunnel-lethal — postmortem incident 2). Set "
+            "CYCLEGAN_ALLOW_PALLAS_REMOTE=1 to override.")
+
+
+def run_spec(spec: str) -> None:
+    # abort BEFORE compile
+    mode, batch, k, pallas, pad_mode, image = parse_spec(spec)
+    # Honor JAX_PLATFORMS=cpu (the axon sitecustomize overrides the env
+    # var; main.py re-asserts it the same way) so the tool is drivable
+    # off-chip and fails fast instead of hanging when the relay is down.
+    from cyclegan_tpu.utils.platform import ensure_platform_from_env
+    ensure_platform_from_env()
+
+    t0 = time.perf_counter()
+    rec = {"key": spec, "ts": time.strftime("%Y-%m-%dT%H:%MZ", time.gmtime())}
+    blocked = _pallas_blocked() if pallas else None
+    if blocked:
+        # A refusal is a RECORDED result, like an OOM: it costs no
+        # compile, and aborting here would silently drop the remaining
+        # specs of an unattended multi-spec sweep.
+        rec["error"] = f"refused: {blocked}"
+        print(f"[sweep] {spec}: {rec['error']}", flush=True)
+        rec["wall_s"] = 0.0
+        _append_record(rec)
+        return
+    import bench
+
+    norm = "pallas" if pallas else "auto"
+    try:
+        if mode == "scan":
+            ips = bench.bench_scan("bfloat16", batch, image=image,
+                                   norm_impl=norm, k=k, pad_mode=pad_mode)
+        else:
+            ips = bench.bench_dispatch("bfloat16", batch, image=image,
+                                       norm_impl=norm, k=k,
+                                       pad_mode=pad_mode)
+        rec["img_per_sec"] = round(ips, 2)
+        print(f"[sweep] {spec}: {ips:.2f} img/s "
+              f"({time.perf_counter() - t0:.0f}s incl. compile)", flush=True)
+    except Exception as e:  # OOM is a RESULT here, not a failure
+        rec["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+        print(f"[sweep] {spec}: {rec['error']}", flush=True)
+    rec["wall_s"] = round(time.perf_counter() - t0, 1)
+    _append_record(rec)
+
+
+def main() -> None:
+    specs = sys.argv[1:]
+    if not specs:
+        raise SystemExit(__doc__)
+    _load_records()  # fail fast on a corrupt record file, BEFORE any compile
+    for spec in specs:
+        parse_spec(spec)  # validate the WHOLE list before the first compile
+    for spec in specs:
+        run_spec(spec)
+
+
+if __name__ == "__main__":
+    main()
