@@ -215,7 +215,7 @@ def _default_config():
 
 
 def _build(compute_dtype: str, batch: int, image: int, norm_impl: str,
-           pad_mode: str = "reflect"):
+           pad_mode: str = "reflect", pad_impl: str = "pad"):
     from cyclegan_tpu.config import Config, ModelConfig, TrainConfig
     from cyclegan_tpu.train import create_state, make_train_step
 
@@ -225,6 +225,7 @@ def _build(compute_dtype: str, batch: int, image: int, norm_impl: str,
             image_size=image,
             instance_norm_impl=norm_impl,
             pad_mode=pad_mode,
+            pad_impl=pad_impl,
         ),
         train=TrainConfig(batch_size=batch),
     )
@@ -283,7 +284,8 @@ def _fused_k_step(step_fn, k: int):
 
 def bench_dispatch(compute_dtype: str, batch: int, image: int = 256,
                    norm_impl: str = "auto", k: int = 1, warmup: int = 1,
-                   iters: int = 10, pad_mode: str = "reflect"):
+                   iters: int = 10, pad_mode: str = "reflect",
+                   pad_impl: str = "pad"):
     """Epoch-loop semantics INCLUDING the input pipeline's host->device
     transfer: every timed dispatch feeds fresh float32 NUMPY batches (the
     dtype the prefetch thread emits, data/pipeline.py), so each dispatch
@@ -292,7 +294,7 @@ def bench_dispatch(compute_dtype: str, batch: int, image: int = 256,
     program (`--steps_per_dispatch`, parallel/dp.py:109-134) — one
     dispatch + one (k x batch) transfer per k steps."""
     state, step_fn, _ = _build(compute_dtype, batch, image, norm_impl,
-                               pad_mode)
+                               pad_mode, pad_impl)
     rng = np.random.RandomState(1)
     lead = () if k == 1 else (k,)
     # Two host copies alternated so the runtime can't alias/cache one
@@ -323,10 +325,10 @@ def bench_dispatch(compute_dtype: str, batch: int, image: int = 256,
 
 def bench_scan(compute_dtype: str, batch: int, image: int = 256,
                norm_impl: str = "auto", warmup: int = 1, iters: int = 3,
-               k: int = 8, pad_mode: str = "reflect"):
+               k: int = 8, pad_mode: str = "reflect", pad_impl: str = "pad"):
     """Device-resident: K steps per jitted scan over K pre-staged batches."""
     state, step_fn, (x, y, w) = _build(compute_dtype, batch, image, norm_impl,
-                                       pad_mode)
+                                       pad_mode, pad_impl)
     rng = np.random.RandomState(1)
     xs = jnp.asarray(rng.rand(k, batch, image, image, 3).astype(np.float32) * 2 - 1)
     ys = jnp.asarray(rng.rand(k, batch, image, image, 3).astype(np.float32) * 2 - 1)
@@ -477,6 +479,8 @@ def _config_key(c: dict) -> str:
         key += f"/i{c['image']}"
     if c["mode"] == "dispatch":
         key += f"/k{c.get('k', 1)}"
+    if c.get("pad_impl", "pad") == "fused":
+        key += "/fused"
     return key
 
 
@@ -500,6 +504,7 @@ def _run_configs(results: dict, configs, t_start: float, on_result=None,
             # step takes minutes on host cores — shrink the work so at
             # least one honest measurement lands inside the budget.
             on_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+            pad_impl = c.get("pad_impl", "pad")
             if mode == "steps":
                 # on_cpu: 2 total steps (~100s each at 256^2) — the CPU
                 # fallback is a liveness signal, not a precision number,
@@ -515,11 +520,13 @@ def _run_configs(results: dict, configs, t_start: float, on_result=None,
                 ips = bench_dispatch(
                     dtype, batch, image=image, k=k, warmup=1,
                     iters=1 if on_cpu else max(2, -(-10 // k)),
+                    pad_impl=pad_impl,
                 )
             else:
                 ips = bench_scan(
                     dtype, batch, image=image, warmup=1,
                     iters=1 if on_cpu else 3, k=2 if on_cpu else 8,
+                    pad_impl=pad_impl,
                 )
             results[key] = ips
             if on_result is not None:
@@ -549,6 +556,9 @@ TPU_CONFIGS = [
     {"mode": "scan", "dtype": "bfloat16", "batch": 16},
     {"mode": "dispatch", "dtype": "bfloat16", "batch": 16, "k": 1},
     {"mode": "dispatch", "dtype": "bfloat16", "batch": 16, "k": 8},
+    # one batch-sweep point beyond the headline in the official record
+    # (the full sweep lives in docs/bench_sweeps.json)
+    {"mode": "scan", "dtype": "bfloat16", "batch": 24},
     {"mode": "dispatch", "dtype": "bfloat16", "batch": 16, "k": 4},
     # reference default: per-replica batch 1
     {"mode": "steps", "dtype": "float32", "batch": 1},

@@ -58,6 +58,33 @@ class ModelConfig:
     # traffic cost/benefit is quantified by tools/aot_analyze.py
     # (pad-probe jobs) and documented in docs/BENCHMARKS.md.
     pad_mode: str = "reflect"  # "reflect" | "zero"
+    # How pad_mode="reflect" is SCHEDULED (semantics unchanged):
+    # "pad"   = jnp.pad(mode="reflect") + VALID conv — bitwise parity
+    #           baseline, but each site materializes a padded copy;
+    # "fused" = ReflectConv: conv's built-in zero padding + fusible thin
+    #           border-correction convs (ops/padding.py:reflect_conv) —
+    #           same math to fp tolerance, no padded copies. Ignored when
+    #           pad_mode="zero". Param trees are identical either way.
+    pad_impl: str = "pad"  # "pad" | "fused"
+
+    def __post_init__(self):
+        # A typo like "Reflect" would otherwise silently select zero/SAME
+        # padding in the generator, changing border numerics away from
+        # reference parity with no error (argparse choices only guard the
+        # CLI; programmatic construction lands here).
+        if self.pad_mode not in ("reflect", "zero"):
+            raise ValueError(
+                f"pad_mode must be 'reflect' or 'zero', got {self.pad_mode!r}"
+            )
+        if self.instance_norm_impl not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                "instance_norm_impl must be 'auto', 'xla' or 'pallas', "
+                f"got {self.instance_norm_impl!r}"
+            )
+        if self.pad_impl not in ("pad", "fused"):
+            raise ValueError(
+                f"pad_impl must be 'pad' or 'fused', got {self.pad_impl!r}"
+            )
 
     @property
     def input_shape(self) -> Tuple[int, int, int]:

@@ -26,7 +26,6 @@ from cyclegan_tpu.models.modules import (
     InstanceNorm,
     ResidualBlock,
     Upsample,
-    init_normal,
 )
 
 
@@ -37,13 +36,15 @@ class _TrunkBody(nn.Module):
     norm_impl: str = "auto"
     remat: bool = False
     pad_mode: str = "reflect"
+    pad_impl: str = "pad"
 
     @nn.compact
     def __call__(self, carry, _):
         block_cls = nn.remat(ResidualBlock) if self.remat else ResidualBlock
         y = block_cls(
             dtype=self.dtype, norm_impl=self.norm_impl,
-            pad_mode=self.pad_mode, name="ResidualBlock_0"
+            pad_mode=self.pad_mode, pad_impl=self.pad_impl,
+            name="ResidualBlock_0"
         )(carry)
         return y, None
 
@@ -56,9 +57,11 @@ class ResNetGenerator(nn.Module):
     scan_blocks: bool = False
     norm_impl: str = "auto"
     pad_mode: str = "reflect"  # "zero": conv built-in SAME (same param tree)
+    pad_impl: str = "pad"  # "fused": reflect semantics via ReflectConv
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        from cyclegan_tpu.models.modules import parity_conv
         from cyclegan_tpu.ops.padding import reflect_pad
 
         cfg = self.config
@@ -67,17 +70,16 @@ class ResNetGenerator(nn.Module):
             x = x.astype(self.dtype)
 
         reflect = self.pad_mode == "reflect"
+        fused = reflect and self.pad_impl == "fused"
+
+        def edge_conv(features, use_bias, name):
+            return parity_conv(features, pad=3, reflect=reflect, fused=fused,
+                               use_bias=use_bias, dtype=self.dtype, name=name)
+
         filters = cfg.filters
         # c7s1-64 (model.py:138-145)
-        y = reflect_pad(x, 3) if reflect else x
-        y = nn.Conv(
-            filters,
-            (7, 7),
-            padding="VALID" if reflect else "SAME",
-            use_bias=False,
-            kernel_init=init_normal,
-            dtype=self.dtype,
-        )(y)
+        y = reflect_pad(x, 3) if reflect and not fused else x
+        y = edge_conv(filters, use_bias=False, name="Conv_0")(y)
         y = InstanceNorm(impl=self.norm_impl)(y)
         y = nn.relu(y)
 
@@ -106,6 +108,7 @@ class ResNetGenerator(nn.Module):
                 norm_impl=self.norm_impl,
                 remat=self.remat,
                 pad_mode=self.pad_mode,
+                pad_impl=self.pad_impl,
                 name="ScannedTrunk",
             )
             y, _ = trunk(y, None)
@@ -118,6 +121,7 @@ class ResNetGenerator(nn.Module):
                     dtype=self.dtype,
                     norm_impl=self.norm_impl,
                     pad_mode=self.pad_mode,
+                    pad_impl=self.pad_impl,
                     name=f"ResidualBlock_{i}",
                 )(y)
 
@@ -127,15 +131,8 @@ class ResNetGenerator(nn.Module):
             y = Upsample(filters, dtype=self.dtype, norm_impl=self.norm_impl)(y)
 
         # Final block (model.py:164-167): bias on, tanh
-        y = reflect_pad(y, 3) if reflect else y
-        y = nn.Conv(
-            self.out_channels,
-            (7, 7),
-            padding="VALID" if reflect else "SAME",
-            use_bias=True,
-            kernel_init=init_normal,
-            dtype=self.dtype,
-        )(y)
+        y = reflect_pad(y, 3) if reflect and not fused else y
+        y = edge_conv(self.out_channels, use_bias=True, name="Conv_1")(y)
         y = jnp.tanh(y)
         return y.astype(in_dtype)
 

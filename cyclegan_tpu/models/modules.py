@@ -18,12 +18,78 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from cyclegan_tpu.ops.norm import instance_norm
-from cyclegan_tpu.ops.padding import reflect_pad
+from cyclegan_tpu.ops.padding import reflect_conv, reflect_pad
 
 Dtype = Any
 
 # N(0, 0.02) for conv kernels and IN gammas (reference model.py:10-11).
 init_normal = nn.initializers.normal(stddev=0.02)
+
+
+class ReflectConv(nn.Module):
+    """Conv with reflect-padding semantics, scheduled as zero-pad conv +
+    fusible border corrections (ops/padding.py:reflect_conv).
+
+    Drop-in for the reflect-pad + nn.Conv(VALID) pair: same "kernel" /
+    "bias" param names, shapes, and init, so checkpoints interchange with
+    the pad_impl="pad" layout when given the same module `name` (the
+    callers pass name="Conv_N" to pin the auto-assigned path). Numerics
+    agree to fp tolerance (border sums re-associated), not bitwise —
+    pad_impl="pad" stays the parity default.
+    """
+
+    features: int
+    pad: int  # kernel is (2*pad+1)^2
+    use_bias: bool = False
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        ksz = 2 * self.pad + 1
+        kernel = self.param(
+            "kernel", init_normal, (ksz, ksz, x.shape[-1], self.features),
+            jnp.float32,
+        )
+        bias = (
+            self.param(
+                "bias", nn.initializers.zeros_init(), (self.features,),
+                jnp.float32,
+            )
+            if self.use_bias
+            else None
+        )
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+            kernel = kernel.astype(self.dtype)
+            bias = bias.astype(self.dtype) if bias is not None else None
+        y = reflect_conv(x, kernel, self.pad)
+        if bias is not None:
+            y = y + bias
+        return y
+
+
+def parity_conv(features: int, pad: int, reflect: bool, fused: bool,
+                use_bias: bool, dtype: Optional[Dtype], name: str):
+    """The conv factory for every reference reflect-pad site, shared by
+    ResidualBlock and ResNetGenerator so the checkpoint-compat invariants
+    (pinned "Conv_N" names, VALID-for-reflect vs built-in-SAME-for-zero)
+    have one author. Kernel size is (2*pad+1)^2 — the only geometries the
+    reference uses at these sites (3x3/pad-1, 7x7/pad-3; model.py:14-33).
+    """
+    if fused:
+        return ReflectConv(
+            features, pad=pad, use_bias=use_bias, dtype=dtype, name=name
+        )
+    ksz = 2 * pad + 1
+    return nn.Conv(
+        features,
+        (ksz, ksz),
+        padding="VALID" if reflect else "SAME",
+        use_bias=use_bias,
+        kernel_init=init_normal,
+        dtype=dtype,
+        name=name,
+    )
 
 
 class InstanceNorm(nn.Module):
@@ -52,37 +118,31 @@ class ResidualBlock(nn.Module):
     pad_mode="zero" swaps each reflect-pad+VALID conv for the conv's
     built-in SAME zero padding: identical kernel shapes (checkpoints
     interchange), different border semantics — the TPU perf option
-    (ModelConfig.pad_mode).
+    (ModelConfig.pad_mode). pad_impl="fused" keeps reflect semantics but
+    schedules each site as ReflectConv (no materialized padded copy).
     """
 
     dtype: Optional[Dtype] = None
     norm_impl: str = "auto"
     pad_mode: str = "reflect"
+    pad_impl: str = "pad"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         filters = x.shape[-1]
         reflect = self.pad_mode == "reflect"
-        y = reflect_pad(x, 1) if reflect else x
-        y = nn.Conv(
-            filters,
-            (3, 3),
-            padding="VALID" if reflect else "SAME",
-            use_bias=False,
-            kernel_init=init_normal,
-            dtype=self.dtype,
-        )(y)
+        fused = reflect and self.pad_impl == "fused"
+
+        def conv(name: str):
+            return parity_conv(filters, pad=1, reflect=reflect, fused=fused,
+                               use_bias=False, dtype=self.dtype, name=name)
+
+        y = reflect_pad(x, 1) if reflect and not fused else x
+        y = conv("Conv_0")(y)
         y = InstanceNorm(impl=self.norm_impl)(y)
         y = nn.relu(y)
-        y = reflect_pad(y, 1) if reflect else y
-        y = nn.Conv(
-            filters,
-            (3, 3),
-            padding="VALID" if reflect else "SAME",
-            use_bias=False,
-            kernel_init=init_normal,
-            dtype=self.dtype,
-        )(y)
+        y = reflect_pad(y, 1) if reflect and not fused else y
+        y = conv("Conv_1")(y)
         y = InstanceNorm(impl=self.norm_impl)(y)
         return x + y
 

@@ -5,7 +5,7 @@ provided for the fused instance-norm path where measurement shows XLA
 fusion is poor.
 """
 
-from cyclegan_tpu.ops.padding import reflect_pad
+from cyclegan_tpu.ops.padding import reflect_conv, reflect_pad
 from cyclegan_tpu.ops.norm import instance_norm
 
-__all__ = ["reflect_pad", "instance_norm"]
+__all__ = ["reflect_pad", "reflect_conv", "instance_norm"]

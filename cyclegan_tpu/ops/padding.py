@@ -18,6 +18,7 @@ same parameter tree).
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
 
 def reflect_pad(x: jnp.ndarray, pad: int | tuple[int, int]) -> jnp.ndarray:
@@ -35,3 +36,96 @@ def reflect_pad(x: jnp.ndarray, pad: int | tuple[int, int]) -> jnp.ndarray:
     else:
         ph, pw = pad
     return jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)), mode="reflect")
+
+
+_DN = ("NHWC", "HWIO", "NHWC")
+
+
+def _conv(x, k, padding):
+    return lax.conv_general_dilated(
+        x, k, window_strides=(1, 1), padding=padding, dimension_numbers=_DN
+    )
+
+
+def _top_correction(x, k, p):
+    """Missing-tap contributions for output rows [0, p).
+
+    For output row i < p, the taps at input rows r = i + a - p < 0 read
+    x[-r] under reflection but 0 under zero padding. Those contributions
+    reduce to a conv of the H-flipped strip x[p..1] with the kernel's top
+    p rows: corr[i] = sum_{u=1..p-i} x[u] * k[p-i-u]  (derivation: sub
+    u = p - i - a). One-sided zero H-padding (0, p-1) realizes the
+    shrinking overlap; reflect W-padding makes the same strip also carry
+    the corner taps (r < 0 AND c outside), so the side corrections can
+    stay row-exact without double counting.
+    """
+    strip = x[:, p:0:-1]  # rows p..1 (H-flipped), full W
+    strip = jnp.pad(strip, ((0, 0), (0, 0), (p, p), (0, 0)), mode="reflect")
+    return _conv(strip, k[:p], padding=((0, p - 1), (0, 0)))
+
+
+def _left_correction(x, k, p):
+    """Missing-tap contributions for output cols [0, p), in-range rows only.
+
+    Taps with c < 0 and 0 <= r < H: the W analog of `_top_correction`,
+    except the H axis uses the conv's own symmetric ZERO padding (p, p) —
+    out-of-range rows contribute nothing here because `_top_correction` /
+    its bottom mirror already counted them (with W-reflection).
+    """
+    strip = x[:, :, p:0:-1]  # cols p..1 (W-flipped), full H
+    return _conv(strip, k[:, :p], padding=((p, p), (0, p - 1)))
+
+
+def reflect_conv(x: jnp.ndarray, k: jnp.ndarray, pad: int) -> jnp.ndarray:
+    """Stride-1 VALID conv over a reflect-padded input, without ever
+    materializing the padded copy.
+
+    Numerically ≡ ``conv_valid(reflect_pad(x, pad), k)`` (same products;
+    border sums re-associated, so agreement is to fp tolerance rather
+    than bitwise). Scheduled TPU-first: the bulk runs as one conv with
+    built-in zero padding — XLA:TPU handles that inside the conv's window
+    logic, reading ``x`` straight from HBM — and the reflect-vs-zero
+    difference is confined to four thin border-correction convs whose
+    zero-pad-to-full-size + add epilogue is elementwise and fusible into
+    the consumer (instance-norm stats), unlike ``jnp.pad(mode="reflect")``
+    whose slice/reverse/concat chain must materialize a padded copy per
+    site (~32% of step HBM traffic at the headline config;
+    docs/aot_analysis.json pad-probe vs pad-fused jobs).
+
+    Requires kernel size (2·pad+1)² (the generator's 3×3/pad-1 and
+    7×7/pad-3 sites) and H, W > 2·pad.
+
+    Args:
+      x: [N, H, W, C] input.
+      k: [kh, kw, C, O] kernel with kh == kw == 2*pad + 1.
+      pad: reflect-padding amount the conv semantics assume.
+    """
+    p = pad
+    kh, kw = k.shape[0], k.shape[1]
+    if kh != 2 * p + 1 or kw != 2 * p + 1:
+        raise ValueError(
+            f"reflect_conv needs a (2*pad+1)^2 kernel; got {kh}x{kw} for pad={p}"
+        )
+    H, W = x.shape[1], x.shape[2]
+    if H <= 2 * p or W <= 2 * p:
+        raise ValueError(
+            f"reflect_conv needs H, W > 2*pad; got {H}x{W} for pad={p}"
+        )
+
+    out = _conv(x, k, padding=((p, p), (p, p)))
+
+    corr_t = _top_correction(x, k, p)
+    corr_b = jnp.flip(
+        _top_correction(jnp.flip(x, axis=1), jnp.flip(k, axis=0), p), axis=1
+    )
+    corr_l = _left_correction(x, k, p)
+    corr_r = jnp.flip(
+        _left_correction(jnp.flip(x, axis=2), jnp.flip(k, axis=1), p), axis=2
+    )
+
+    zero = ((0, 0), (0, H - p), (0, 0), (0, 0))
+    out = out + jnp.pad(corr_t, zero)
+    out = out + jnp.pad(corr_b, ((0, 0), (H - p, 0), (0, 0), (0, 0)))
+    out = out + jnp.pad(corr_l, ((0, 0), (0, 0), (0, W - p), (0, 0)))
+    out = out + jnp.pad(corr_r, ((0, 0), (0, 0), (W - p, 0), (0, 0)))
+    return out

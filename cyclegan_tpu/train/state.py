@@ -66,6 +66,7 @@ def build_models(
         scan_blocks=m.scan_blocks,
         norm_impl=m.instance_norm_impl,
         pad_mode=m.pad_mode,
+        pad_impl=m.pad_impl,
     )
     disc = PatchGANDiscriminator(
         config=m.discriminator, dtype=dtype, norm_impl=m.instance_norm_impl

@@ -77,6 +77,7 @@ def main(args: argparse.Namespace) -> None:
             remat=args.remat,
             scan_blocks=args.scan_blocks,
             pad_mode=args.pad_mode,
+            pad_impl=args.pad_impl,
             image_size=args.image_size,
         ),
         data=DataConfig(
@@ -308,6 +309,16 @@ if __name__ == "__main__":
                              "tree (checkpoints interchange), different "
                              "border semantics; traffic trade quantified in "
                              "docs/BENCHMARKS.md (pad-probe)")
+    parser.add_argument("--pad_impl", default="pad",
+                        choices=["pad", "fused"],
+                        help="how pad_mode=reflect is scheduled: 'pad' "
+                             "materializes reflect-padded copies (bitwise "
+                             "parity baseline); 'fused' keeps reflect "
+                             "semantics (fp-tolerance-identical) but runs "
+                             "each site as a zero-padded conv + fusible "
+                             "border corrections — removes the pads' ~32%% "
+                             "of step HBM traffic (docs/BENCHMARKS.md). "
+                             "Checkpoints interchange")
     parser.add_argument("--spatial_parallelism", default=1, type=int,
                         help="shard the image H axis over this many mesh columns")
     parser.add_argument("--grad_accum", default=1, type=int, metavar="A",

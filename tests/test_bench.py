@@ -143,7 +143,8 @@ def test_bench_dispatch_smoke(monkeypatch):
     chip job."""
     import jax.numpy as jnp
 
-    def fake_build(dtype, batch, image, norm, pad_mode="reflect"):
+    def fake_build(dtype, batch, image, norm, pad_mode="reflect",
+                   pad_impl="pad"):
         state = jnp.zeros(())
 
         def step_fn(st, x, y, w):

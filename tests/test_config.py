@@ -1,0 +1,29 @@
+"""ModelConfig validation: bad enum values must fail loudly.
+
+Round-3 advisor finding: a typo like pad_mode='Reflect' silently selected
+zero/SAME padding (non-parity border numerics) when Config was built
+programmatically — only main.py's argparse choices guarded it.
+"""
+
+import pytest
+
+from cyclegan_tpu.config import Config, ModelConfig
+
+
+def test_pad_mode_typo_raises():
+    with pytest.raises(ValueError, match="pad_mode"):
+        ModelConfig(pad_mode="Reflect")
+
+
+def test_pad_mode_valid_values_accepted():
+    assert ModelConfig(pad_mode="reflect").pad_mode == "reflect"
+    assert ModelConfig(pad_mode="zero").pad_mode == "zero"
+
+
+def test_instance_norm_impl_typo_raises():
+    with pytest.raises(ValueError, match="instance_norm_impl"):
+        ModelConfig(instance_norm_impl="Pallas")
+
+
+def test_default_config_constructs():
+    assert Config().model.pad_mode == "reflect"

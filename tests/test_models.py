@@ -198,3 +198,53 @@ class TestPadMode:
         np.testing.assert_allclose(np.asarray(same)[:, 1:-1, 1:-1, :],
                                    np.asarray(valid)[:, 1:-1, 1:-1, :],
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestPadImpl:
+    """pad_impl="fused" (ModelConfig.pad_impl): reflect semantics
+    scheduled as ReflectConv (zero-pad conv + fusible border corrections)
+    instead of materialized reflect-pads. Contract: the param tree —
+    paths AND shapes — is identical to pad_impl="pad" (checkpoints
+    interchange), and same-params outputs agree to fp tolerance (unlike
+    pad_mode="zero", which changes border semantics)."""
+
+    def test_param_tree_identical_and_outputs_match(self):
+        cfg = GeneratorConfig(filters=8, num_residual_blocks=2)
+        x = jax.random.uniform(jax.random.PRNGKey(1), (1, 32, 32, 3),
+                               minval=-1.0, maxval=1.0)
+        gens = {impl: ResNetGenerator(config=cfg, pad_impl=impl)
+                for impl in ("pad", "fused")}
+        trees = {impl: jax.eval_shape(g.init, jax.random.PRNGKey(0), x)
+                 for impl, g in gens.items()}
+        assert (jax.tree.map(lambda l: (l.shape, l.dtype), trees["pad"]) ==
+                jax.tree.map(lambda l: (l.shape, l.dtype), trees["fused"]))
+
+        params = gens["pad"].init(jax.random.PRNGKey(0), x)
+        out_pad = gens["pad"].apply(params, x)
+        out_fused = gens["fused"].apply(params, x)  # same tree loads
+        np.testing.assert_allclose(np.asarray(out_pad),
+                                   np.asarray(out_fused),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_param_tree_identical_with_scan_blocks(self):
+        x = jnp.zeros((1, 64, 64, 3))
+        trees = {}
+        for impl in ("pad", "fused"):
+            gen = ResNetGenerator(pad_impl=impl, scan_blocks=True)
+            trees[impl] = jax.eval_shape(gen.init, jax.random.PRNGKey(0), x)
+        assert (jax.tree.map(lambda l: l.shape, trees["pad"]) ==
+                jax.tree.map(lambda l: l.shape, trees["fused"]))
+
+    def test_fused_init_statistics_match_conv_init(self):
+        # ReflectConv must init kernels N(0, 0.02) like nn.Conv does
+        # (reference model.py:10-11) — same init fn, same param dtype.
+        cfg = GeneratorConfig(filters=32, num_residual_blocks=2)
+        gen = ResNetGenerator(config=cfg, pad_impl="fused")
+        params = gen.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, 32, 32, 3)))
+        kernels = [l for p, l in jax.tree_util.tree_flatten_with_path(params)[0]
+                   if any(getattr(q, "key", None) == "kernel" for q in p)]
+        flat = np.concatenate([np.asarray(k).ravel() for k in kernels])
+        assert abs(flat.mean()) < 5e-3
+        assert abs(flat.std() - 0.02) < 5e-3
+        assert all(k.dtype == jnp.float32 for k in kernels)

@@ -48,7 +48,8 @@ def register_local_only() -> None:
 
 
 def build_step(compute_dtype: str, batch: int, image: int, remat: bool = False,
-               scan_blocks: bool = False, pad_mode: str = "reflect"):
+               scan_blocks: bool = False, pad_mode: str = "reflect",
+               pad_impl: str = "pad"):
     import jax
 
     from cyclegan_tpu.config import Config, ModelConfig, TrainConfig
@@ -57,7 +58,7 @@ def build_step(compute_dtype: str, batch: int, image: int, remat: bool = False,
     cfg = Config(
         model=ModelConfig(
             compute_dtype=compute_dtype, image_size=image, remat=remat,
-            scan_blocks=scan_blocks, pad_mode=pad_mode,
+            scan_blocks=scan_blocks, pad_mode=pad_mode, pad_impl=pad_impl,
         ),
         train=TrainConfig(batch_size=batch),
     )
@@ -72,13 +73,14 @@ def build_step(compute_dtype: str, batch: int, image: int, remat: bool = False,
 
 def analyze(tag: str, compute_dtype: str, batch: int, image: int,
             remat: bool = False, scan_blocks: bool = False,
-            pad_mode: str = "reflect", hlo_excerpt: bool = False) -> dict:
+            pad_mode: str = "reflect", pad_impl: str = "pad",
+            hlo_excerpt: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
     say(f"{tag}: building")
     cfg, state, step = build_step(compute_dtype, batch, image, remat,
-                                  scan_blocks, pad_mode)
+                                  scan_blocks, pad_mode, pad_impl)
     x = jax.ShapeDtypeStruct((batch, image, image, 3), jnp.float32)
     y = jax.ShapeDtypeStruct((batch, image, image, 3), jnp.float32)
     w = jax.ShapeDtypeStruct((batch,), jnp.float32)
@@ -155,16 +157,24 @@ def analyze(tag: str, compute_dtype: str, batch: int, image: int,
 
 
 def main() -> None:
+    # Parse args BEFORE the (slow) backend registration so usage errors
+    # fail in milliseconds, not after a libtpu init.
+    fast = "--fast" in sys.argv
+    only = None
+    if "--only" in sys.argv:
+        idx = sys.argv.index("--only")
+        if idx + 1 >= len(sys.argv):
+            raise SystemExit(
+                "usage: aot_analyze.py [--fast] [--only SUBSTRING] — "
+                "--only needs a job-name substring"
+            )
+        only = sys.argv[idx + 1]
+
     register_local_only()
     say("registered local_only AOT backend")
     import jax
 
     say(f"devices: {jax.devices()}")
-
-    fast = "--fast" in sys.argv
-    only = None
-    if "--only" in sys.argv:
-        only = sys.argv[sys.argv.index("--only") + 1]
     jobs = {
         "scan-headline-equivalent step/bf16/b16/256": dict(
             compute_dtype="bfloat16", batch=16, image=256, hlo_excerpt=True),
@@ -188,6 +198,13 @@ def main() -> None:
             "pad-probe step/bf16/b16/256/zero-pad": dict(
                 compute_dtype="bfloat16", batch=16, image=256,
                 pad_mode="zero", hlo_excerpt=True),
+            # pad-fused: same reflect semantics as the headline, scheduled
+            # as ReflectConv (ops/padding.py:reflect_conv — zero-pad conv
+            # + fusible border corrections). Measures how much of the
+            # 32% pad traffic the parity-preserving fix recovers.
+            "pad-fused step/bf16/b16/256/reflect-fused": dict(
+                compute_dtype="bfloat16", batch=16, image=256,
+                pad_impl="fused", hlo_excerpt=True),
         })
 
     if only is not None:

@@ -1,0 +1,84 @@
+"""Forward-only generator traffic probe: pad vs zero vs fused, offline.
+
+The full-step pad-fused AOT job came back WORSE than the materialized-pad
+baseline (317 GB vs 227.3 GB — docs/aot_analysis.json), against the
+fusion-epilogue prediction. This probe compiles ONLY the generator
+forward (no grads, no optimizer) for each pad scheme, so the regression
+can be attributed: if fused-forward is near zero-forward, the blowup is
+in autodiff's backward (thin-slice VJPs scatter-adding into full-size
+zeros — fixable with a custom VJP); if fused-forward is already bad, the
+zero-pad-conv + pad/add-correction epilogue itself does not fuse on
+XLA:TPU and the schedule needs a different shape (e.g. concat assembly).
+
+Run: PALLAS_AXON_POOL_IPS= python tools/aot_fwd_probe.py
+Appends results as jobs named "fwd-probe gen/<scheme>/bf16/b16/256" to
+docs/aot_analysis.json (merge semantics — aot_analyze.merge_into_report).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from aot_analyze import (  # noqa: E402
+    extract_analysis,
+    merge_into_report,
+    register_local_only,
+    say,
+)
+
+
+def main() -> None:
+    register_local_only()
+    say("registered local_only AOT backend")
+    import jax
+    import jax.numpy as jnp
+
+    from cyclegan_tpu.config import GeneratorConfig
+    from cyclegan_tpu.models import ResNetGenerator
+
+    batch, image = 16, 256
+    schemes = {
+        "pad": dict(pad_mode="reflect", pad_impl="pad"),
+        "zero": dict(pad_mode="zero", pad_impl="pad"),
+        "fused": dict(pad_mode="reflect", pad_impl="fused"),
+    }
+    jobs = {}
+    for name, kw in schemes.items():
+        tag = f"fwd-probe gen/{name}/bf16/b{batch}/{image}"
+        say(f"{tag}: building")
+        gen = ResNetGenerator(
+            config=GeneratorConfig(), dtype=jnp.bfloat16, **kw
+        )
+        x = jax.ShapeDtypeStruct((batch, image, image, 3), jnp.float32)
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            params = jax.eval_shape(
+                gen.init, jax.random.PRNGKey(0),
+                jnp.zeros((1, image, image, 3), jnp.float32),
+            )
+        say(f"{tag}: lowering + compiling")
+        t0 = time.perf_counter()
+        try:
+            compiled = jax.jit(gen.apply).lower(params, x).compile()
+            out = extract_analysis(compiled)
+            out["compile_seconds"] = round(time.perf_counter() - t0, 1)
+            ca = out.get("cost_analysis", {})
+            say(f"{tag}: {ca.get('bytes accessed', 0) / 1e9:.1f} GB, "
+                f"{out['compile_seconds']}s")
+        except Exception as e:  # record, keep probing other schemes
+            out = {"error": f"{type(e).__name__}: {e}"}
+            say(f"{tag}: FAILED {out['error']}")
+        out["config"] = dict(kw, batch=batch, image=image, fwd_only=True)
+        jobs[tag] = out
+
+    merge_into_report(jobs)
+    for tag, j in jobs.items():
+        ca = j.get("cost_analysis", {})
+        print(tag, round(ca.get("bytes accessed", 0) / 1e9, 2), "GB")
+
+
+if __name__ == "__main__":
+    main()
