@@ -47,33 +47,39 @@ def _conv(x, k, padding):
     )
 
 
-def _top_correction(x, k, p):
-    """Missing-tap contributions for output rows [0, p).
+def _h_edge_correction(strip, ksub, p):
+    """Missing-tap contributions for the p output rows nearest an H edge.
 
-    For output row i < p, the taps at input rows r = i + a - p < 0 read
-    x[-r] under reflection but 0 under zero padding. Those contributions
-    reduce to a conv of the H-flipped strip x[p..1] with the kernel's top
-    p rows: corr[i] = sum_{u=1..p-i} x[u] * k[p-i-u]  (derivation: sub
-    u = p - i - a). One-sided zero H-padding (0, p-1) realizes the
-    shrinking overlap; reflect W-padding makes the same strip also carry
-    the corner taps (r < 0 AND c outside), so the side corrections can
-    stay row-exact without double counting.
+    For output row i < p (top edge), the taps at input rows
+    r = i + a - p < 0 read x[-r] under reflection but 0 under zero
+    padding. Those contributions reduce to a conv of the mirror-ordered
+    strip (strip[m] = x[mirror row m], i.e. x rows p..1 for the top)
+    with the kernel's first p rows: corr[i] = sum_{u=1..p-i}
+    x[u] * k[p-i-u] (derivation: sub u = p - i - a). One-sided zero
+    H-padding (0, p-1) realizes the shrinking overlap; reflect W-padding
+    makes the same strip also carry the corner taps (r < 0 AND c
+    outside), so the W-edge corrections can stay row-exact without
+    double counting.
+
+    The caller passes thin strips only — never a full-size flip of x
+    (an earlier jnp.flip(x)-based formulation materialized a full-size
+    reverse per edge; the block-level HLO probe caught it).
     """
-    strip = x[:, p:0:-1]  # rows p..1 (H-flipped), full W
     strip = jnp.pad(strip, ((0, 0), (0, 0), (p, p), (0, 0)), mode="reflect")
-    return _conv(strip, k[:p], padding=((0, p - 1), (0, 0)))
+    return _conv(strip, ksub, padding=((0, p - 1), (0, 0)))
 
 
-def _left_correction(x, k, p):
-    """Missing-tap contributions for output cols [0, p), in-range rows only.
+def _w_edge_correction(strip, ksub, p):
+    """Missing-tap contributions for the p output cols nearest a W edge,
+    in-range rows only.
 
-    Taps with c < 0 and 0 <= r < H: the W analog of `_top_correction`,
-    except the H axis uses the conv's own symmetric ZERO padding (p, p) —
-    out-of-range rows contribute nothing here because `_top_correction` /
-    its bottom mirror already counted them (with W-reflection).
+    Taps with c < 0 and 0 <= r < H: the W analog of
+    `_h_edge_correction`, except the H axis uses the conv's own
+    symmetric ZERO padding (p, p) — out-of-range rows contribute nothing
+    here because the H-edge corrections already counted them (with
+    W-reflection).
     """
-    strip = x[:, :, p:0:-1]  # cols p..1 (W-flipped), full H
-    return _conv(strip, k[:, :p], padding=((p, p), (0, p - 1)))
+    return _conv(strip, ksub, padding=((p, p), (0, p - 1)))
 
 
 def reflect_conv(x: jnp.ndarray, k: jnp.ndarray, pad: int) -> jnp.ndarray:
@@ -114,13 +120,32 @@ def reflect_conv(x: jnp.ndarray, k: jnp.ndarray, pad: int) -> jnp.ndarray:
 
     out = _conv(x, k, padding=((p, p), (p, p)))
 
-    corr_t = _top_correction(x, k, p)
+    # Strips are THIN slices of x; only thin outputs and (2p+1)-sized
+    # kernels are ever flipped. The bottom/right strips need no input
+    # flip at all: mirror order under the flipped-image derivation works
+    # out to an ascending slice (z[u] = x[H-1-u] for u = p..1 is just
+    # x rows H-1-p..H-2).
+    kf_h = jnp.flip(k, axis=0)
+    kf_w = jnp.flip(k, axis=1)
+    corr_t = _h_edge_correction(x[:, p:0:-1], k[:p], p)
     corr_b = jnp.flip(
-        _top_correction(jnp.flip(x, axis=1), jnp.flip(k, axis=0), p), axis=1
+        _h_edge_correction(x[:, H - 1 - p:H - 1], kf_h[:p], p), axis=1
     )
-    corr_l = _left_correction(x, k, p)
+    corr_l = _w_edge_correction(x[:, :, p:0:-1], k[:, :p], p)
     corr_r = jnp.flip(
-        _left_correction(jnp.flip(x, axis=2), jnp.flip(k, axis=1), p), axis=2
+        _w_edge_correction(x[:, :, W - 1 - p:W - 1], kf_w[:, :p], p), axis=2
+    )
+
+    # Without this barrier XLA:TPU folds each thin zero-pad embed below
+    # INTO its producer conv's window padding (pad=..x(H-p)_0), turning
+    # all four correction convs into FULL-SIZE-output convolutions — and
+    # conv outputs always materialize on TPU, so the "corrections" cost
+    # more than the pads they replace (single-site HLO probe: 142.1 MB
+    # no-barrier vs 75.4 with, vs 103.0 materialized-pad / 67.9 zero).
+    # The barrier keeps the conv outputs thin; the pad+add epilogue then
+    # loop-fuses into the consumer.
+    corr_t, corr_b, corr_l, corr_r = lax.optimization_barrier(
+        (corr_t, corr_b, corr_l, corr_r)
     )
 
     zero = ((0, 0), (0, H - p), (0, 0), (0, 0))

@@ -66,3 +66,20 @@ def test_cli_emits_json_line():
     line = json.loads(r.stdout.strip().splitlines()[-1])
     assert line["metric"] == "weak_scaling_efficiency_predicted"
     assert line["value"] >= 0.98
+
+
+def test_measured_ips_constant_matches_onchip_record():
+    """VERDICT r3 weak #5: the scaling model's hard-coded measured
+    throughput must not drift from the committed on-chip record
+    (docs/bench_r03_onchip.json, warm run, scan/bfloat16/b16)."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "bench_r03_onchip.json")
+    with open(path) as f:
+        runs = json.load(f)
+    warm = [r["record"] for r in runs if str(r.get("run", "")).startswith("warm")]
+    assert warm, "no warm run in the on-chip record"
+    measured = warm[-1]["all"]["scan/bfloat16/b16"]
+    assert warm[-1]["platform"] == "tpu"
+    assert abs(scaling_model.MEASURED_V5E_IPS - measured) <= 1.0, (
+        f"MEASURED_V5E_IPS={scaling_model.MEASURED_V5E_IPS} drifted from "
+        f"the on-chip record {measured}")
