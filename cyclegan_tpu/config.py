@@ -153,6 +153,12 @@ class TrainConfig:
     # reference's per-step host loop. Epoch remainders (< K full batches)
     # run through the single-step program for exact semantics.
     steps_per_dispatch: int = 1
+    # Device prefetch depth: how many dispatch-ready batch groups the
+    # input thread stages (host prep + device_put) ahead of the training
+    # loop, overlapping H2D with device compute. The reference pipeline's
+    # .prefetch(AUTOTUNE) analog (main.py:72) extended to device staging;
+    # 0 = stage inline on the loop thread (pre-round-4 behavior).
+    prefetch_batches: int = 2
     # TPU knob (no reference counterpart): gradient accumulation. The
     # effective global batch becomes n_data * batch_size * grad_accum,
     # with per-device activation memory tracking only the microbatch —

@@ -32,8 +32,6 @@ TPU-first differences:
 from __future__ import annotations
 
 import math
-import queue
-import threading
 from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -44,39 +42,10 @@ from cyclegan_tpu.data.augment import (
     preprocess_test,
     preprocess_train,
 )
+from cyclegan_tpu.data.prefetch import prefetch_iter
 from cyclegan_tpu.data.sources import Source, resolve_source, split_tag
 
 Batch = Tuple[np.ndarray, np.ndarray, np.ndarray]  # x, y, weights
-
-
-class _Prefetcher:
-    """Tiny background-thread prefetcher (depth-2 queue)."""
-
-    def __init__(self, it: Iterator[Batch], depth: int = 2):
-        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
-        self._sentinel = object()
-        self._err: Optional[BaseException] = None
-
-        def run():
-            try:
-                for item in it:
-                    self._q.put(item)
-            except BaseException as e:  # propagate to consumer
-                self._err = e
-            finally:
-                self._q.put(self._sentinel)
-
-        self._t = threading.Thread(target=run, daemon=True)
-        self._t.start()
-
-    def __iter__(self):
-        while True:
-            item = self._q.get()
-            if item is self._sentinel:
-                if self._err is not None:
-                    raise self._err
-                return
-            yield item
 
 
 class CycleGANData:
@@ -266,7 +235,7 @@ class CycleGANData:
             self._epoch_order(epoch, 0, self.n_train),
             self._epoch_order(epoch, 1, self.n_train),
         )
-        return iter(_Prefetcher(it)) if prefetch else it
+        return prefetch_iter(it, depth=2) if prefetch else it
 
     def test_epoch(self, prefetch: bool = True) -> Iterator[Batch]:
         order = np.arange(self.n_test)
@@ -274,7 +243,7 @@ class CycleGANData:
             self._test_a.__getitem__, self._test_b.__getitem__, order, order,
             gbs=self.test_batch_size,
         )
-        return iter(_Prefetcher(it)) if prefetch else it
+        return prefetch_iter(it, depth=2) if prefetch else it
 
     def plot_pairs(self, k: Optional[int] = None) -> List[Tuple[np.ndarray, np.ndarray]]:
         """First k test pairs at batch 1 (main.py:76-77), normalized."""

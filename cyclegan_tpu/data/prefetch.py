@@ -1,0 +1,80 @@
+"""Background-thread device prefetch for the training loop.
+
+TPU-native equivalent of the reference pipeline's host-side
+`.prefetch(tf.data.AUTOTUNE)` (/root/reference/main.py:72), extended to
+DEVICE staging: the worker thread runs the whole batch-prep chain —
+host-side stacking plus `jax.device_put` against the mesh shardings — so
+the H2D transfer of batch N+1..N+depth overlaps the device compute of
+batch N instead of sitting on the dispatch critical path.
+`train/loop.py` threads it around `_staged_batches`; depth is
+`TrainConfig.prefetch_batches` (0 disables — staging runs inline on the
+consumer thread, the pre-round-4 behavior).
+
+JAX calls (`device_put`, `make_array_from_process_local_data`) are
+thread-safe for this producer/consumer split; the jitted step dispatches
+stay on the caller's thread.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator
+
+_SENTINEL = object()
+
+
+def prefetch_iter(src: Iterable, depth: int) -> Iterator:
+    """Iterate `src` on a daemon worker thread, keeping up to `depth + 1`
+    items staged ahead of the consumer (`depth` queued, plus the one the
+    worker has already produced and is blocked on enqueueing).
+
+    Exceptions raised by `src` re-raise at the consumer's next pull
+    (after already-staged items drain). Abandoning the iterator (consumer
+    exception / early close) stops the worker promptly via the
+    generator's `finally` instead of leaking a blocked thread.
+    """
+    if depth < 1:
+        raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+    return _prefetch_gen(src, depth)
+
+
+def _prefetch_gen(src: Iterable, depth: int) -> Iterator:
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    err: list = []
+
+    def _put(item) -> bool:
+        """Bounded put that gives up when the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker() -> None:
+        try:
+            for item in src:
+                if not _put(item):
+                    return
+        except BaseException as e:  # propagate to the consumer
+            err.append(e)
+        finally:
+            _put(_SENTINEL)
+
+    thread = threading.Thread(
+        target=worker, daemon=True, name="cyclegan-prefetch"
+    )
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        stop.set()

@@ -17,6 +17,9 @@ same parameter tree).
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -98,6 +101,16 @@ def reflect_conv(x: jnp.ndarray, k: jnp.ndarray, pad: int) -> jnp.ndarray:
     site (~32% of step HBM traffic at the headline config;
     docs/aot_analysis.json pad-probe vs pad-fused jobs).
 
+    The backward pass is a CUSTOM VJP with the same structure: the bulk
+    input/kernel gradients are XLA's own backward programs for the
+    zero-padded conv (obtained via jax.vjp, so the compiler picks the
+    conv-grad algorithms), plus barrier-protected thin edge-correction
+    transposes. Plain autodiff of the forward was measured WORSE than
+    the materialized-pad baseline (240.6 vs 227.3 GB/step,
+    docs/aot_analysis.json): the transposed graph re-creates the
+    embed-into-conv-window merges the forward barrier prevents, and the
+    thin-slice transposes scatter into full-size buffers per edge.
+
     Requires kernel size (2·pad+1)² (the generator's 3×3/pad-1 and
     7×7/pad-3 sites) and H, W > 2·pad.
 
@@ -117,7 +130,12 @@ def reflect_conv(x: jnp.ndarray, k: jnp.ndarray, pad: int) -> jnp.ndarray:
         raise ValueError(
             f"reflect_conv needs H, W > 2*pad; got {H}x{W} for pad={p}"
         )
+    return _reflect_conv(x, k, p)
 
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _reflect_conv(x, k, p):
+    H, W = x.shape[1], x.shape[2]
     out = _conv(x, k, padding=((p, p), (p, p)))
 
     # Strips are THIN slices of x; only thin outputs and (2p+1)-sized
@@ -154,3 +172,83 @@ def reflect_conv(x: jnp.ndarray, k: jnp.ndarray, pad: int) -> jnp.ndarray:
     out = out + jnp.pad(corr_l, ((0, 0), (0, 0), (0, W - p), (0, 0)))
     out = out + jnp.pad(corr_r, ((0, 0), (0, 0), (W - p, 0), (0, 0)))
     return out
+
+
+def _reflect_conv_fwd(x, k, p):
+    # Residuals are x and k only — unlike autodiff of the materialized-pad
+    # formulation, no (H+2p)² padded activation stays live for backward.
+    return _reflect_conv(x, k, p), (x, k)
+
+
+def _reflect_conv_bwd(p, res, g):
+    """Hand-scheduled transpose mirroring the forward's structure.
+
+    Linearity: reflect_conv = C0 + Σ_e Embed_e∘conv_e∘Strip_e, so the
+    cotangent splits the same way — bulk via XLA's own conv-grad
+    programs for the zero-padded conv (jax.vjp picks them), edge terms
+    via jax.vjp of each thin correction closure. Embed^T is a thin slice
+    of g; Strip^T re-embeds a THIN tensor into x-sized zeros, whose
+    producer after the barrier is elementwise — so the four dx embeds
+    loop-fuse into the dx accumulation instead of materializing
+    full-size conv outputs (the failure mode of plain autodiff here).
+    """
+    x, k = res
+    H, W = x.shape[1], x.shape[2]
+    kh, kw = k.shape[0], k.shape[1]
+    kf_h = jnp.flip(k, axis=0)
+    kf_w = jnp.flip(k, axis=1)
+
+    _, vjp0 = jax.vjp(lambda x_, k_: _conv(x_, k_, ((p, p), (p, p))), x, k)
+    dx, dk = vjp0(g)
+
+    # Top edge: corr_t = h_edge(strip_t, k[:p]) embedded at rows [0, p);
+    # strip_t = x[:, p:0:-1] (x rows p..1 reversed).
+    _, vjp_t = jax.vjp(
+        lambda s, ks: _h_edge_correction(s, ks, p), x[:, p:0:-1], k[:p]
+    )
+    ds_t, dks_t = lax.optimization_barrier(vjp_t(g[:, :p]))
+    dx = dx + jnp.pad(
+        ds_t[:, ::-1], ((0, 0), (1, H - p - 1), (0, 0), (0, 0))
+    )
+    dk = dk + jnp.pad(dks_t, ((0, kh - p), (0, 0), (0, 0), (0, 0)))
+
+    # Bottom edge: corr_b = flip_H(h_edge(strip_b, kf_h[:p])) at rows
+    # [H-p, H); strip_b = x rows [H-1-p, H-1); kf_h[:p][i] = k[kh-1-i].
+    _, vjp_b = jax.vjp(
+        lambda s, ks: _h_edge_correction(s, ks, p),
+        x[:, H - 1 - p:H - 1], kf_h[:p],
+    )
+    ds_b, dks_b = lax.optimization_barrier(vjp_b(jnp.flip(g[:, H - p:], axis=1)))
+    dx = dx + jnp.pad(ds_b, ((0, 0), (H - 1 - p, 1), (0, 0), (0, 0)))
+    dk = dk + jnp.pad(
+        jnp.flip(dks_b, axis=0), ((kh - p, 0), (0, 0), (0, 0), (0, 0))
+    )
+
+    # Left edge: corr_l = w_edge(strip_l, k[:, :p]) at cols [0, p);
+    # strip_l = x[:, :, p:0:-1].
+    _, vjp_l = jax.vjp(
+        lambda s, ks: _w_edge_correction(s, ks, p), x[:, :, p:0:-1], k[:, :p]
+    )
+    ds_l, dks_l = lax.optimization_barrier(vjp_l(g[:, :, :p]))
+    dx = dx + jnp.pad(
+        ds_l[:, :, ::-1], ((0, 0), (0, 0), (1, W - p - 1), (0, 0))
+    )
+    dk = dk + jnp.pad(dks_l, ((0, 0), (0, kw - p), (0, 0), (0, 0)))
+
+    # Right edge: corr_r = flip_W(w_edge(strip_r, kf_w[:, :p])) at cols
+    # [W-p, W); strip_r = x cols [W-1-p, W-1); kf_w[:, :p][:, j] = k[:, kw-1-j].
+    _, vjp_r = jax.vjp(
+        lambda s, ks: _w_edge_correction(s, ks, p),
+        x[:, :, W - 1 - p:W - 1], kf_w[:, :p],
+    )
+    ds_r, dks_r = lax.optimization_barrier(
+        vjp_r(jnp.flip(g[:, :, W - p:], axis=2))
+    )
+    dx = dx + jnp.pad(ds_r, ((0, 0), (0, 0), (W - 1 - p, 1), (0, 0)))
+    dk = dk + jnp.pad(
+        jnp.flip(dks_r, axis=1), ((0, 0), (kw - p, 0), (0, 0), (0, 0))
+    )
+    return dx, dk
+
+
+_reflect_conv.defvjp(_reflect_conv_fwd, _reflect_conv_bwd)

@@ -49,6 +49,52 @@ def _progress(it, total: int, desc: str, verbose: int):
         return it
 
 
+def _staged_batches(config: Config, data: CycleGANData, plan: MeshPlan,
+                    epoch: int, multi: bool):
+    """Yield dispatch-ready device batches: ("multi"|"accum"|"single",
+    sharded arrays).
+
+    All host-side prep (K-stacking, accum reshape) AND the device_put
+    against the mesh shardings happen HERE, so running this generator on
+    the prefetch worker thread (data/prefetch.py) overlaps the next
+    dispatches' H2D transfers with the current device compute. K-group
+    remainders fall through to the per-step program — the same update
+    sequence as the inline loop (tests/test_multistep.py).
+    """
+    k = config.train.steps_per_dispatch
+    accum = config.train.grad_accum
+    # When the device-prefetch worker runs this generator, the pipeline's
+    # own host-side prefetch hop is redundant (two threads + two queues
+    # double-buffering every batch) — the worker IS the background thread.
+    host_prefetch = config.train.prefetch_batches == 0
+    buf = []
+    for x, y, w in data.train_epoch(epoch, prefetch=host_prefetch):
+        if multi and k > 1:
+            buf.append((x, y, w))
+            if len(buf) == k:
+                yield "multi", shard_stacked_batch(
+                    plan,
+                    np.stack([b[0] for b in buf]),
+                    np.stack([b[1] for b in buf]),
+                    np.stack([b[2] for b in buf]),
+                )
+                buf = []
+            continue
+        if accum > 1:
+            yield "accum", shard_stacked_batch(
+                plan,
+                x.reshape(accum, -1, *x.shape[1:]),
+                y.reshape(accum, -1, *y.shape[1:]),
+                w.reshape(accum, -1),
+            )
+        else:
+            yield "single", shard_batch(plan, x, y, w)
+    # Remainder: fewer than K batches left — per-step program, exact
+    # semantics (a zero-weight padded step would still decay Adam moments).
+    for x, y, w in buf:
+        yield "single", shard_batch(plan, x, y, w)
+
+
 def train_epoch(
     config: Config,
     data: CycleGANData,
@@ -89,9 +135,6 @@ def train_epoch(
     # unbounded number of steps whose input batches stay pinned on device.
     pending: list = []
     fetched: list = []
-    it = _progress(
-        data.train_epoch(epoch), data.train_steps, "Train", config.train.verbose
-    )
 
     def append_metrics(metrics, steps: int = 1, pinned: int = None):
         # Backpressure counts PINNED BATCHES, not dispatches: a fused
@@ -104,44 +147,50 @@ def train_epoch(
         while sum(p for _, _, p in pending) > max(MAX_IN_FLIGHT, pinned):
             fetched.append(jax.device_get(pending.pop(0)))
 
-    buf = []
-    for x, y, w in it:
-        if multi_step_fn is not None and k > 1:
-            buf.append((x, y, w))
-            if len(buf) == k:
-                if tracer is not None:
-                    tracer.step()  # one trace unit = one fused dispatch
-                xs, ys, ws = shard_stacked_batch(
-                    plan,
-                    np.stack([b[0] for b in buf]),
-                    np.stack([b[1] for b in buf]),
-                    np.stack([b[2] for b in buf]),
-                )
-                state, metrics = multi_step_fn(state, xs, ys, ws)
-                append_metrics(metrics, steps=k)
-                buf = []
-            continue
-        if tracer is not None:
-            tracer.step()  # before dispatch: full steps land in the window
-        if accum > 1:
-            xs, ys, ws = shard_stacked_batch(
-                plan,
-                x.reshape(accum, -1, *x.shape[1:]),
-                y.reshape(accum, -1, *y.shape[1:]),
-                w.reshape(accum, -1),
-            )
-        else:
-            xs, ys, ws = shard_batch(plan, x, y, w)
-        state, metrics = step_fn(state, xs, ys, ws)
-        append_metrics(metrics, pinned=accum)
-    # Remainder: fewer than K batches left — per-step program, exact
-    # semantics (a zero-weight padded step would still decay Adam moments).
-    for x, y, w in buf:
-        if tracer is not None:
+    multi = multi_step_fn is not None and k > 1
+    staged = _staged_batches(config, data, plan, epoch, multi)
+    depth = config.train.prefetch_batches
+    if depth > 0:
+        # Device staging runs ahead on a worker thread (reference
+        # pipeline analog: .prefetch(AUTOTUNE), main.py:72). Pinned-HBM
+        # note: this adds up to depth+1 more staged batch groups (each K
+        # or A batches; +1 = the group the worker holds while the queue
+        # is full) beyond the MAX_IN_FLIGHT fetch window.
+        from cyclegan_tpu.data.prefetch import prefetch_iter
+
+        staged = prefetch_iter(staged, depth)
+    n_dispatch = (
+        data.train_steps // k + data.train_steps % k if multi
+        else data.train_steps
+    )
+    it = iter(_progress(staged, n_dispatch, "Train", config.train.verbose))
+
+    while True:
+        # One trace unit = one dispatch (a fused dispatch carries K
+        # steps). At depth 0 staging runs inline inside next(it), so
+        # stepping the tracer FIRST keeps the H2D transfer inside the
+        # traced window — the historical --trace semantics. With
+        # prefetch, staging happened on the worker thread and the window
+        # shows dispatch + device compute only. (A trailing step() when
+        # the iterator is exhausted is harmless: TraceCapture.step() is a
+        # no-op once stopped/disabled.)
+        if tracer is not None and depth == 0:
             tracer.step()
-        xs, ys, ws = shard_batch(plan, x, y, w)
-        state, metrics = step_fn(state, xs, ys, ws)
-        append_metrics(metrics)
+        try:
+            kind, (xs, ys, ws) = next(it)
+        except StopIteration:
+            break
+        if tracer is not None and depth > 0:
+            tracer.step()
+        if kind == "multi":
+            state, metrics = multi_step_fn(state, xs, ys, ws)
+            append_metrics(metrics, steps=k)
+        elif kind == "accum":
+            state, metrics = step_fn(state, xs, ys, ws)
+            append_metrics(metrics, pinned=accum)
+        else:
+            state, metrics = step_fn(state, xs, ys, ws)
+            append_metrics(metrics)
 
     results: Dict[str, list] = {}
     for metrics, steps, _ in fetched + jax.device_get(pending):

@@ -98,11 +98,14 @@ def main(args: argparse.Namespace) -> None:
             verbose=args.verbose,
             clear_output_dir=args.clear_output_dir,
             steps_per_dispatch=args.steps_per_dispatch,
+            prefetch_batches=args.prefetch_batches,
             grad_accum=args.grad_accum,
         ),
     )
     if config.train.grad_accum < 1 or config.train.steps_per_dispatch < 1:
         raise SystemExit("--grad_accum and --steps_per_dispatch must be >= 1")
+    if config.train.prefetch_batches < 0:
+        raise SystemExit("--prefetch_batches must be >= 0")
     if config.train.grad_accum > 1 and config.train.steps_per_dispatch > 1:
         raise SystemExit(
             "--grad_accum and --steps_per_dispatch are mutually exclusive "
@@ -332,6 +335,12 @@ if __name__ == "__main__":
                         help="fuse this many train steps into one lax.scan "
                              "dispatch (amortizes host->device latency; "
                              "identical update sequence to 1)")
+    parser.add_argument("--prefetch_batches", default=2, type=int,
+                        help="stage this many dispatch-ready batch groups "
+                             "ahead on an input thread (device_put included) "
+                             "so H2D overlaps device compute — the "
+                             "reference's .prefetch(AUTOTUNE) analog; "
+                             "0 stages inline")
     parser.add_argument("--trace", default=0, type=int, metavar="N",
                         help="capture a jax.profiler trace of N train steps "
                              "(steps 2..N+1 — step 1 is compile) to "

@@ -87,7 +87,7 @@ def test_train_epoch_with_remainder(tiny_config, devices):
         def __init__(self, batches):
             self.batches = batches
 
-        def train_epoch(self, epoch):
+        def train_epoch(self, epoch, prefetch=True):
             return iter(self.batches)
 
     class _NullSummary:
