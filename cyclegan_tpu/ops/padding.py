@@ -111,6 +111,14 @@ def reflect_conv(x: jnp.ndarray, k: jnp.ndarray, pad: int) -> jnp.ndarray:
     embed-into-conv-window merges the forward barrier prevents, and the
     thin-slice transposes scatter into full-size buffers per edge.
 
+    Measured outcome (docs/BENCHMARKS.md "Round 4" section): the win
+    over materialized pads decays with graph depth — 100% of the
+    pad-vs-zero gap at one site, 56% at a block's gradient, 8% at the
+    full train step (221.1 vs 227.3 GB) — because XLA's layout
+    assignment reconciles the thin convs' T(2,128)-style tilings with
+    the main convs' T(8,128) via full-tensor layout copies. A modest,
+    exact-semantics improvement, not the -32% of pad_mode="zero".
+
     Requires kernel size (2·pad+1)² (the generator's 3×3/pad-1 and
     7×7/pad-3 sites) and H, W > 2·pad.
 
