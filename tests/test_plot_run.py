@@ -56,3 +56,21 @@ def test_plot_renders_matching_tags(run_dir, tmp_path):
 def test_plot_unmatched_tags_fail_loudly(run_dir, tmp_path):
     with pytest.raises(SystemExit):
         plot(read_scalars(run_dir), ["nope/.*"], str(tmp_path / "x.png"))
+
+
+def test_pad_ab_report_runs_and_compares(run_dir, tmp_path, capsys,
+                                         monkeypatch):
+    """tools/pad_ab_report.py: end-to-end over Summary-written events —
+    FID rows appear, MAE placeholders render, loss divergence vs the
+    control computes over common epochs."""
+    import pad_ab_report
+
+    monkeypatch.setattr(sys, "argv", ["pad_ab_report.py", "--runs",
+                                      f"control={run_dir}",
+                                      f"variant={run_dir}"])
+    pad_ab_report.main()
+    out = capsys.readouterr().out
+    assert "fid/G_vs_B" in out
+    assert "MAE(X, F(G(X)))" in out
+    # identical runs -> zero divergence on the shared loss tag
+    assert "| `loss_G/total` | 0.0000 |" in out

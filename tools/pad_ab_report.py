@@ -1,0 +1,102 @@
+"""Compare the pad-scheme quality A/B runs (reflect vs zero vs fused).
+
+VERDICT r3 item 2's CPU half: same data, same seeds, same budget —
+only the pad flags differ. Reads each run's TensorBoard event files
+(tools/plot_run.py reader) and prints a markdown comparison of:
+- final + trajectory FID (fid/<featurizer>/G(A)_vs_B and F(B)_vs_A),
+- the four reference test MAE metrics at the final epoch,
+- generator/discriminator loss-curve divergence vs the reflect control
+  (max |Δ| over epochs; fused should shadow reflect until fp-level
+  divergence compounds, zero may genuinely differ).
+
+Usage:
+  python tools/pad_ab_report.py --runs reflect=/tmp/ab_reflect \
+      zero=/tmp/ab_zero fused=/tmp/ab_fused
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from plot_run import read_scalars  # noqa: E402
+
+# tensorboardX sanitizes tag punctuation: "error/MAE(X, F(G(X)))" is
+# stored as "error/MAE_X__F_G_X___".
+ERROR_TAGS = [
+    ("MAE(X, F(G(X)))", "test/error/MAE_X__F_G_X___"),
+    ("MAE(X, F(X))", "test/error/MAE_X__F_X__"),
+    ("MAE(Y, G(F(Y)))", "test/error/MAE_Y__G_F_Y___"),
+    ("MAE(Y, G(Y))", "test/error/MAE_Y__G_Y__"),
+]
+LOSS_TAGS = ["loss_G/total", "loss_F/total", "loss_X/loss", "loss_Y/loss"]
+
+
+def last(series, tag):
+    pts = series.get(tag) or []
+    return pts[-1][1] if pts else None
+
+
+def fid_tags(series):
+    return sorted(t for t in series if t.startswith("fid/") or "/fid/" in t)
+
+
+def fmt(v):
+    return "—" if v is None else f"{v:.4f}"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--runs", nargs="+", required=True,
+                    metavar="NAME=DIR", help="first run is the control")
+    args = ap.parse_args()
+    runs = {}
+    for spec in args.runs:
+        name, _, d = spec.partition("=")
+        if not d or not os.path.isdir(d):
+            raise SystemExit(f"bad run spec or missing dir: {spec}")
+        if name in runs:
+            raise SystemExit(f"duplicate run name: {name}")
+        runs[name] = read_scalars(d)
+    control_name = next(iter(runs))
+    control = runs[control_name]
+
+    print(f"## Pad-scheme A/B ({' vs '.join(runs)})\n")
+
+    all_fid = sorted({t for s in runs.values() for t in fid_tags(s)})
+    if all_fid:
+        print("| FID (final) | " + " | ".join(runs) + " |")
+        print("|---|" + "---|" * len(runs))
+        for t in all_fid:
+            print(f"| `{t}` | " + " | ".join(
+                fmt(last(s, t)) for s in runs.values()) + " |")
+        print()
+
+    print("| test MAE (final epoch) | " + " | ".join(runs) + " |")
+    print("|---|" + "---|" * len(runs))
+    for label, t in ERROR_TAGS:
+        print(f"| `{label}` | " + " | ".join(
+            fmt(last(s, t)) for s in runs.values()) + " |")
+    print()
+
+    if len(runs) > 1:
+        print(f"| max abs Δ loss vs {control_name} | " +
+              " | ".join(n for n in runs if n != control_name) + " |")
+        print("|---|" + "---|" * (len(runs) - 1))
+        for t in LOSS_TAGS:
+            cells = []
+            cpts = dict(control.get(t) or [])
+            for name, s in runs.items():
+                if name == control_name:
+                    continue
+                opts = dict(s.get(t) or [])
+                common = sorted(set(cpts) & set(opts))
+                d = max((abs(cpts[e] - opts[e]) for e in common), default=None)
+                cells.append(fmt(d))
+            print(f"| `{t}` | " + " | ".join(cells) + " |")
+
+
+if __name__ == "__main__":
+    main()
