@@ -112,6 +112,14 @@ def test_config_key_format():
     assert bench._config_key(
         {"mode": "steps", "dtype": "float32", "batch": 4, "image": 512}
     ) == "steps/float32/b4/i512"
+    assert bench._config_key(
+        {"mode": "dispatch", "dtype": "bfloat16", "batch": 16, "k": 8,
+         "prefetch": True}
+    ) == "dispatch/bfloat16/b16/k8/pf"
+    assert bench._config_key(
+        {"mode": "scan", "dtype": "bfloat16", "batch": 16,
+         "pad_impl": "fused"}
+    ) == "scan/bfloat16/b16/fused"
 
 
 def test_flops_accounting_follows_winning_geometry():
@@ -155,6 +163,9 @@ def test_bench_dispatch_smoke(monkeypatch):
     monkeypatch.setattr(bench, "_build", fake_build)
     assert bench.bench_dispatch("float32", 2, image=8, k=1, iters=2) > 0
     assert bench.bench_dispatch("float32", 2, image=8, k=3, iters=2) > 0
+    # round-4 prefetch variant: same program, staged inputs
+    assert bench.bench_dispatch("float32", 2, image=8, k=3, iters=2,
+                                prefetch=True) > 0
 
 
 def test_read_worker_results_tolerates_missing_and_garbage(tmp_path):
