@@ -205,6 +205,11 @@ def main() -> None:
             "pad-fused step/bf16/b16/256/reflect-fused": dict(
                 compute_dtype="bfloat16", batch=16, image=256,
                 pad_impl="fused", hlo_excerpt=True),
+            # Does the zero-pad lever extend to the long-context config?
+            # (512²/b4/remat reflect = 542.2 GB.)
+            "pad-probe-512 step/bf16/b4/512/remat/zero-pad": dict(
+                compute_dtype="bfloat16", batch=4, image=512, remat=True,
+                pad_mode="zero"),
         })
 
     if only is not None:
