@@ -113,16 +113,23 @@ def test_corrupt_record_aborts_before_measuring(tmp_path):
 
 
 @pytest.mark.parametrize("spec,expect", [
-    ("scan:b8", ("scan", 8, 8, False, "reflect", "pad", 256)),
-    ("scan:b16k16", ("scan", 16, 16, False, "reflect", "pad", 256)),
-    ("dispatch:b16", ("dispatch", 16, 1, False, "reflect", "pad", 256)),
-    ("dispatch:b1k1i64", ("dispatch", 1, 1, False, "reflect", "pad", 64)),
-    ("scan:b16pallasi512", ("scan", 16, 8, True, "reflect", "pad", 512)),
-    ("scan:b16zero", ("scan", 16, 8, False, "zero", "pad", 256)),
-    ("dispatch:b16k8zeroi512", ("dispatch", 16, 8, False, "zero", "pad", 512)),
-    ("scan:b16fused", ("scan", 16, 8, False, "reflect", "fused", 256)),
+    ("scan:b8", ("scan", 8, 8, False, "reflect", "pad", False, 256)),
+    ("scan:b16k16", ("scan", 16, 16, False, "reflect", "pad", False, 256)),
+    ("dispatch:b16", ("dispatch", 16, 1, False, "reflect", "pad", False, 256)),
+    ("dispatch:b1k1i64",
+     ("dispatch", 1, 1, False, "reflect", "pad", False, 64)),
+    ("scan:b16pallasi512",
+     ("scan", 16, 8, True, "reflect", "pad", False, 512)),
+    ("scan:b16zero", ("scan", 16, 8, False, "zero", "pad", False, 256)),
+    ("dispatch:b16k8zeroi512",
+     ("dispatch", 16, 8, False, "zero", "pad", False, 512)),
+    ("scan:b16fused", ("scan", 16, 8, False, "reflect", "fused", False, 256)),
     ("dispatch:b16k8fusedi512",
-     ("dispatch", 16, 8, False, "reflect", "fused", 512)),
+     ("dispatch", 16, 8, False, "reflect", "fused", False, 512)),
+    ("dispatch:b16k8pf",
+     ("dispatch", 16, 8, False, "reflect", "pad", True, 256)),
+    ("dispatch:b16k8zeropfi512",
+     ("dispatch", 16, 8, False, "zero", "pad", True, 512)),
 ])
 def test_spec_grammar(spec, expect):
     assert chip_sweep.parse_spec(spec) == expect
@@ -131,7 +138,8 @@ def test_spec_grammar(spec, expect):
 @pytest.mark.parametrize("bad", ["scan:i512b8", "scan:b0", "scan:b16k0",
                                  "steps:b1", "scan:b8i0", "scan", "",
                                  "scan:b16zeropallas", "scan:b16zerofused",
-                                 "scan:b16fusedzero"])
+                                 "scan:b16fusedzero", "scan:b16pf",
+                                 "dispatch:b16pfk8"])
 def test_spec_grammar_rejects(bad):
     with pytest.raises(SystemExit):
         chip_sweep.parse_spec(bad)

@@ -8,12 +8,16 @@ so the record is regenerable:
 
     python tools/chip_sweep.py scan:b8 scan:b24 scan:b32 scan:b16k16
 
-Spec grammar: <scan|dispatch>:b<batch>[k<K>][pallas][zero|fused][i<image>]
+Spec grammar:
+<scan|dispatch>:b<batch>[k<K>][pallas][zero|fused][pf][i<image>]
 — parts in that order; k defaults to 8 for scan / 1 for dispatch, image
 to 256; `zero` selects pad_mode="zero" (conv built-in SAME padding, the
 compiler-certified −32% traffic variant — docs/BENCHMARKS.md pad-probe);
 `fused` selects pad_impl="fused" (ReflectConv: reflect SEMANTICS without
-materialized pads — the parity-preserving variant of the same lever).
+materialized pads — the parity-preserving variant of the same lever);
+`pf` (dispatch only) stages inputs via the device-prefetch worker — the
+round-4 real-loop contract (`--prefetch_batches`), same XLA program as
+the plain dispatch spec.
 Runs ONE config per spec sequentially in this process (ground rule:
 one axon client at a time). A failed measurement — an OOM, or a pallas
 spec refused off-CPU — is recorded as an error row and the sweep
@@ -42,11 +46,12 @@ RECORD_PATH = os.environ.get("CYCLEGAN_SWEEP_RECORD") or os.path.join(
     "docs", "bench_sweeps.json")
 
 SPEC_RE = re.compile(
-    r"(scan|dispatch):b(\d+)(?:k(\d+))?(pallas)?(zero|fused)?(?:i(\d+))?")
+    r"(scan|dispatch):b(\d+)(?:k(\d+))?(pallas)?(zero|fused)?(pf)?(?:i(\d+))?")
 
 
 def parse_spec(spec: str):
-    """spec -> (mode, batch, k, pallas, pad_mode, pad_impl, image).
+    """spec -> (mode, batch, k, pallas, pad_mode, pad_impl, prefetch,
+    image).
     Raises SystemExit on a malformed spec or zero batch/k/image (the
     regex's \\d+ admits 0, which `k or default` would silently coerce to
     the default — a mislabeled record in a file the docs treat as ground
@@ -55,18 +60,20 @@ def parse_spec(spec: str):
     if not m:
         raise SystemExit(f"bad spec: {spec}")
     pad_word = m.group(5)
-    mode, batch, k, pallas, image = (
+    mode, batch, k, pallas, prefetch, image = (
         m.group(1), int(m.group(2)),
         int(m.group(3)) if m.group(3) else None,
-        bool(m.group(4)),
-        int(m.group(6)) if m.group(6) else 256)
+        bool(m.group(4)), bool(m.group(6)),
+        int(m.group(7)) if m.group(7) else 256)
     pad_mode = "zero" if pad_word == "zero" else "reflect"
     pad_impl = "fused" if pad_word == "fused" else "pad"
     if batch < 1 or image < 1 or (k is not None and k < 1):
         raise SystemExit(f"bad spec: {spec} (batch/k/image must be >= 1)")
+    if prefetch and mode != "dispatch":
+        raise SystemExit(f"bad spec: {spec} (pf applies to dispatch only)")
     if k is None:
         k = 8 if mode == "scan" else 1
-    return mode, batch, k, pallas, pad_mode, pad_impl, image
+    return mode, batch, k, pallas, pad_mode, pad_impl, prefetch, image
 
 
 def _load_records() -> list:
@@ -116,7 +123,8 @@ def _pallas_blocked() -> str | None:
 
 def run_spec(spec: str) -> None:
     # abort BEFORE compile
-    mode, batch, k, pallas, pad_mode, pad_impl, image = parse_spec(spec)
+    mode, batch, k, pallas, pad_mode, pad_impl, prefetch, image = (
+        parse_spec(spec))
     # Honor JAX_PLATFORMS=cpu (the axon sitecustomize overrides the env
     # var; main.py re-asserts it the same way) so the tool is drivable
     # off-chip and fails fast instead of hanging when the relay is down.
@@ -147,7 +155,8 @@ def run_spec(spec: str) -> None:
             ips = bench.bench_dispatch("bfloat16", batch, image=image,
                                        norm_impl=norm, k=k,
                                        pad_mode=pad_mode,
-                                       pad_impl=pad_impl)
+                                       pad_impl=pad_impl,
+                                       prefetch=prefetch)
         rec["img_per_sec"] = round(ips, 2)
         print(f"[sweep] {spec}: {ips:.2f} img/s "
               f"({time.perf_counter() - t0:.0f}s incl. compile)", flush=True)
