@@ -97,6 +97,7 @@ def main(args: argparse.Namespace) -> None:
             batch_size=args.batch_size,
             verbose=args.verbose,
             clear_output_dir=args.clear_output_dir,
+            seed=args.seed,
             steps_per_dispatch=args.steps_per_dispatch,
             prefetch_batches=args.prefetch_batches,
             grad_accum=args.grad_accum,
@@ -106,6 +107,8 @@ def main(args: argparse.Namespace) -> None:
         raise SystemExit("--grad_accum and --steps_per_dispatch must be >= 1")
     if config.train.prefetch_batches < 0:
         raise SystemExit("--prefetch_batches must be >= 0")
+    if not 0 <= config.train.seed < 2 ** 32:
+        raise SystemExit("--seed must be in [0, 2**32)")
     if config.train.grad_accum > 1 and config.train.steps_per_dispatch > 1:
         raise SystemExit(
             "--grad_accum and --steps_per_dispatch are mutually exclusive "
@@ -335,6 +338,10 @@ if __name__ == "__main__":
                         help="fuse this many train steps into one lax.scan "
                              "dispatch (amortizes host->device latency; "
                              "identical update sequence to 1)")
+    parser.add_argument("--seed", default=1234, type=int,
+                        help="global RNG seed (init + data order); 1234 is "
+                             "the reference's hard-coded value "
+                             "(main.py:366-367)")
     parser.add_argument("--prefetch_batches", default=2, type=int,
                         help="stage this many dispatch-ready batch groups "
                              "ahead on an input thread (device_put included) "
