@@ -214,12 +214,18 @@ def _default_config():
     return Config(model=ModelConfig(), train=TrainConfig())
 
 
-def _build(compute_dtype: str, batch: int, image: int, norm_impl: str,
-           pad_mode: str = "reflect", pad_impl: str = "pad"):
+def _config_for(compute_dtype: str, batch: int, image: int, norm_impl: str,
+                pad_mode: str = "reflect", pad_impl: str = "pad",
+                grad_accum: int = 1):
+    """The exact Config a bench measurement uses — shared with
+    tools/cache_warm.py so the offline cache-warming compiles the SAME
+    programs the driver-window bench will request (any drift here means
+    a cold compile eats the driver's budget). For the accum mode,
+    `batch` is the EFFECTIVE batch and `grad_accum` the microbatch
+    count (bench_accum's contract)."""
     from cyclegan_tpu.config import Config, ModelConfig, TrainConfig
-    from cyclegan_tpu.train import create_state, make_train_step
 
-    cfg = Config(
+    return Config(
         model=ModelConfig(
             compute_dtype=compute_dtype,
             image_size=image,
@@ -227,8 +233,16 @@ def _build(compute_dtype: str, batch: int, image: int, norm_impl: str,
             pad_mode=pad_mode,
             pad_impl=pad_impl,
         ),
-        train=TrainConfig(batch_size=batch),
+        train=TrainConfig(batch_size=batch, grad_accum=grad_accum),
     )
+
+
+def _build(compute_dtype: str, batch: int, image: int, norm_impl: str,
+           pad_mode: str = "reflect", pad_impl: str = "pad"):
+    from cyclegan_tpu.train import create_state, make_train_step
+
+    cfg = _config_for(compute_dtype, batch, image, norm_impl, pad_mode,
+                      pad_impl)
     state = create_state(cfg, jax.random.PRNGKey(0))
     global _PLATFORM, _DEVICE_KIND
     _PLATFORM = jax.default_backend()  # backend is up once state exists
@@ -367,6 +381,47 @@ def bench_scan(compute_dtype: str, batch: int, image: int = 256,
     _sync(metrics)
     dt = time.perf_counter() - t0
     return 2 * batch * k * iters / dt
+
+
+def bench_accum(compute_dtype: str, micro: int, image: int = 512,
+                accum: int = 8, norm_impl: str = "auto", warmup: int = 1,
+                iters: int = 3, pad_mode: str = "reflect",
+                pad_impl: str = "pad"):
+    """Gradient-accumulation step timing — the 512^2 HBM-relief config
+    (TPU_RUNBOOK item 5): `accum` microbatches of `micro` per optimizer
+    update, peak activation memory tracking the MICRObatch
+    (train/steps.py:make_accum_train_step; compiler-certified at +4.4%
+    temps vs plain micro — docs/aot_analysis.json accum-probe). Update
+    semantics are exactly the effective-batch step, so img/s counts
+    2 * micro * accum images per update."""
+    from cyclegan_tpu.train import create_state
+    from cyclegan_tpu.train.steps import make_accum_train_step
+
+    effective = micro * accum
+    cfg = _config_for(compute_dtype, effective, image, norm_impl, pad_mode,
+                      pad_impl, grad_accum=accum)
+    state = create_state(cfg, jax.random.PRNGKey(0))
+    global _PLATFORM, _DEVICE_KIND
+    _PLATFORM = jax.default_backend()
+    _DEVICE_KIND = jax.devices()[0].device_kind
+    step = jax.jit(make_accum_train_step(cfg, effective, accum),
+                   donate_argnums=(0,))
+    rng = np.random.RandomState(1)
+    xs = jnp.asarray(
+        rng.rand(accum, micro, image, image, 3).astype(np.float32) * 2 - 1)
+    ys = jnp.asarray(
+        rng.rand(accum, micro, image, image, 3).astype(np.float32) * 2 - 1)
+    ws = jnp.ones((accum, micro), jnp.float32)
+
+    for _ in range(warmup):
+        state, metrics = step(state, xs, ys, ws)
+    _sync(metrics)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, xs, ys, ws)
+    _sync(metrics)
+    dt = time.perf_counter() - t0
+    return 2 * effective * iters / dt
 
 
 # Cached by the first successful _build; the emit path must NEVER call

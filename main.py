@@ -319,11 +319,13 @@ if __name__ == "__main__":
                         choices=["pad", "fused"],
                         help="how pad_mode=reflect is scheduled: 'pad' "
                              "materializes reflect-padded copies (bitwise "
-                             "parity baseline); 'fused' keeps reflect "
-                             "semantics (fp-tolerance-identical) but runs "
-                             "each site as a zero-padded conv + fusible "
-                             "border corrections — removes the pads' ~32%% "
-                             "of step HBM traffic (docs/BENCHMARKS.md). "
+                             "parity baseline); 'fused' keeps exact reflect "
+                             "semantics (fp-tolerance-identical) without "
+                             "materialized pad copies — a modest measured "
+                             "win (~-2.7%% step HBM bytes; layout copies "
+                             "eat most of the gap — docs/BENCHMARKS.md "
+                             "round 4). The ~-32%% traffic lever is "
+                             "--pad_mode zero (non-parity borders). "
                              "Checkpoints interchange")
     parser.add_argument("--spatial_parallelism", default=1, type=int,
                         help="shard the image H axis over this many mesh columns")

@@ -168,6 +168,36 @@ def test_bench_dispatch_smoke(monkeypatch):
                                 prefetch=True) > 0
 
 
+def test_bench_accum_smoke(monkeypatch):
+    """Flow check for the accum mode (grad-accumulation step, 512^2
+    HBM-relief row) with stubbed state/step — the real program is a chip
+    job; its EXACTNESS vs the big-batch step is pinned by
+    tests/test_accum.py."""
+    import jax.numpy as jnp
+
+    import cyclegan_tpu.train as train_mod
+    import cyclegan_tpu.train.steps as steps_mod
+
+    monkeypatch.setattr(train_mod, "create_state",
+                        lambda cfg, rng: jnp.zeros(()))
+
+    captured = {}
+
+    def fake_make(cfg, effective, accum):
+        captured["effective"], captured["accum"] = effective, accum
+
+        def accum_step(st, xs, ys, ws):
+            return st + 1.0, {"loss_G/total": st + jnp.mean(xs) + jnp.mean(ys)}
+
+        return accum_step
+
+    monkeypatch.setattr(steps_mod, "make_accum_train_step", fake_make)
+    ips = bench.bench_accum("float32", micro=2, image=8, accum=3, iters=2)
+    assert ips > 0
+    # effective batch = micro * accum; the update sees the full batch
+    assert captured == {"effective": 6, "accum": 3}
+
+
 def test_read_worker_results_tolerates_missing_and_garbage(tmp_path):
     assert bench._read_worker_results(None) == {}
     assert bench._read_worker_results(str(tmp_path / "nope.json")) == {}

@@ -1,0 +1,92 @@
+"""Guard tools/cache_warm.py — the driver-window cache-readiness tool.
+
+What must not drift (VERDICT r4 weak #6): the warm list must cover the
+PROGRAM of every official bench config (a missing one means a 2-5 min
+cold compile inside the driver's 480 s window), while deduplicating
+configs that share an XLA program (pf = host-side staging only;
+steps ≡ dispatch-k1). Compilation itself is a TPU job — these tests
+never compile; the compile-path machinery they rely on
+(lower+compile on the local_only AOT backend, persistent cache) is the
+same one tools/aot_analyze.py exercises.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+sys.path.insert(0, REPO)
+
+import cache_warm  # noqa: E402
+
+
+def test_every_official_config_program_is_covered():
+    import bench
+
+    progs = cache_warm.official_programs()
+    covered = {key for p in progs for key in p["covers"]}
+    for c in bench.TPU_CONFIGS:
+        assert bench._config_key(c) in covered, (
+            f"{bench._config_key(c)} missing from the warm list — its "
+            "cold compile would eat the driver's bench budget")
+
+
+def test_autorun_sweep_rows_are_covered():
+    keys = {p["key"] for p in cache_warm.official_programs()}
+    for spec in ("scan:b16zero", "scan:b24zero", "scan:b16fused",
+                 "accum:b1k8i512", "scan:b4k2i512", "scan:b4k2zeroi512"):
+        assert f"sweep {spec}" in keys
+
+
+def test_shared_programs_deduplicated():
+    import bench
+
+    progs = cache_warm.official_programs()
+    # the pf config must NOT be a separate compile: same XLA program as
+    # dispatch k8 (bench.bench_dispatch prefetch docstring)
+    pf_key = bench._config_key(
+        {"mode": "dispatch", "dtype": "bfloat16", "batch": 16, "k": 8,
+         "prefetch": True})
+    owners = [p for p in progs if pf_key in p["covers"]]
+    assert len(owners) == 1
+    assert owners[0]["key"] != pf_key  # it rides the earlier k8 program
+    # scan b16 (k=8) and dispatch b16 k8 share the fused program too
+    scan_key = bench._config_key(
+        {"mode": "scan", "dtype": "bfloat16", "batch": 16})
+    k8_key = bench._config_key(
+        {"mode": "dispatch", "dtype": "bfloat16", "batch": 16, "k": 8})
+    owner = [p for p in progs if scan_key in p["covers"]][0]
+    assert k8_key in owner["covers"]
+
+
+def test_absent_axon_writes_report_and_check_fails(tmp_path, monkeypatch):
+    """With no axon plugin, --check must FAIL (readiness unverifiable)
+    and a fresh report must be written anyway — otherwise a stale prior
+    container's report would masquerade as this run's evidence
+    (code-review r5 finding)."""
+    import json
+
+    import cyclegan_tpu.utils.axon_compat as axon_compat
+
+    monkeypatch.setattr(axon_compat, "register_axon_local",
+                        lambda **kw: False)
+    report = tmp_path / "report.json"
+    monkeypatch.setattr(cache_warm, "REPORT_PATH", str(report))
+    assert cache_warm.main(["--check"]) == 1
+    rec = json.loads(report.read_text())
+    assert rec["axon_plugin"] == "absent" and rec["programs"] == []
+    # warm mode on a CPU box is a harmless no-op, not a failure
+    assert cache_warm.main([]) == 0
+
+
+def test_list_mode_needs_no_axon(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "cache_warm.py"),
+         "--list"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=180)
+    assert r.returncode == 0, r.stderr
+    assert "scan/bfloat16/b16" in r.stdout
+    assert "sweep accum:b1k8i512" in r.stdout

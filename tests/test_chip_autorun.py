@@ -1,0 +1,422 @@
+"""Guard the automatic chip-window runner (tools/chip_autorun.py).
+
+These pin the machinery, not measurements: the mode decision from
+relay-socket states, the queue's content/order/budgets, per-step
+artifact commits (so a window that closes mid-queue loses nothing
+already landed), resume-at-first-incomplete-step semantics, the
+timeout-means-wedged abort, and the oversized-artifact MANIFEST guard.
+Nothing here touches jax or any relay socket: relay state is injected
+via CHIP_AUTORUN_FAKE_RELAY and steps are stub subprocesses in a
+throwaway git repo.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import chip_autorun  # noqa: E402  (parent module imports no jax)
+from chip_autorun import Step, build_queue, relay_mode  # noqa: E402
+
+
+# ------------------------------------------------------------- mode map
+
+@pytest.mark.parametrize("status,expect", [
+    ({8082: "open", 8083: "open", 8093: "open"}, "remote"),
+    ({8082: "open", 8083: "closed", 8093: "open"}, "remote"),
+    ({8082: "open", 8083: "open", 8093: "closed"}, "local_compile"),
+    ({8082: "closed", 8083: "open", 8093: "open"}, None),  # no claim leg
+    ({8082: "open", 8083: "closed", 8093: "closed"}, None),
+    ({8082: "closed", 8083: "closed", 8093: "closed"}, None),
+    ({}, None),
+])
+def test_relay_mode(status, expect):
+    assert relay_mode(status) == expect
+
+
+def test_fake_relay_env_round_trips(monkeypatch):
+    monkeypatch.setenv("CHIP_AUTORUN_FAKE_RELAY",
+                       "8082:open,8083:open,8093:closed")
+    assert chip_autorun.relay_status() == {
+        8082: "open", 8083: "open", 8093: "closed"}
+
+
+# ---------------------------------------------------------------- queue
+
+def test_queue_order_and_budgets():
+    q = build_queue("remote")
+    names = [s.name for s in q]
+    # Highest value first (VERDICT r4 item 1): health probe, official
+    # number cold then warm, the pad lever, 512^2 rows, trace, e2e run.
+    assert names == ["diag", "bench_cold", "bench_warm", "pad_sweep",
+                     "accum512", "scan512", "trace", "timed_main"]
+    by = {s.name: s for s in q}
+    assert by["diag"].abort_queue_on_fail  # diag failing = relay sick
+    # cold run gets the cache-warming budget; warm run is the record
+    assert float(by["bench_cold"].env["BENCH_TIME_BUDGET_S"]) > float(
+        by["bench_warm"].env["BENCH_TIME_BUDGET_S"])
+    assert by["bench_cold"].stdout_to.endswith("_cold.json")
+    assert by["bench_warm"].stdout_to and not (
+        by["bench_warm"].stdout_to.endswith("_cold.json"))
+    # every step outlives its own worst-case compile chain
+    for s in q:
+        assert s.timeout_s >= 1800.0, s.name
+
+
+def test_queue_pad_sweep_covers_the_lever():
+    specs = build_queue("remote")[3].argv
+    assert "scan:b16zero" in specs and "scan:b16fused" in specs
+
+
+def test_queue_never_enables_pallas():
+    for s in build_queue("remote") + build_queue("local_compile"):
+        assert "pallas" not in " ".join(s.argv)
+        assert s.env.get("CYCLEGAN_ALLOW_PALLAS_REMOTE") is None
+
+
+def test_local_compile_mode_sets_env_on_every_step():
+    for s in build_queue("local_compile"):
+        assert s.env["PALLAS_AXON_POOL_IPS"] == ""
+        assert s.env["CYCLEGAN_AXON_LOCAL_COMPILE"] == "1"
+    for s in build_queue("remote"):
+        assert "CYCLEGAN_AXON_LOCAL_COMPILE" not in s.env
+
+
+def test_timed_main_writes_outside_repo():
+    # checkpoints are hundreds of MB; the timed run must not point its
+    # output_dir inside the repo where the step-commit would sweep it up
+    argv = [s for s in build_queue("remote") if s.name == "timed_main"][0].argv
+    out = argv[argv.index("--output_dir") + 1]
+    assert os.path.isabs(out) and not out.startswith(REPO + os.sep)
+
+
+def test_dry_run_prints_queue_and_executes_nothing(tmp_path):
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chip_autorun.py"),
+         "--dry-run"],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "mode remote" in r.stdout and "mode local_compile" in r.stdout
+    for name in ("diag", "bench_cold", "bench_warm", "pad_sweep",
+                 "accum512", "scan512", "trace", "timed_main"):
+        assert name in r.stdout
+
+
+# ----------------------------------------------------- supervised queue
+
+@pytest.fixture()
+def fake_repo(tmp_path, monkeypatch):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    subprocess.run(["git", "init", "-q"], cwd=repo, check=True)
+    subprocess.run(["git", "config", "user.email", "t@t"], cwd=repo,
+                   check=True)
+    subprocess.run(["git", "config", "user.name", "t"], cwd=repo, check=True)
+    monkeypatch.setenv("CHIP_AUTORUN_FAKE_RELAY",
+                       "8082:open,8083:open,8093:open")
+    return str(repo)
+
+
+def _stub_step(name, script, timeout_s=30.0, **kw):
+    return Step(name, [sys.executable, "-c", script], timeout_s, **kw)
+
+
+def _commits(repo):
+    r = subprocess.run(["git", "log", "--format=%s"], cwd=repo,
+                       capture_output=True, text=True)
+    return r.stdout.strip().splitlines()
+
+
+def test_run_queue_commits_each_step_immediately(fake_repo):
+    q = [
+        _stub_step("one", "open('a.txt','w').write('1')",
+                   artifacts=["a.txt"]),
+        _stub_step("two", "open('b.txt','w').write('2')",
+                   artifacts=["b.txt"]),
+    ]
+    assert chip_autorun.run_queue(fake_repo, q)
+    log = _commits(fake_repo)
+    assert len(log) == 2
+    assert "one ok" in log[1] and "two ok" in log[0]
+    status = chip_autorun.load_status(fake_repo)
+    assert [s["name"] for s in status["steps"]] == ["one", "two"]
+    assert all(s["status"] == "ok" for s in status["steps"])
+    # the per-step log itself is committed evidence
+    assert os.path.exists(os.path.join(
+        fake_repo, chip_autorun.LOG_DIR_REL, "one.log"))
+
+
+def test_run_queue_resume_skips_completed(fake_repo):
+    q = [
+        _stub_step("one", "open('a.txt','w').write('1')",
+                   artifacts=["a.txt"]),
+        _stub_step("two", "open('b.txt','w').write('2')",
+                   artifacts=["b.txt"]),
+    ]
+    assert chip_autorun.run_queue(fake_repo, q, resume_from={"one"})
+    assert not os.path.exists(os.path.join(fake_repo, "a.txt"))
+    assert os.path.exists(os.path.join(fake_repo, "b.txt"))
+
+
+def test_run_queue_stdout_capture(fake_repo):
+    q = [_stub_step("bench_stub", "print('{\"metric\": 1}')",
+                    stdout_to="docs/bench_stub.json")]
+    assert chip_autorun.run_queue(fake_repo, q)
+    rec = json.loads(
+        open(os.path.join(fake_repo, "docs", "bench_stub.json")).read())
+    assert rec == {"metric": 1}
+    assert any("bench_stub ok" in c for c in _commits(fake_repo))
+
+
+def test_run_queue_timeout_aborts_remaining(fake_repo):
+    q = [
+        _stub_step("hang", "import time; time.sleep(60)", timeout_s=1.5),
+        _stub_step("never", "open('never.txt','w').write('x')",
+                   artifacts=["never.txt"]),
+    ]
+    assert chip_autorun.run_queue(fake_repo, q) is False
+    assert not os.path.exists(os.path.join(fake_repo, "never.txt"))
+    status = chip_autorun.load_status(fake_repo)
+    assert status["steps"][0]["status"] == "timeout_killed"
+    # the kill itself is committed evidence (ledger + step log)
+    assert any("timeout_killed" in c for c in _commits(fake_repo))
+
+
+def test_run_queue_abort_on_fail_step(fake_repo):
+    q = [
+        _stub_step("diag", "raise SystemExit(3)", abort_queue_on_fail=True),
+        _stub_step("never", "open('never.txt','w').write('x')",
+                   artifacts=["never.txt"]),
+    ]
+    assert chip_autorun.run_queue(fake_repo, q) is False
+    assert not os.path.exists(os.path.join(fake_repo, "never.txt"))
+
+
+def test_run_queue_plain_failure_continues(fake_repo):
+    q = [
+        _stub_step("oom_row", "raise SystemExit(1)"),
+        _stub_step("next", "open('n.txt','w').write('x')",
+                   artifacts=["n.txt"]),
+    ]
+    # a failed measurement (e.g. an OOM row) must not strand the queue
+    assert chip_autorun.run_queue(fake_repo, q) is False
+    assert os.path.exists(os.path.join(fake_repo, "n.txt"))
+
+
+def test_run_queue_stops_when_relay_drops(fake_repo, monkeypatch):
+    monkeypatch.setenv("CHIP_AUTORUN_FAKE_RELAY",
+                       "8082:closed,8083:closed,8093:closed")
+    q = [_stub_step("one", "open('a.txt','w').write('1')",
+                    artifacts=["a.txt"])]
+    assert chip_autorun.run_queue(fake_repo, q) is False
+    assert not os.path.exists(os.path.join(fake_repo, "a.txt"))
+
+
+def test_run_queue_timeout_kills_grandchildren(fake_repo):
+    """A timed-out step's whole process GROUP dies: an orphaned
+    bench.py CPU-worker would match other_chip_clients' markers and
+    block the next window attempt (code-review r5 finding)."""
+    script = (
+        "import subprocess, sys, time, os\n"
+        "child = subprocess.Popen([sys.executable, '-c',"
+        " 'import time; time.sleep(60)'])\n"
+        "open('childpid.txt', 'w').write(str(child.pid))\n"
+        "time.sleep(60)\n"
+    )
+    q = [_stub_step("hang_tree", script, timeout_s=3.0,
+                    artifacts=["childpid.txt"])]
+    assert chip_autorun.run_queue(fake_repo, q) is False
+    pid = int(open(os.path.join(fake_repo, "childpid.txt")).read())
+    for _ in range(50):  # grace for the SIGKILL to land + reap
+        if not os.path.exists(f"/proc/{pid}"):
+            break
+        # a zombie (reparented, unreaped) is dead for our purposes
+        with open(f"/proc/{pid}/stat") as f:
+            if f.read().split()[2] == "Z":
+                break
+        import time
+        time.sleep(0.1)
+    else:
+        raise AssertionError(f"grandchild {pid} survived the group kill")
+
+
+def test_given_up_steps_two_strikes():
+    tag = chip_autorun.ROUND_TAG
+    status = {"steps": [
+        {"name": "bench_cold", "status": "timeout_killed", "tag": tag},
+        {"name": "bench_cold", "status": "timeout_killed", "tag": tag},
+        {"name": "diag", "status": "timeout_killed", "tag": tag},
+        {"name": "pad_sweep", "status": "ok", "tag": tag},
+    ]}
+    assert chip_autorun.given_up_steps(status) == {"bench_cold"}
+
+
+def test_ledger_is_round_scoped():
+    """A step completed (or struck out) in a PRIOR round must not skip
+    this round's identically-named step — each round's captures are
+    fresh evidence (code-review r5 finding)."""
+    old = {"steps": [
+        {"name": "bench_cold", "status": "ok", "tag": "r04"},
+        {"name": "pad_sweep", "status": "timeout_killed", "tag": "r04"},
+        {"name": "pad_sweep", "status": "timeout_killed", "tag": "r04"},
+        {"name": "bench_warm", "status": "ok"},  # legacy tagless
+    ]}
+    assert chip_autorun.completed_steps(old) == set()
+    assert chip_autorun.given_up_steps(old) == set()
+
+
+def test_attempt_window_skips_given_up_steps(fake_repo, monkeypatch):
+    """Two timeout strikes retire a step so retries can't kill-loop a
+    client against a slow tunnel; with every step completed or given
+    up, an attempt is a no-op success."""
+    monkeypatch.setattr(chip_autorun, "CONFIRM_S", 0.0)
+    tag = chip_autorun.ROUND_TAG
+    steps = []
+    for s in build_queue("remote"):
+        if s.name == "bench_cold":
+            steps += [{"name": s.name, "status": "timeout_killed",
+                       "tag": tag}] * 2
+        else:
+            steps.append({"name": s.name, "status": "ok", "tag": tag})
+    chip_autorun.save_status(fake_repo, {"steps": steps})
+    assert chip_autorun.attempt_window(fake_repo) is True
+
+
+def test_always_run_step_reruns_despite_prior_ok(fake_repo):
+    """diag is a health probe: a past ok says nothing about THIS
+    window, so resume must never skip an always_run step."""
+    q = [
+        _stub_step("diag", "open('d.txt','a').write('x')",
+                   artifacts=["d.txt"], abort_queue_on_fail=True,
+                   always_run=True),
+        _stub_step("work", "open('w.txt','w').write('x')",
+                   artifacts=["w.txt"]),
+    ]
+    assert chip_autorun.run_queue(fake_repo, q, resume_from={"diag"})
+    assert os.path.exists(os.path.join(fake_repo, "d.txt"))
+
+
+def test_diag_never_given_up_while_work_pends(fake_repo, monkeypatch):
+    """Two diag timeouts must NOT retire the health probe: skipping it
+    would launch long bench clients against an unverified relay
+    (code-review r5 finding)."""
+    monkeypatch.setattr(chip_autorun, "CONFIRM_S", 0.0)
+    tag = chip_autorun.ROUND_TAG
+    chip_autorun.save_status(fake_repo, {"steps": [
+        {"name": "diag", "status": "timeout_killed", "tag": tag},
+        {"name": "diag", "status": "timeout_killed", "tag": tag},
+    ]})
+    ran = []
+
+    def fake_run_queue(repo, queue, resume_from=frozenset(), mode=None):
+        ran.append([s.name for s in queue
+                    if s.name not in resume_from or s.always_run])
+        return False
+
+    monkeypatch.setattr(chip_autorun, "run_queue", fake_run_queue)
+    assert chip_autorun.attempt_window(fake_repo) is False
+    assert ran and ran[0][0] == "diag"  # probe still leads the attempt
+
+
+def test_run_queue_stops_on_mode_shift(fake_repo, monkeypatch):
+    """remote -> local_compile mid-queue must stop the queue (next
+    attempt rebuilds with the local-compile env) instead of running a
+    step against the dead remote-compile leg."""
+    monkeypatch.setenv("CHIP_AUTORUN_FAKE_RELAY",
+                       "8082:open,8083:open,8093:closed")  # local_compile
+    q = [_stub_step("one", "open('a.txt','w').write('1')",
+                    artifacts=["a.txt"])]
+    assert chip_autorun.run_queue(fake_repo, q, mode="remote") is False
+    assert not os.path.exists(os.path.join(fake_repo, "a.txt"))
+    # matching mode proceeds
+    assert chip_autorun.run_queue(fake_repo, q, mode="local_compile")
+    assert os.path.exists(os.path.join(fake_repo, "a.txt"))
+
+
+def test_commit_paths_manifests_oversized_dirs(fake_repo, monkeypatch):
+    big = os.path.join(fake_repo, "trace")
+    os.makedirs(big)
+    with open(os.path.join(big, "trace.pb"), "wb") as f:
+        f.write(b"\0" * 4096)
+    monkeypatch.setattr(chip_autorun, "MAX_COMMIT_DIR_BYTES", 1024)
+    assert chip_autorun.commit_paths(fake_repo, ["trace"], "trace step")
+    committed = subprocess.run(
+        ["git", "ls-tree", "-r", "--name-only", "HEAD"], cwd=fake_repo,
+        capture_output=True, text=True).stdout.split()
+    assert committed == ["trace.MANIFEST"]
+    assert "trace.pb" in open(os.path.join(fake_repo,
+                                           "trace.MANIFEST")).read()
+
+
+def test_attempt_window_refuses_when_relay_down(fake_repo, monkeypatch):
+    monkeypatch.setenv("CHIP_AUTORUN_FAKE_RELAY",
+                       "8082:closed,8083:closed,8093:closed")
+    assert chip_autorun.attempt_window(fake_repo) is False
+
+
+def test_attempt_window_noop_when_queue_done(fake_repo, monkeypatch):
+    monkeypatch.setattr(chip_autorun, "CONFIRM_S", 0.0)
+    chip_autorun.save_status(fake_repo, {"steps": [
+        {"name": s.name, "status": "ok", "tag": chip_autorun.ROUND_TAG}
+        for s in build_queue("remote")
+    ]})
+    assert chip_autorun.attempt_window(fake_repo) is True
+
+
+def test_collect_copies_from_outside_repo(fake_repo, tmp_path):
+    """A step may write its bulky output OUTSIDE the repo (checkpoints
+    must never be committable); `collect` copies just the evidence in."""
+    src = tmp_path / "ext_out" / "traces"
+    src.mkdir(parents=True)
+    (src / "trace.json.gz").write_bytes(b"tracedata")
+    q = [Step("trace_stub", [sys.executable, "-c", "pass"], 30.0,
+              collect=[(str(src), "docs/chip_logs/r05/trace_run/traces")])]
+    assert chip_autorun.run_queue(fake_repo, q)
+    dest = os.path.join(fake_repo, "docs", "chip_logs", "r05",
+                        "trace_run", "traces", "trace.json.gz")
+    assert os.path.exists(dest)
+    committed = subprocess.run(
+        ["git", "ls-tree", "-r", "--name-only", "HEAD"], cwd=fake_repo,
+        capture_output=True, text=True).stdout
+    assert "trace.json.gz" in committed
+
+
+def test_trace_step_outputs_outside_repo_and_collects_traces():
+    by = {s.name: s for s in build_queue("remote")}
+    argv = by["trace"].argv
+    out = argv[argv.index("--output_dir") + 1]
+    assert os.path.isabs(out) and not out.startswith(REPO + os.sep)
+    (src, dest_rel), = by["trace"].collect
+    assert src.startswith(out)  # only the trace subdir is collected
+    assert dest_rel.startswith("docs/chip_logs/")
+
+
+def test_flock_single_instance(tmp_path, monkeypatch):
+    """The single-instance lock must hold atomically (no stale-file
+    TOCTOU): with the lock held, --once exits 1 before doing anything."""
+    import fcntl
+
+    lock = tmp_path / "autorun.lock"
+    fd = os.open(str(lock), os.O_CREAT | os.O_WRONLY)
+    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    env = dict(os.environ)
+    env["CHIP_AUTORUN_LOCK"] = str(lock)
+    env["CHIP_AUTORUN_FAKE_RELAY"] = "8082:closed,8083:closed,8093:closed"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chip_autorun.py"),
+         "--once"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=60)
+    os.close(fd)
+    assert r.returncode == 1
+    assert "holds the lock" in r.stdout
+    # once released, --once proceeds to the (refused: relay down) attempt
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chip_autorun.py"),
+         "--once"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=60)
+    assert r2.returncode == 1 and "relay not usable" in r2.stdout

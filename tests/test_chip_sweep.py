@@ -102,6 +102,32 @@ def test_pallas_allowed_on_cpu_platform(tmp_path):
     assert r.returncode == 0 and "ok" in r.stdout, (r.stdout, r.stderr)
 
 
+def test_accum_spec_routes_to_bench_accum(tmp_path, monkeypatch):
+    """run_spec('accum:...') must call bench.bench_accum with b as the
+    MICRObatch and k as the accumulation count, and record img/s."""
+    import types
+
+    calls = {}
+    stub = types.ModuleType("bench")
+
+    def fake_accum(dtype, micro, image, accum, norm_impl, pad_mode, pad_impl):
+        calls.update(micro=micro, image=image, accum=accum,
+                     pad_mode=pad_mode)
+        return 12.34
+
+    stub.bench_accum = fake_accum
+    monkeypatch.setitem(sys.modules, "bench", stub)
+    monkeypatch.setattr(chip_sweep, "RECORD_PATH",
+                        str(tmp_path / "rec.json"))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    chip_sweep.run_spec("accum:b2k4zeroi512")
+    assert calls == {"micro": 2, "image": 512, "accum": 4,
+                     "pad_mode": "zero"}
+    rows = json.loads((tmp_path / "rec.json").read_text())
+    assert rows[0]["key"] == "accum:b2k4zeroi512"
+    assert rows[0]["img_per_sec"] == 12.34
+
+
 def test_corrupt_record_aborts_before_measuring(tmp_path):
     rec = tmp_path / "rec.json"
     rec.write_text("{corrupt")
@@ -130,6 +156,11 @@ def test_corrupt_record_aborts_before_measuring(tmp_path):
      ("dispatch", 16, 8, False, "reflect", "pad", True, 256)),
     ("dispatch:b16k8zeropfi512",
      ("dispatch", 16, 8, False, "zero", "pad", True, 512)),
+    # accum mode: b = MICRObatch, k = microbatches per update (default 8)
+    ("accum:b1k8i512", ("accum", 1, 8, False, "reflect", "pad", False, 512)),
+    ("accum:b1i512", ("accum", 1, 8, False, "reflect", "pad", False, 512)),
+    ("accum:b2k4zeroi512",
+     ("accum", 2, 4, False, "zero", "pad", False, 512)),
 ])
 def test_spec_grammar(spec, expect):
     assert chip_sweep.parse_spec(spec) == expect
@@ -139,7 +170,8 @@ def test_spec_grammar(spec, expect):
                                  "steps:b1", "scan:b8i0", "scan", "",
                                  "scan:b16zeropallas", "scan:b16zerofused",
                                  "scan:b16fusedzero", "scan:b16pf",
-                                 "dispatch:b16pfk8"])
+                                 "dispatch:b16pfk8", "accum:b1pf",
+                                 "accum:b0k8", "accum:b1k0"])
 def test_spec_grammar_rejects(bad):
     with pytest.raises(SystemExit):
         chip_sweep.parse_spec(bad)

@@ -1,0 +1,647 @@
+"""Automatic chip-window runner: watch the relay, cash the whole queue.
+
+Four rounds of evidence (docs/TUNNEL_POSTMORTEM.md, VERDICT r4) say the
+TPU loopback relay comes up rarely, briefly, and unpredictably; a
+human-sequenced runbook missed every window but one. This daemon makes
+capture automatic: poll the relay sockets (cheap TCP connects — never
+an axon client), and on a down->up transition that HOLDS for a
+confirmation poll, run docs/TPU_RUNBOOK.md's queue as one supervised
+session:
+
+    diag -> bench cold -> bench warm -> pad A/B sweep (zero/fused)
+    -> accum 512^2 row -> 512^2 scan rows -> profiler trace
+    -> timed main.py run
+
+Each step is a subprocess with a generous timeout, stdout+stderr teed
+to docs/chip_logs/<run>/<step>.log, and its artifacts git-committed
+IMMEDIATELY on completion — a window that closes mid-queue loses
+nothing already landed. Per-step completion is recorded in
+docs/chip_autorun_status.json, so a SECOND window resumes the queue at
+the first incomplete step instead of repeating finished work.
+
+Ground rules enforced (TPU_RUNBOOK "learned the hard way"):
+  - ONE axon client at a time: the runner refuses to start while
+    another chip-capable process is alive, and runs steps strictly
+    sequentially.
+  - never kill mid-compile: per-step timeouts sit far beyond any
+    observed healthy compile (cold fused programs <=10 min each over
+    the remote leg). Hitting one means the tunnel is already wedged;
+    the step is killed, the kill logged loudly, and the QUEUE ABORTS —
+    no further clients are started against a sick relay.
+  - XLA-only programs: no step enables pallas (ground rule 2b).
+  - local-compile fallback: :8082+:8083 up with :8093 down runs every
+    step under PALLAS_AXON_POOL_IPS= CYCLEGAN_AXON_LOCAL_COMPILE=1
+    (compiles against the in-image libtpu; the persistent cache makes
+    them hot — tools/cache_warm.py).
+
+Usage:
+    nohup python tools/chip_autorun.py --watch >/tmp/chip_autorun.log 2>&1 &
+    python tools/chip_autorun.py --once      # health-check + run queue now
+    python tools/chip_autorun.py --dry-run   # print the queue, run nothing
+
+The parent process never imports jax (a dead relay can wedge backend
+init); all chip work happens in the step subprocesses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ROUND_TAG = os.environ.get("CHIP_AUTORUN_TAG", "r05")
+LOG_DIR_REL = os.path.join("docs", "chip_logs", ROUND_TAG)
+STATUS_REL = os.path.join("docs", "chip_autorun_status.json")
+POLL_S = float(os.environ.get("CHIP_AUTORUN_POLL_S", "45"))
+CONFIRM_S = float(os.environ.get("CHIP_AUTORUN_CONFIRM_S", "10"))
+# While the relay stays up with queue steps incomplete, retry a
+# refused/aborted attempt this often (a manual client exiting, or a
+# transiently sick tunnel healing, must not require a socket flap).
+RETRY_S = float(os.environ.get("CHIP_AUTORUN_RETRY_S", "600"))
+# Directories larger than this get a MANIFEST committed instead of
+# their contents (profiler traces can be arbitrarily large).
+MAX_COMMIT_DIR_BYTES = 40 * 1024 * 1024
+
+RELAY_PORTS = (8082, 8083, 8093)
+
+
+def relay_status() -> dict:
+    """Socket-connect probe of the loopback relay legs. Never spawns an
+    axon client; safe at any frequency. Overridable for tests via
+    CHIP_AUTORUN_FAKE_RELAY=8082:open,8083:open,8093:closed."""
+    fake = os.environ.get("CHIP_AUTORUN_FAKE_RELAY")
+    if fake:
+        out = {}
+        for part in fake.split(","):
+            port, state = part.split(":")
+            out[int(port)] = state
+        return out
+    out = {}
+    for port in RELAY_PORTS:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=2):
+                out[port] = "open"
+        except OSError:
+            out[port] = "closed"
+    return out
+
+
+def relay_mode(status: dict) -> str | None:
+    """Map socket states to an execution mode.
+
+    "remote": claim/execute (:8082) + remote-compile (:8093) up — the
+    normal path (axon_compat.relay_ok's full-relay criterion).
+    "local_compile": claim legs (:8082+:8083) up, compile service down —
+    every step runs with the local-libtpu compile registration.
+    None: chip execution impossible.
+    """
+    if status.get(8082) == "open" and status.get(8093) == "open":
+        return "remote"
+    if status.get(8082) == "open" and status.get(8083) == "open":
+        return "local_compile"
+    return None
+
+
+@dataclass
+class Step:
+    name: str
+    argv: list
+    timeout_s: float
+    env: dict = field(default_factory=dict)
+    artifacts: list = field(default_factory=list)  # repo-relative paths
+    stdout_to: str | None = None  # repo-relative: capture stdout (bench JSON)
+    abort_queue_on_fail: bool = False  # diag failing means relay is sick
+    # Health probes re-run at EVERY attempt: completion/give-up state
+    # never skips them (a past ok says nothing about THIS window, and
+    # skipping the probe would launch long clients unverified).
+    always_run: bool = False
+    # (src_abs, dest_repo_rel) pairs copied into the repo AFTER the step
+    # completes, then committed like artifacts — lets a step write its
+    # bulky output dir OUTSIDE the repo (checkpoints!) while the select
+    # evidence (e.g. the profiler trace) still lands in git.
+    collect: list = field(default_factory=list)
+
+
+def build_queue(mode: str, round_tag: str = ROUND_TAG) -> list:
+    """The TPU_RUNBOOK queue, highest value first (VERDICT r4 item 1).
+
+    Budgets: a healthy cold compile through the remote leg is 2-5 min
+    per distinct program (TPU_RUNBOOK ground rule 3); each step's
+    timeout covers every program it compiles cold plus measurement,
+    with ~3x slack. bench cold warms the persistent cache, so
+    bench warm (the record that matters) measures hot.
+    """
+    py = sys.executable
+    env = {}
+    if mode == "local_compile":
+        env = {"PALLAS_AXON_POOL_IPS": "", "CYCLEGAN_AXON_LOCAL_COMPILE": "1"}
+    sweeps = os.path.join("docs", "bench_sweeps.json")
+    q = [
+        # Staged health probe: attributes any hang to init vs compile
+        # vs execute. A failure here aborts the queue — the relay is
+        # not actually healthy, and further clients would pile onto it.
+        Step("diag", [py, "tools/tpu_diag.py", "--full"], 1800.0,
+             env=env, abort_queue_on_fail=True, always_run=True),
+        # Official-number runs: cold warms every TPU_CONFIGS program
+        # into the persistent cache; warm is the headline record.
+        Step("bench_cold", [py, "bench.py"], 6300.0,
+             env={**env, "BENCH_TIME_BUDGET_S": "5400"},
+             stdout_to=os.path.join(
+                 "docs", f"bench_{round_tag}_onchip_cold.json")),
+        Step("bench_warm", [py, "bench.py"], 1800.0,
+             env={**env, "BENCH_TIME_BUDGET_S": "900"},
+             stdout_to=os.path.join(
+                 "docs", f"bench_{round_tag}_onchip.json")),
+        # The compiler-certified ~1.4x pad lever (zero) + the
+        # parity-preserving fused variant (runbook item 6).
+        Step("pad_sweep",
+             [py, "tools/chip_sweep.py", "scan:b16zero", "scan:b24zero",
+              "scan:b16fused"], 3600.0, env=env, artifacts=[sweeps]),
+        # 512^2 HBM-relief rows (runbook item 5): accum 8x1 (the
+        # certified memory contract) and the plain/zero 512 scans.
+        Step("accum512", [py, "tools/chip_sweep.py", "accum:b1k8i512"],
+             2700.0, env=env, artifacts=[sweeps]),
+        Step("scan512",
+             [py, "tools/chip_sweep.py", "scan:b4k2i512",
+              "scan:b4k2zeroi512"], 3600.0, env=env, artifacts=[sweeps]),
+        # Profiler trace of the headline config (runbook item 3):
+        # attributes the unexplained 18% between the 337 ms measured
+        # step and the 277 ms bandwidth floor.
+        # Output dir OUTSIDE the repo (the run checkpoints at its final
+        # epoch — hundreds of MB); only the profiler trace is collected
+        # into git, size-guarded by commit_paths' MANIFEST fallback.
+        Step("trace",
+             [py, "main.py", "--trace", "4", "--bf16", "--batch_size", "16",
+              "--data_source", "synthetic", "--synthetic_train_size", "96",
+              "--synthetic_test_size", "16", "--epochs", "1",
+              "--output_dir", "/tmp/chip_autorun_trace"],
+             3600.0, env=env,
+             collect=[("/tmp/chip_autorun_trace/traces",
+                       os.path.join(LOG_DIR_REL, "trace_run", "traces"))]),
+        # End-to-end timed training run — the direct analog of the
+        # reference's only perf signal (main.py:388-392 epoch timing);
+        # numbers print to the step log. Output dir is OUTSIDE the
+        # repo: checkpoints are hundreds of MB and must not be
+        # committed; the log carries elapse + images/sec.
+        Step("timed_main",
+             [py, "main.py", "--epochs", "2", "--batch_size", "16", "--bf16",
+              "--steps_per_dispatch", "8", "--prefetch_batches", "2",
+              "--data_source", "synthetic", "--synthetic_train_size", "2048",
+              "--synthetic_test_size", "64",
+              "--output_dir", "/tmp/chip_autorun_timed"],
+             5400.0, env=env),
+    ]
+    return q
+
+
+# ----------------------------------------------------------------- run
+
+
+def _say(msg: str) -> None:
+    print(f"[{time.strftime('%F %T')}] {msg}", flush=True)
+
+
+def _git(repo: str, *args: str) -> subprocess.CompletedProcess:
+    """git helper that NEVER raises: a commit hiccup (slow disk, lock
+    contention) must not crash the daemon mid-window."""
+    try:
+        return subprocess.run(["git", "-C", repo, *args],
+                              capture_output=True, text=True, timeout=300)
+    except subprocess.TimeoutExpired:
+        return subprocess.CompletedProcess(
+            ["git", *args], returncode=124, stdout="",
+            stderr=f"git {' '.join(args[:1])} timed out after 300s")
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+def _manifest_for(path: str) -> str:
+    lines = ["# too large to commit; sizes only"]
+    for root, _dirs, files in os.walk(path):
+        for f in sorted(files):
+            p = os.path.join(root, f)
+            rel = os.path.relpath(p, path)
+            try:
+                lines.append(f"{os.path.getsize(p):>12}  {rel}")
+            except OSError:
+                pass
+    return "\n".join(lines) + "\n"
+
+
+def commit_paths(repo: str, paths: list, message: str) -> bool:
+    """Stage `paths` (repo-relative; oversized dirs are replaced by a
+    MANIFEST) and commit. Returns True iff a commit was created."""
+    to_add = []
+    for rel in paths:
+        abs_p = os.path.join(repo, rel)
+        if os.path.isdir(abs_p) and _dir_bytes(abs_p) > MAX_COMMIT_DIR_BYTES:
+            manifest = abs_p.rstrip("/") + ".MANIFEST"
+            with open(manifest, "w") as f:
+                f.write(_manifest_for(abs_p))
+            to_add.append(os.path.relpath(manifest, repo))
+            _say(f"{rel}: {_dir_bytes(abs_p)} bytes — committing MANIFEST only")
+        elif os.path.exists(abs_p):
+            to_add.append(rel)
+    if not to_add:
+        return False
+    r = _git(repo, "add", "--", *to_add)
+    if r.returncode != 0:
+        _say(f"git add failed: {r.stderr.strip()}")
+        return False
+    r = _git(repo, "commit", "-m", message, "--", *to_add)
+    if r.returncode != 0:
+        # "nothing to commit" is normal when a step produced no change
+        out = (r.stdout + r.stderr).strip()
+        _say(f"git commit: {out.splitlines()[-1] if out else 'failed'}")
+        return False
+    return True
+
+
+def load_status(repo: str) -> dict:
+    path = os.path.join(repo, STATUS_REL)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (FileNotFoundError, ValueError):
+        return {"steps": []}
+
+
+def save_status(repo: str, status: dict) -> None:
+    path = os.path.join(repo, STATUS_REL)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(status, f, indent=1)
+    os.replace(tmp, path)
+
+
+def _this_round(status: dict) -> list:
+    """Ledger records scoped to the CURRENT round tag: a step completed
+    in r05 must not skip the identically-named step of r06 — each
+    round's captures are fresh evidence (records carry their tag;
+    legacy tagless records are treated as foreign)."""
+    return [s for s in status["steps"] if s.get("tag") == ROUND_TAG]
+
+
+def completed_steps(status: dict) -> set:
+    return {s["name"] for s in _this_round(status)
+            if s.get("status") == "ok"}
+
+
+def given_up_steps(status: dict, strikes: int = 2) -> set:
+    """Steps that hit their timeout `strikes` times THIS round: stop
+    re-running them automatically (each retry kills a client against a
+    possibly just-slow tunnel — ground rule 2 territory) so the REST of
+    the queue still gets its chance on later windows."""
+    counts: dict = {}
+    for s in _this_round(status):
+        if s.get("status") == "timeout_killed":
+            counts[s["name"]] = counts.get(s["name"], 0) + 1
+    return {name for name, n in counts.items() if n >= strikes}
+
+
+def other_chip_clients(repo: str) -> list:
+    """PIDs of other processes that look like chip clients (ground rule
+    1: one axon client at a time). Scans /proc cmdlines for this repo's
+    chip-capable entry points, excluding ourselves and our ancestors."""
+    markers = ("bench.py", "chip_sweep.py", "tpu_diag.py",
+               "aot_analyze.py", "aot_multichip.py", "aot_accum_probe.py",
+               "cache_warm.py")
+    me = os.getpid()
+    ancestors = set()
+    pid = me
+    for _ in range(16):
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                pid = int(f.read().split()[3])
+            ancestors.add(pid)
+        except (OSError, ValueError, IndexError):
+            break
+    hits = []
+    for d in os.listdir("/proc"):
+        if not d.isdigit() or int(d) == me or int(d) in ancestors:
+            continue
+        try:
+            with open(f"/proc/{d}/cmdline", "rb") as f:
+                cmd = f.read().decode("utf-8", "replace").replace("\0", " ")
+        except OSError:
+            continue
+        if "python" not in cmd:
+            continue
+        if any(m in cmd for m in markers) or (
+                "main.py" in cmd and repo in cmd):
+            hits.append((int(d), cmd.strip()))
+    return hits
+
+
+def run_step(repo: str, step: Step, log_dir: str) -> dict:
+    """Run one queue step supervised; returns its status record."""
+    os.makedirs(log_dir, exist_ok=True)
+    log_path = os.path.join(log_dir, f"{step.name}.log")
+    env = dict(os.environ)
+    env.update(step.env)
+    rec = {"name": step.name, "tag": ROUND_TAG, "argv": step.argv,
+           "started": time.strftime("%FT%TZ", time.gmtime()),
+           "mode_env": {k: v for k, v in step.env.items()}}
+    t0 = time.perf_counter()
+    stdout_path = (os.path.join(repo, step.stdout_to)
+                   if step.stdout_to else None)
+    _say(f"step {step.name}: starting ({' '.join(step.argv)})")
+    try:
+        with open(log_path, "w") as log_f:
+            if stdout_path:
+                os.makedirs(os.path.dirname(stdout_path), exist_ok=True)
+                out_f = open(stdout_path, "w")
+            else:
+                out_f = log_f
+            try:
+                # start_new_session: the step gets its own process
+                # group, so a timeout kill reaps GRANDCHILDREN too
+                # (bench.py spawns probe/CPU-worker subprocesses; an
+                # orphaned one matches other_chip_clients' markers and
+                # would block the next window attempt for ~95 min).
+                p = subprocess.Popen(
+                    step.argv, cwd=repo, env=env, stdout=out_f,
+                    stderr=log_f if stdout_path else subprocess.STDOUT,
+                    start_new_session=True)
+                try:
+                    rc = p.wait(timeout=step.timeout_s)
+                    rec["rc"] = rc
+                    rec["status"] = "ok" if rc == 0 else "failed"
+                except subprocess.TimeoutExpired:
+                    # The generous budget was exceeded: the tunnel is
+                    # wedged. This kill is exactly the mid-compile kill
+                    # ground rule 2 forbids against a HEALTHY relay —
+                    # record it loudly; the caller aborts the queue.
+                    import signal as _signal
+
+                    try:
+                        os.killpg(os.getpgid(p.pid), _signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        p.kill()
+                    try:
+                        p.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        # D-state child SIGKILL can't reap (wedged
+                        # transport I/O): record and move on — the
+                        # zombie-to-be will trip other_chip_clients,
+                        # which is the correct conservative behavior;
+                        # crashing the daemon here would silently end
+                        # all future window capture.
+                        _say(f"step {step.name}: child {p.pid} did not "
+                             "die within 30s of SIGKILL (D-state?)")
+                    rec["status"] = "timeout_killed"
+                    rec["rc"] = None
+                    _say(f"step {step.name}: TIMEOUT after "
+                         f"{step.timeout_s:.0f}s — process group killed "
+                         "(tunnel presumed wedged); queue will abort")
+            finally:
+                if stdout_path:
+                    out_f.close()
+    except OSError as e:
+        rec["status"] = "failed"
+        rec["rc"] = None
+        rec["error"] = str(e)
+        _say(f"step {step.name}: spawn failed: {e}")
+    rec["wall_s"] = round(time.perf_counter() - t0, 1)
+    _say(f"step {step.name}: {rec['status']} in {rec['wall_s']}s")
+    return rec
+
+
+def run_queue(repo: str, queue: list, resume_from: set = frozenset(),
+              mode: str | None = None) -> bool:
+    """Run the queue, committing artifacts after every step. Returns
+    True iff every step completed ok (now or in a prior window).
+    `mode` is the relay mode the queue was BUILT for: if the live mode
+    shifts mid-queue (e.g. :8093 drops, remote -> local_compile), the
+    queue stops so the caller rebuilds it with the right compile-leg
+    env instead of hanging a step on a dead leg."""
+    log_dir = os.path.join(repo, LOG_DIR_REL)
+    all_ok = True
+    for step in queue:
+        if step.name in resume_from and not step.always_run:
+            _say(f"step {step.name}: already completed in a prior "
+                 "window — skipping")
+            continue
+        status_now = relay_status()
+        mode_now = relay_mode(status_now)
+        if mode_now is None:
+            _say(f"relay went down before step {step.name} "
+                 f"({status_now}) — stopping queue; will resume on "
+                 "next window")
+            return False
+        if mode is not None and mode_now != mode:
+            _say(f"relay mode shifted {mode} -> {mode_now} before step "
+                 f"{step.name} — stopping so the queue is rebuilt with "
+                 "the right compile-leg env")
+            return False
+        rec = run_step(repo, step, log_dir)
+        rec["relay_at_start"] = status_now
+        status = load_status(repo)
+        status["steps"].append(rec)
+        save_status(repo, status)
+        arts = list(step.artifacts)
+        for src, dest_rel in step.collect:
+            dest = os.path.join(repo, dest_rel)
+            try:
+                if os.path.isdir(src):
+                    if os.path.isdir(dest):
+                        shutil.rmtree(dest)
+                    shutil.copytree(src, dest)
+                elif os.path.exists(src):
+                    os.makedirs(os.path.dirname(dest), exist_ok=True)
+                    shutil.copy2(src, dest)
+                else:
+                    _say(f"step {step.name}: collect source missing: {src}")
+                    continue
+                arts.append(dest_rel)
+            except OSError as e:
+                _say(f"step {step.name}: collect {src} failed: {e}")
+        arts.append(os.path.relpath(
+            os.path.join(log_dir, f"{step.name}.log"), repo))
+        arts.append(STATUS_REL)
+        try:
+            commit_paths(repo, arts,
+                         f"chip({ROUND_TAG}): {step.name} {rec['status']} "
+                         f"in {rec['wall_s']:.0f}s")
+        except Exception as e:  # a commit hiccup must not lose the window
+            _say(f"artifact commit failed (continuing): {e}")
+        if rec["status"] != "ok":
+            all_ok = False
+            if rec["status"] == "timeout_killed" or step.abort_queue_on_fail:
+                _say("aborting queue (relay presumed sick); remaining "
+                     "steps stay queued for the next window")
+                return False
+    return all_ok
+
+
+def attempt_window(repo: str) -> bool:
+    """One recovery attempt: confirm the relay, guard single-client,
+    run whatever of the queue is still incomplete."""
+    status = relay_status()
+    mode = relay_mode(status)
+    if mode is None:
+        _say(f"relay not usable: {status}")
+        return False
+    time.sleep(CONFIRM_S)
+    status2 = relay_status()
+    if relay_mode(status2) is None:
+        _say(f"relay flapped during confirmation ({status} -> {status2}); "
+             "not starting")
+        return False
+    mode = relay_mode(status2)
+    clients = other_chip_clients(repo)
+    if clients:
+        _say(f"refusing to start: other chip client(s) alive: {clients}")
+        return False
+    status_led = load_status(repo)
+    queue = build_queue(mode)
+    # Health probes (always_run) are exempt from both completion skip
+    # and give-up: they re-run every attempt, and their failure aborts
+    # the attempt — so they can never be skipped into an unverified
+    # client launch, nor retired while the rest of the queue pends.
+    always = {s.name for s in queue if s.always_run}
+    skip = (completed_steps(status_led) | given_up_steps(status_led)) - always
+    remaining = [s.name for s in queue
+                 if s.name not in skip and not s.always_run]
+    if not remaining:
+        _say("queue fully completed (or remaining steps given up) — "
+             "nothing to do")
+        return True
+    _say(f"RELAY UP (mode={mode}) — running queue: "
+         f"{sorted(always) + remaining}")
+    return run_queue(repo, queue, resume_from=skip, mode=mode)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--watch", action="store_true",
+                   help="daemon: poll the relay, run the queue on recovery")
+    g.add_argument("--once", action="store_true",
+                   help="health-check and run the (remaining) queue now")
+    g.add_argument("--dry-run", action="store_true",
+                   help="print the queue for both modes; execute nothing")
+    ap.add_argument("--prewarm", action="store_true",
+                    help="before watching (or before --once's attempt), "
+                         "run tools/cache_warm.py once — offline "
+                         "local-AOT compiles of every official program "
+                         "into the persistent cache, no relay needed — "
+                         "and commit its report; a fresh container "
+                         "becomes driver-ready while the relay is still "
+                         "down. Nonzero step status means a program "
+                         "failed to COMPILE (warm-mode semantics), not "
+                         "that the cache was merely cold")
+    args = ap.parse_args()
+
+    if args.dry_run:
+        for mode in ("remote", "local_compile"):
+            print(f"== mode {mode} ==")
+            for s in build_queue(mode):
+                env = " ".join(f"{k}={v}" for k, v in s.env.items())
+                print(f"  {s.name:<12} timeout={s.timeout_s:>6.0f}s "
+                      f"{env + ' ' if env else ''}{' '.join(s.argv)}")
+                for a in s.artifacts + ([s.stdout_to] if s.stdout_to else []):
+                    print(f"  {'':<12} artifact: {a}")
+        return 0
+
+    # Single-instance lock (watch + once share it: both can start
+    # clients). flock, not O_EXCL+pid-file: the kernel releases it when
+    # the holder dies (no stale-lock state), and acquisition is atomic
+    # (no stale-recovery TOCTOU where two racers each unlink the
+    # other's fresh lock and both run).
+    import fcntl
+
+    lock = os.environ.get("CHIP_AUTORUN_LOCK", "/tmp/chip_autorun.lock")
+    lock_fd = os.open(lock, os.O_CREAT | os.O_WRONLY, 0o644)
+    try:
+        fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        os.close(lock_fd)
+        print("another chip_autorun holds the lock; exiting")
+        return 1
+    os.ftruncate(lock_fd, 0)
+    os.write(lock_fd, str(os.getpid()).encode())  # diagnostics only
+    try:
+        if args.prewarm:
+            # Cache-warm bootstrap (VERDICT r4 item 4): offline local-AOT
+            # compiles of every official program — no relay involved, so
+            # it can run right now; its report records hit/miss per
+            # program, i.e. whether the container started driver-ready.
+            # warm mode, not --check: exit 0 = cache ready (whether it
+            # was already warm or was warmed just now); nonzero only
+            # when a program cannot compile at all
+            rec = run_step(
+                REPO,
+                Step("cache_prewarm",
+                     [sys.executable, "tools/cache_warm.py"],
+                     4 * 3600.0, env={"PALLAS_AXON_POOL_IPS": ""}),
+                os.path.join(REPO, LOG_DIR_REL))
+            status = load_status(REPO)
+            status["steps"].append(rec)
+            save_status(REPO, status)
+            commit_paths(
+                REPO,
+                [os.path.join("docs", "cache_warm_report.json"),
+                 os.path.join(LOG_DIR_REL, "cache_prewarm.log"), STATUS_REL],
+                f"chip({ROUND_TAG}): cache prewarm {rec['status']} "
+                f"in {rec['wall_s']:.0f}s")
+        if args.once:
+            ok = attempt_window(REPO)
+            return 0 if ok else 1
+        _say(f"watching relay ({POLL_S:.0f}s poll); queue tag {ROUND_TAG}")
+        prev = None
+        last_attempt = 0.0
+        fails = 0
+        while True:
+            mode = relay_mode(relay_status())
+            if mode != prev:
+                _say(f"relay transition: {prev} -> {mode}")
+            # Attempt on every transition to up, AND periodically while
+            # the relay STAYS up with queue steps still incomplete — a
+            # refused attempt (e.g. a manual chip client was running,
+            # or a step aborted) must not idle away an hours-long
+            # window just because the sockets never flapped.
+            if mode is not None:
+                led = load_status(REPO)
+                skip = completed_steps(led) | given_up_steps(led)
+                pending = [s.name for s in build_queue(mode)
+                           if s.name not in skip and not s.always_run]
+                # Back off while attempts keep failing against an
+                # up-but-sick relay (each failed attempt may have cost
+                # a client kill); any success resets the cadence.
+                interval = min(RETRY_S * (2 ** fails), 7200.0)
+                due = (mode != prev
+                       or time.monotonic() - last_attempt >= interval)
+                if pending and due:
+                    last_attempt = time.monotonic()
+                    fails = 0 if attempt_window(REPO) else fails + 1
+            prev = mode
+            time.sleep(POLL_S)
+    finally:
+        # flock releases with the fd (and automatically on death);
+        # leave the file in place — it carries the last holder's pid
+        try:
+            os.close(lock_fd)
+        except OSError:
+            pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

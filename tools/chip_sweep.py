@@ -9,7 +9,7 @@ so the record is regenerable:
     python tools/chip_sweep.py scan:b8 scan:b24 scan:b32 scan:b16k16
 
 Spec grammar:
-<scan|dispatch>:b<batch>[k<K>][pallas][zero|fused][pf][i<image>]
+<scan|dispatch|accum>:b<batch>[k<K>][pallas][zero|fused][pf][i<image>]
 — parts in that order; k defaults to 8 for scan / 1 for dispatch, image
 to 256; `zero` selects pad_mode="zero" (conv built-in SAME padding, the
 compiler-certified −32% traffic variant — docs/BENCHMARKS.md pad-probe);
@@ -18,6 +18,11 @@ materialized pads — the parity-preserving variant of the same lever);
 `pf` (dispatch only) stages inputs via the device-prefetch worker — the
 round-4 real-loop contract (`--prefetch_batches`), same XLA program as
 the plain dispatch spec.
+`accum` mode is the gradient-accumulation step (`--grad_accum`,
+TPU_RUNBOOK item 5): b = MICRObatch, k = microbatches per update
+(default 8), so `accum:b1k8i512` is the compiler-certified 512² config
+— one update from 8 microbatches of 1, activation memory bounded by the
+microbatch. `pf` does not apply (inputs are device-staged).
 Runs ONE config per spec sequentially in this process (ground rule:
 one axon client at a time). A failed measurement — an OOM, or a pallas
 spec refused off-CPU — is recorded as an error row and the sweep
@@ -46,7 +51,8 @@ RECORD_PATH = os.environ.get("CYCLEGAN_SWEEP_RECORD") or os.path.join(
     "docs", "bench_sweeps.json")
 
 SPEC_RE = re.compile(
-    r"(scan|dispatch):b(\d+)(?:k(\d+))?(pallas)?(zero|fused)?(pf)?(?:i(\d+))?")
+    r"(scan|dispatch|accum):b(\d+)(?:k(\d+))?(pallas)?(zero|fused)?(pf)?"
+    r"(?:i(\d+))?")
 
 
 def parse_spec(spec: str):
@@ -72,7 +78,7 @@ def parse_spec(spec: str):
     if prefetch and mode != "dispatch":
         raise SystemExit(f"bad spec: {spec} (pf applies to dispatch only)")
     if k is None:
-        k = 8 if mode == "scan" else 1
+        k = 1 if mode == "dispatch" else 8
     return mode, batch, k, pallas, pad_mode, pad_impl, prefetch, image
 
 
@@ -151,6 +157,10 @@ def run_spec(spec: str) -> None:
             ips = bench.bench_scan("bfloat16", batch, image=image,
                                    norm_impl=norm, k=k, pad_mode=pad_mode,
                                    pad_impl=pad_impl)
+        elif mode == "accum":
+            ips = bench.bench_accum("bfloat16", micro=batch, image=image,
+                                    accum=k, norm_impl=norm,
+                                    pad_mode=pad_mode, pad_impl=pad_impl)
         else:
             ips = bench.bench_dispatch("bfloat16", batch, image=image,
                                        norm_impl=norm, k=k,
