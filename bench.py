@@ -528,8 +528,14 @@ def _emit(results, done: bool) -> None:
             line["probes"] = list(_PROBE_LOG)
         print(json.dumps(line), flush=True)
         return
-    best_key = max(results, key=results.get)
-    best = results[best_key]
+    # Headline `value` comes from PARITY configs only: a /zero row
+    # (relaxed border semantics) may beat every parity config, but the
+    # metric's meaning is "the reference's train step"; zero rides in
+    # `all` with its own key.
+    parity = {k: v for k, v in results.items() if "/zero" not in k}
+    pool = parity or results
+    best_key = max(pool, key=pool.get)
+    best = pool[best_key]
     line = {
         "metric": "cyclegan_256_train_images_per_sec_1chip",
         "value": round(best, 2),
@@ -561,6 +567,8 @@ def _config_key(c: dict) -> str:
         key += "/pf"
     if c.get("pad_impl", "pad") == "fused":
         key += "/fused"
+    if c.get("pad_mode", "reflect") == "zero":
+        key += "/zero"
     return key
 
 
@@ -585,6 +593,7 @@ def _run_configs(results: dict, configs, t_start: float, on_result=None,
             # least one honest measurement lands inside the budget.
             on_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
             pad_impl = c.get("pad_impl", "pad")
+            pad_mode = c.get("pad_mode", "reflect")
             if mode == "steps":
                 # on_cpu: 2 total steps (~100s each at 256^2) — the CPU
                 # fallback is a liveness signal, not a precision number,
@@ -600,13 +609,14 @@ def _run_configs(results: dict, configs, t_start: float, on_result=None,
                 ips = bench_dispatch(
                     dtype, batch, image=image, k=k, warmup=1,
                     iters=1 if on_cpu else max(2, -(-10 // k)),
-                    pad_impl=pad_impl, prefetch=bool(c.get("prefetch")),
+                    pad_mode=pad_mode, pad_impl=pad_impl,
+                    prefetch=bool(c.get("prefetch")),
                 )
             else:
                 ips = bench_scan(
                     dtype, batch, image=image, warmup=1,
                     iters=1 if on_cpu else 3, k=2 if on_cpu else 8,
-                    pad_impl=pad_impl,
+                    pad_mode=pad_mode, pad_impl=pad_impl,
                 )
             results[key] = ips
             if on_result is not None:
@@ -641,6 +651,13 @@ TPU_CONFIGS = [
     # quantifies how much of the scan-vs-dispatch gap prefetch closes.
     {"mode": "dispatch", "dtype": "bfloat16", "batch": 16, "k": 8,
      "prefetch": True},
+    # The zero-pad lever (compiler-certified −32.4% step traffic,
+    # quality-cleared at toy scale — docs/RESULTS.md pad A/B): carried
+    # in the OFFICIAL record so the driver window captures it. Placed
+    # AFTER the parity/REAL-loop rows because _emit excludes /zero from
+    # the headline `value` (non-parity borders) — it must not spend a
+    # tight budget ahead of rows that can claim the headline.
+    {"mode": "scan", "dtype": "bfloat16", "batch": 16, "pad_mode": "zero"},
     # one batch-sweep point beyond the headline in the official record
     # (the full sweep lives in docs/bench_sweeps.json)
     {"mode": "scan", "dtype": "bfloat16", "batch": 24},

@@ -120,6 +120,25 @@ def test_config_key_format():
         {"mode": "scan", "dtype": "bfloat16", "batch": 16,
          "pad_impl": "fused"}
     ) == "scan/bfloat16/b16/fused"
+    assert bench._config_key(
+        {"mode": "scan", "dtype": "bfloat16", "batch": 16,
+         "pad_mode": "zero"}
+    ) == "scan/bfloat16/b16/zero"
+
+
+def test_emit_headline_excludes_zero_pad_rows(capsys):
+    """/zero rows (non-parity border semantics) ride in `all` but must
+    not claim the headline `value` — the metric means the REFERENCE's
+    train step."""
+    bench._emit({"scan/bfloat16/b16": 95.0,
+                 "scan/bfloat16/b16/zero": 140.0}, done=True)
+    d = _last_json(capsys)
+    assert d["value"] == 95.0 and d["config"] == "scan/bfloat16/b16"
+    assert d["all"]["scan/bfloat16/b16/zero"] == 140.0
+    # a zero-only result set still emits (fallback pool)
+    bench._emit({"scan/bfloat16/b16/zero": 140.0}, done=True)
+    d = _last_json(capsys)
+    assert d["value"] == 140.0
 
 
 def test_flops_accounting_follows_winning_geometry():

@@ -95,10 +95,14 @@ def official_programs() -> list:
             k=c.get("k", 8 if c["mode"] == "scan" else 1),
             pad_mode=c.get("pad_mode", "reflect"),
             pad_impl=c.get("pad_impl", "pad"))
-    # chip_autorun queue rows (tools/chip_autorun.py build_queue):
-    add("sweep scan:b16zero", "scan", "bfloat16", 16, pad_mode="zero")
-    add("sweep scan:b24zero", "scan", "bfloat16", 24, pad_mode="zero")
-    add("sweep scan:b16fused", "scan", "bfloat16", 16, pad_impl="fused")
+    # chip_autorun queue rows (tools/chip_autorun.py build_queue).
+    # k=8 matches chip_sweep's scan default (parse_spec) — the k the
+    # sweep will actually compile; omitting it would warm k=1 programs
+    # the driver never requests.
+    add("sweep scan:b16zero", "scan", "bfloat16", 16, k=8, pad_mode="zero")
+    add("sweep scan:b24zero", "scan", "bfloat16", 24, k=8, pad_mode="zero")
+    add("sweep scan:b16fused", "scan", "bfloat16", 16, k=8,
+        pad_impl="fused")
     add("sweep accum:b1k8i512", "accum", "bfloat16", 1, image=512, k=8,
         accum=8)
     add("sweep scan:b4k2i512", "scan", "bfloat16", 4, image=512, k=2)
