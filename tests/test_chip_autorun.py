@@ -228,7 +228,11 @@ def test_run_queue_timeout_kills_grandchildren(fake_repo):
         "open('childpid.txt', 'w').write(str(child.pid))\n"
         "time.sleep(60)\n"
     )
-    q = [_stub_step("hang_tree", script, timeout_s=3.0,
+    # 10s timeout, not 3: on a loaded 1-core host the stub interpreter
+    # can take seconds to even start — the kill must land AFTER the
+    # grandchild exists or the test asserts nothing (observed flaky in
+    # the full suite under a concurrent training run)
+    q = [_stub_step("hang_tree", script, timeout_s=10.0,
                     artifacts=["childpid.txt"])]
     assert chip_autorun.run_queue(fake_repo, q) is False
     pid = int(open(os.path.join(fake_repo, "childpid.txt")).read())
@@ -336,6 +340,76 @@ def test_run_queue_stops_on_mode_shift(fake_repo, monkeypatch):
     # matching mode proceeds
     assert chip_autorun.run_queue(fake_repo, q, mode="local_compile")
     assert os.path.exists(os.path.join(fake_repo, "a.txt"))
+
+
+def test_argv_matching_is_token_based(tmp_path):
+    """A marker NAME inside a long argument string (a harness process
+    whose embedded prompt mentions bench.py, a grep over the repo) must
+    NOT read as a chip client — only an actual argv SCRIPT token
+    invoking the entry point does. The substring version of this bug
+    made the deployed watcher refuse every window while the session
+    driver was alive (found via the full-suite run, where pytest is
+    reparented away from the driver's ancestor chain)."""
+    repo = str(tmp_path)
+    is_client = chip_autorun._argv_is_chip_client
+    # real clients
+    assert is_client(["python", "bench.py"], repo)
+    assert is_client(["/opt/venv/bin/python", "tools/tpu_diag.py",
+                      "--full"], repo)
+    assert is_client(["python3", "/x/tools/chip_sweep.py", "scan:b16"],
+                     repo)
+    # marker name embedded in a prompt/argument string: NOT a client
+    assert not is_client(
+        ["claude", "-p", "--append-system-prompt",
+         "keep tests green (python -m pytest); run bench.py and "
+         "tools/tpu_diag.py when the relay recovers"], repo)
+    assert not is_client(["grep", "-rn", "bench.py", "."], repo)
+    # marker as a DATA argument after a non-marker script: not a client
+    assert not is_client(["python", "tools/plot.py", "--input",
+                          "bench.py"], repo)
+    assert not is_client(["python", "-m", "pydoc", "bench.py"], repo)
+    # non-python argv0 never matches even with a marker token
+    assert not is_client(["bash", "bench.py"], repo)
+    # main.py: only THIS repo's, resolved against the PROCESS's cwd
+    assert is_client(["python", os.path.join(repo, "main.py")], repo)
+    assert is_client(["python", "-u", "main.py"], repo, cwd=repo)
+    assert not is_client(["python", "-u", "main.py"], repo,
+                         cwd="/somewhere/else")
+    # relative main.py with unknown cwd: cannot be claimed as ours
+    assert not is_client(["python", "-u", "main.py"], repo)
+    assert not is_client(["python", "/somewhere/else/main.py"], repo)
+
+
+def test_other_chip_clients_cpu_pinned_exempt_with_positive_control():
+    """A JAX_PLATFORMS=cpu process (offline tests, quality A/B runs)
+    can never claim the chip and must not block a window — while the
+    SAME entry point without the pin (positive control) must be
+    reported. Both processes are killed during interpreter startup
+    (init-phase kills are safe — TPU_RUNBOOK ground rules); the
+    control uses cache_warm --list, which never opens a backend."""
+    import subprocess as sp
+    import time as _t
+
+    tool = os.path.join(REPO, "tools", "cache_warm.py")
+    env_cpu = dict(os.environ)
+    env_cpu["JAX_PLATFORMS"] = "cpu"
+    env_free = {k: v for k, v in os.environ.items()
+                if k != "JAX_PLATFORMS"}
+    p_cpu = sp.Popen([sys.executable, tool, "--list"], env=env_cpu,
+                     stdout=sp.DEVNULL, stderr=sp.DEVNULL)
+    p_free = sp.Popen([sys.executable, tool, "--list"], env=env_free,
+                      stdout=sp.DEVNULL, stderr=sp.DEVNULL)
+    try:
+        _t.sleep(0.5)  # let /proc entries appear
+        assert p_cpu.poll() is None and p_free.poll() is None, (
+            "probe processes died before the scan — test would be vacuous")
+        hits = [pid for pid, _ in chip_autorun.other_chip_clients(REPO)]
+        assert p_free.pid in hits  # positive control: detection works
+        assert p_cpu.pid not in hits  # cpu-pinned is exempt
+    finally:
+        for p in (p_cpu, p_free):
+            p.kill()
+            p.wait()
 
 
 def test_commit_paths_manifests_oversized_dirs(fake_repo, monkeypatch):

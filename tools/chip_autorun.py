@@ -316,13 +316,49 @@ def given_up_steps(status: dict, strikes: int = 2) -> set:
     return {name for name, n in counts.items() if n >= strikes}
 
 
-def other_chip_clients(repo: str) -> list:
-    """PIDs of other processes that look like chip clients (ground rule
-    1: one axon client at a time). Scans /proc cmdlines for this repo's
-    chip-capable entry points, excluding ourselves and our ancestors."""
+def _argv_is_chip_client(argv: list, repo: str, cwd: str | None = None) -> bool:
+    """True if this parsed argv looks like one of the repo's
+    chip-capable entry points. Matching rules, each closing an observed
+    or reviewed false-positive that would make the watcher refuse every
+    window:
+    - per-TOKEN, never substring over the joined cmdline (the session
+      driver's --append-system-prompt MENTIONS bench.py/tpu_diag.py
+      inside one giant argv element);
+    - only the SCRIPT position (first non-option token after argv[0])
+      is matched, so `python sometool.py --input bench.py` — a marker
+      name as a data argument — is not a client;
+    - main.py is generic: a relative token resolves against the
+      process's own cwd (`cwd`), and only THIS repo's main.py counts.
+    """
+    if not argv:
+        return False
+    if "python" not in os.path.basename(argv[0]):
+        return False
     markers = ("bench.py", "chip_sweep.py", "tpu_diag.py",
                "aot_analyze.py", "aot_multichip.py", "aot_accum_probe.py",
-               "cache_warm.py")
+               "cache_warm.py", "main.py")
+    script = next((t for t in argv[1:] if not t.startswith("-")), None)
+    if script is None:
+        return False
+    base = os.path.basename(script)
+    if base not in markers:
+        return False
+    if base != "main.py":
+        return True
+    if os.path.isabs(script):
+        path = script
+    elif cwd:
+        path = os.path.join(cwd, script)
+    else:
+        return False  # relative main.py with unknown cwd: can't claim it's ours
+    return os.path.realpath(path).startswith(
+        os.path.realpath(repo) + os.sep)
+
+
+def other_chip_clients(repo: str) -> list:
+    """PIDs of other processes that look like chip clients (ground rule
+    1: one axon client at a time). Scans /proc argv token-wise,
+    excluding ourselves and our ancestors."""
     me = os.getpid()
     ancestors = set()
     pid = me
@@ -339,14 +375,28 @@ def other_chip_clients(repo: str) -> list:
             continue
         try:
             with open(f"/proc/{d}/cmdline", "rb") as f:
-                cmd = f.read().decode("utf-8", "replace").replace("\0", " ")
+                argv = [t.decode("utf-8", "replace")
+                        for t in f.read().split(b"\0") if t]
         except OSError:
             continue
-        if "python" not in cmd:
+        try:
+            proc_cwd = os.readlink(f"/proc/{d}/cwd")
+        except OSError:
+            proc_cwd = None
+        if not _argv_is_chip_client(argv, repo, cwd=proc_cwd):
             continue
-        if any(m in cmd for m in markers) or (
-                "main.py" in cmd and repo in cmd):
-            hits.append((int(d), cmd.strip()))
+        # A JAX_PLATFORMS=cpu process can never claim the chip (the
+        # repo's CLIs re-assert the env var over the sitecustomize) —
+        # offline CPU work (tests, quality A/Bs) must not block a
+        # window.
+        try:
+            with open(f"/proc/{d}/environ", "rb") as f:
+                env_entries = f.read().split(b"\0")
+            if b"JAX_PLATFORMS=cpu" in env_entries:
+                continue
+        except OSError:
+            pass  # unreadable environ: assume it could be a client
+        hits.append((int(d), " ".join(argv)[:300]))
     return hits
 
 
