@@ -523,6 +523,19 @@ def run_queue(repo: str, queue: list, resume_from: set = frozenset(),
                 arts.append(dest_rel)
             except OSError as e:
                 _say(f"step {step.name}: collect {src} failed: {e}")
+        if step.stdout_to and rec["status"] == "ok":
+            # only commit a record a failed/killed step couldn't have
+            # truncated: the stdout file is pre-created before Popen,
+            # so on failure it holds partial bytes — committing that
+            # as the official bench JSON would poison every consumer
+            # that globs for the newest record.
+            try:
+                with open(os.path.join(repo, step.stdout_to)) as f:
+                    json.load(f)
+                arts.append(step.stdout_to)
+            except (OSError, ValueError) as e:
+                _say(f"step {step.name}: stdout record not committed "
+                     f"(unparseable: {e})")
         arts.append(os.path.relpath(
             os.path.join(log_dir, f"{step.name}.log"), repo))
         arts.append(STATUS_REL)
