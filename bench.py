@@ -161,6 +161,10 @@ def _spawn_cpu_worker(results_path: str) -> subprocess.Popen:
     env["JAX_PLATFORMS"] = "cpu"
     env["BENCH_ROLE"] = "cpu-worker"
     env["BENCH_RESULTS_FILE"] = results_path
+    # Telemetry stays coordinator-owned: two writers appending one JSONL
+    # stream would interleave; the coordinator logs the worker's results
+    # when it merges them at emit time.
+    env.pop("BENCH_OBS_JSONL", None)
     return subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)],
         stdout=subprocess.DEVNULL,
@@ -434,6 +438,42 @@ _DEVICE_KIND = ""
 # the worker's incremental results (in-process results win on key clash).
 _WORKER_RESULTS_PATH: str | None = None
 
+# Optional telemetry stream (BENCH_OBS_JSONL=path): the same event
+# schema training runs write (cyclegan_tpu/obs), so tools/obs_report.py
+# folds bench and training streams with one tool. Coordinator-only
+# (workers get the env var stripped); every use is guarded so telemetry
+# can never break the one-JSON-line emission contract.
+_OBS_LOGGER = None
+
+
+def _obs_event(kind: str, **fields) -> None:
+    if _OBS_LOGGER is not None:
+        try:
+            _OBS_LOGGER.event(kind, **fields)
+            _OBS_LOGGER.flush()
+        except Exception:
+            pass
+
+
+def _obs_open() -> None:
+    """Open the stream and write the manifest. query_devices=False: the
+    emit path must never touch the backend (a dead TPU transport blocks
+    backend queries indefinitely — see _PLATFORM's note)."""
+    global _OBS_LOGGER
+    path = os.environ.get("BENCH_OBS_JSONL")
+    if not path:
+        return
+    try:
+        from cyclegan_tpu.obs import MetricsLogger, build_manifest
+
+        _OBS_LOGGER = MetricsLogger(path)
+        _OBS_LOGGER.event(
+            "manifest",
+            **build_manifest(None, query_devices=False, role="bench"),
+        )
+    except Exception:
+        _OBS_LOGGER = None
+
 # One entry per accelerator probe attempt: {"at_s": offset from process
 # start, "wait_s": ACTUAL seconds the probe took (= the allowed timeout
 # when it hung), "result": backend name, "hung" (killed at timeout), or
@@ -526,6 +566,7 @@ def _emit(results, done: bool) -> None:
             line["note"] = note
         if _PROBE_LOG:
             line["probes"] = list(_PROBE_LOG)
+        _obs_event("bench_summary", **line)
         print(json.dumps(line), flush=True)
         return
     # Headline `value` comes from PARITY configs only: a /zero row
@@ -554,6 +595,7 @@ def _emit(results, done: bool) -> None:
         line["probes"] = list(_PROBE_LOG)
     if not done:
         line["partial"] = True
+    _obs_event("bench_summary", **line)
     print(json.dumps(line), flush=True)
 
 
@@ -621,8 +663,12 @@ def _run_configs(results: dict, configs, t_start: float, on_result=None,
             results[key] = ips
             if on_result is not None:
                 on_result()
+            _obs_event("bench", key=key, images_per_sec=round(ips, 4),
+                       platform=_backend(), spent_s=round(
+                           time.perf_counter() - t_start, 1))
             print(f"[{tag}] {key}: {ips:.2f} images/sec", file=sys.stderr, flush=True)
         except Exception as e:
+            _obs_event("bench_error", key=key, error=f"{type(e).__name__}: {e}")
             print(f"[{tag}] {key}: FAILED {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
 
@@ -698,6 +744,7 @@ def main():
     global _PLATFORM, _WORKER_RESULTS_PATH
     results: dict = {}
     t_start = time.perf_counter()
+    _obs_open()
 
     # Exactly-one-emit: every emitter (signal handler, watchdog thread,
     # the normal exit path) must win this test-and-set first. A plain

@@ -168,6 +168,27 @@ class TrainConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Run telemetry (cyclegan_tpu/obs): JSONL event stream, stall
+    watchdog, memory watermarks. No reference counterpart — the
+    reference's only instrumentation is the per-epoch elapse scalar."""
+
+    enabled: bool = True
+    # Append-only JSONL event stream; None resolves to
+    # <output_dir>/telemetry.jsonl, "none" disables like enabled=False.
+    jsonl_path: Optional[str] = None
+    # Stall watchdog: warn (and record pending-dispatch depth) when no
+    # step completes within this many seconds; 0 disables the thread.
+    watchdog_deadline_s: float = 0.0
+    # Emit a per-dispatch `step` event every N dispatches (0 = aggregate
+    # epoch_steps events only — for long runs where per-step records
+    # would dominate the stream).
+    step_log_every: int = 1
+    # Sample per-device HBM watermarks every N epochs.
+    memory_sample_every: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     model: ModelConfig = ModelConfig()
     optimizer: OptimizerConfig = OptimizerConfig()
@@ -175,6 +196,7 @@ class Config:
     data: DataConfig = DataConfig()
     parallel: ParallelConfig = ParallelConfig()
     train: TrainConfig = TrainConfig()
+    obs: ObsConfig = ObsConfig()
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)

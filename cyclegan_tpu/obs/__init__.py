@@ -1,0 +1,49 @@
+"""Run-wide telemetry: JSONL event stream, step-time attribution, stall
+watchdog, memory watermarks, run manifest.
+
+The reference's only instrumentation is a per-epoch wall-clock scalar and
+tqdm bars (SURVEY.md §5); `utils/summary.py` mirrors that with epoch-mean
+TensorBoard scalars and `utils/profiler.py` captures a bounded trace
+window. Neither answers the questions that decide whether a TPU run is
+healthy WHILE it runs: is the input pipeline starving the device, what
+does a step actually cost, how much HBM headroom is left, did the device
+hang (docs/TUNNEL_POSTMORTEM.md). This package answers them with an
+append-only JSONL event stream written incrementally — a preempted or
+crashed run keeps every event up to the moment it died — that
+`tools/obs_report.py` folds into a human-readable run report. `bench.py`
+emits the same schema (BENCH_OBS_JSONL), so bench and training runs are
+comparable with one tool.
+
+Design constraint: NOTHING here may add a host-device synchronization to
+the dispatch hot path. The StepClock only timestamps work the loop
+already does (staging, dispatch returns, and the deferred metric fetches
+on the existing backpressure path — never `block_until_ready`);
+`tools/check_no_sync.py` enforces this statically and runs in tier-1.
+"""
+
+from cyclegan_tpu.obs.jsonl import EVENT_SCHEMA_VERSION, MetricsLogger, NullMetricsLogger
+from cyclegan_tpu.obs.manifest import build_manifest
+from cyclegan_tpu.obs.memory import memory_watermarks
+from cyclegan_tpu.obs.stepclock import NullStepClock, StepClock
+from cyclegan_tpu.obs.telemetry import (
+    NULL_TELEMETRY,
+    NullTelemetry,
+    Telemetry,
+    make_telemetry,
+)
+from cyclegan_tpu.obs.watchdog import StallWatchdog
+
+__all__ = [
+    "EVENT_SCHEMA_VERSION",
+    "MetricsLogger",
+    "NullMetricsLogger",
+    "build_manifest",
+    "memory_watermarks",
+    "StepClock",
+    "NullStepClock",
+    "StallWatchdog",
+    "Telemetry",
+    "NullTelemetry",
+    "NULL_TELEMETRY",
+    "make_telemetry",
+]

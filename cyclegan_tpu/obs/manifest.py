@@ -1,0 +1,106 @@
+"""Run manifest: the event that makes a telemetry stream self-describing.
+
+Written once at startup, before any step event, so a JSONL file carries
+everything needed to interpret (and reproduce) the run it describes:
+full config tree, mesh shape, software versions, git SHA, host topology,
+and the argv that launched it. `bench.py` writes the same event shape
+with `query_devices=False` — its emit path must never touch the backend
+(a dead TPU transport blocks `jax.default_backend()` indefinitely;
+see bench.py's _PLATFORM note).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import platform as _platform
+import subprocess
+import sys
+import time
+from typing import Optional
+
+from cyclegan_tpu.obs.jsonl import EVENT_SCHEMA_VERSION
+
+
+def git_sha(cwd: Optional[str] = None) -> Optional[str]:
+    """Best-effort repo SHA (None outside a git checkout)."""
+    if cwd is None:
+        cwd = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=cwd, capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def _versions() -> dict:
+    v = {"python": sys.version.split()[0]}
+    try:
+        import jax
+
+        v["jax"] = jax.__version__
+    except Exception:
+        pass
+    try:
+        import jaxlib
+
+        v["jaxlib"] = getattr(jaxlib, "__version__", None)
+    except Exception:
+        pass
+    try:  # present only on TPU images
+        from jax.lib import xla_bridge  # noqa: F401
+        import libtpu  # type: ignore
+
+        v["libtpu"] = getattr(libtpu, "__version__", "present")
+    except Exception:
+        pass
+    return v
+
+
+def build_manifest(config=None, plan=None, query_devices: bool = True,
+                   **extra) -> dict:
+    """Assemble the manifest payload (the caller logs it as an event).
+
+    `config` is the frozen Config dataclass (serialized whole); `plan` a
+    parallel.mesh.MeshPlan for mesh shape. With `query_devices=False`
+    nothing touches the JAX backend — safe before/without device init.
+    """
+    mani: dict = {
+        "schema_version": EVENT_SCHEMA_VERSION,
+        "unix_time": round(time.time(), 3),
+        "argv": list(sys.argv),
+        "hostname": _platform.node(),
+        "pid": os.getpid(),
+        "versions": _versions(),
+        "git_sha": git_sha(),
+    }
+    if config is not None:
+        mani["config"] = dataclasses.asdict(config)
+    if extra:
+        mani.update(extra)
+
+    mesh: dict = {}
+    if plan is not None:
+        mesh.update(
+            n_devices=plan.n_devices, n_data=plan.n_data,
+            n_spatial=plan.n_spatial,
+        )
+    if query_devices:
+        import jax
+
+        mesh.setdefault("n_devices", len(jax.devices()))
+        mesh["platform"] = jax.default_backend()
+        mesh["device_kind"] = jax.devices()[0].device_kind
+        mani["host"] = {
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+            "local_device_count": jax.local_device_count(),
+        }
+    if mesh:
+        mani["mesh"] = mesh
+    return mani

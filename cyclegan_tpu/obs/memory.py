@@ -1,0 +1,45 @@
+"""Per-device HBM watermark sampling.
+
+`jax.Device.memory_stats()` is a host-side query of the allocator's
+counters — it does not synchronize with the device stream, so sampling
+it at epoch boundaries adds nothing to the hot path. TPU backends report
+`bytes_in_use` / `peak_bytes_in_use` / `bytes_limit`; the CPU backend
+returns None (the event is still emitted, with an `available: false`
+marker, so a telemetry stream always contains the sample the schema
+promises).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+_WATERMARK_KEYS = (
+    "bytes_in_use",
+    "peak_bytes_in_use",
+    "bytes_limit",
+    "bytes_reserved",
+    "largest_alloc_size",
+)
+
+
+def memory_watermarks(devices: Optional[List] = None) -> dict:
+    """Snapshot allocator watermarks for each local device."""
+    import jax
+
+    if devices is None:
+        devices = jax.local_devices()
+    rows = []
+    available = False
+    for d in devices:
+        row: dict = {"id": d.id, "kind": d.device_kind}
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            available = True
+            for key in _WATERMARK_KEYS:
+                if key in stats:
+                    row[key] = int(stats[key])
+        rows.append(row)
+    return {"available": available, "devices": rows}

@@ -1,0 +1,200 @@
+"""Non-blocking wall-time attribution for the dispatch loop.
+
+The training loop is deliberately asynchronous (train/loop.py): the host
+stages batches, dispatches jitted steps without waiting, and fetches the
+tiny metric arrays only when the bounded in-flight window forces it
+(`append_metrics` backpressure) or at epoch end. A naive per-step timer
+would have to synchronize — exactly what the loop exists to avoid. The
+StepClock instead timestamps ONLY work the loop already does:
+
+- `stage` — time inside `next(it)`: host batch prep + device_put at
+  prefetch depth 0, or queue wait when the prefetch worker runs ahead.
+  At steady state this is input-pipeline starvation: the device had
+  nothing queued and the host made it wait.
+- `dispatch` — time inside the jitted-call return: enqueue cost (plus
+  compilation on the first dispatch of a program).
+- `fetch_block` — time blocked in the `jax.device_get` the backpressure
+  path already performs. Because metrics data-depend on their step, a
+  fetch completing at T proves that step finished on device by T; at
+  steady state this is where device-bound time surfaces, so the
+  dispatch-to-dispatch interval (`wall`) paces to the device step time
+  without any added sync.
+
+No `block_until_ready`, no extra `device_get`, no synchronization of
+any kind is introduced — `tools/check_no_sync.py` enforces this file
+stays that way.
+
+Per-dispatch `step` events are emitted every `log_every` dispatches
+(every dispatch by default); `finish()` always emits an `epoch_steps`
+aggregate (totals, wall percentiles, starvation fraction). `depth`
+tracks pinned in-flight batches for the stall watchdog, and every
+dispatch/fetch beats the watchdog's heartbeat.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class StepClock:
+    """One per (epoch, split) pass; drive with
+    stage_begin -> staged -> dispatched -> fetched* per loop iteration,
+    then drained/finish once."""
+
+    def __init__(
+        self,
+        logger,
+        epoch: int,
+        split: str = "train",
+        log_every: int = 1,
+        heartbeat: Optional[Callable[[], None]] = None,
+        clock=time.perf_counter,
+    ):
+        self._logger = logger
+        self._epoch = epoch
+        self._split = split
+        self._log_every = max(0, int(log_every))
+        self._heartbeat = heartbeat or (lambda: None)
+        self._clock = clock
+        self.depth = 0  # pinned in-flight batches (watchdog reads this)
+        self.n_dispatches = 0
+        self.n_steps = 0
+        self._walls: List[float] = []  # per-dispatch loop-iteration wall
+        self._stage_s = 0.0
+        self._dispatch_s = 0.0
+        self._fetch_s = 0.0
+        self._drain_s = 0.0
+        self._t_open = clock()
+        self._t_iter: Optional[float] = None  # current iteration start
+        self._t0 = None  # stage_begin timestamp
+        self._cur: Optional[dict] = None  # current dispatch record
+
+    def _close_record(self, now: float) -> None:
+        if self._cur is None:
+            return
+        wall = now - self._t_iter
+        self._cur["wall_s"] = round(wall, 6)
+        self._walls.append(wall)
+        if self._log_every and (self.n_dispatches % self._log_every == 0):
+            self._logger.event("step", **self._cur)
+        self._cur = None
+
+    def stage_begin(self) -> None:
+        now = self._clock()
+        self._close_record(now)
+        self._t_iter = now
+        self._t0 = now
+
+    def staged(self) -> None:
+        now = self._clock()
+        if self._t0 is None:  # tolerate missed stage_begin
+            self._t0 = self._t_iter = now
+        self._last_stage = now - self._t0
+        self._stage_s += self._last_stage
+        self._t0 = now
+
+    def dispatched(self, steps: int = 1, pinned: Optional[int] = None,
+                   kind: str = "single") -> None:
+        now = self._clock()
+        d = now - self._t0 if self._t0 is not None else 0.0
+        self._dispatch_s += d
+        self.depth += steps if pinned is None else pinned
+        self.n_dispatches += 1
+        self.n_steps += steps
+        self._cur = {
+            "split": self._split,
+            "epoch": self._epoch,
+            "dispatch": self.n_dispatches - 1,
+            "steps": steps,
+            "kind": kind,
+            "stage_s": round(getattr(self, "_last_stage", 0.0), 6),
+            "dispatch_s": round(d, 6),
+            "fetch_block_s": 0.0,
+            "depth": self.depth,
+        }
+        self._heartbeat()
+
+    def fetched(self, wait_s: float, steps: int = 1,
+                pinned: Optional[int] = None) -> None:
+        """One deferred metric fetch completed on the backpressure path
+        (wait_s = how long the host was blocked in the device_get the
+        loop performs anyway)."""
+        self.depth = max(0, self.depth - (steps if pinned is None else pinned))
+        self._fetch_s += wait_s
+        if self._cur is not None:
+            self._cur["fetch_block_s"] = round(
+                self._cur["fetch_block_s"] + wait_s, 6
+            )
+            self._cur["depth"] = self.depth
+        self._heartbeat()
+
+    def drained(self, wait_s: float, n_entries: int = 0) -> None:
+        """End-of-pass fetch of all still-pending metric entries."""
+        self._drain_s += wait_s
+        self.depth = 0
+        self._heartbeat()
+
+    def finish(self) -> dict:
+        """Close the pass: emit and return the `epoch_steps` aggregate."""
+        now = self._clock()
+        self._close_record(now)
+        wall = now - self._t_open
+        walls = sorted(self._walls)
+        busy = self._stage_s + self._dispatch_s + self._fetch_s
+        agg = {
+            "split": self._split,
+            "epoch": self._epoch,
+            "n_dispatches": self.n_dispatches,
+            "n_steps": self.n_steps,
+            "wall_s": round(wall, 6),
+            "stage_s": round(self._stage_s, 6),
+            "dispatch_s": round(self._dispatch_s, 6),
+            "fetch_block_s": round(self._fetch_s, 6),
+            "drain_s": round(self._drain_s, 6),
+            # Fraction of loop wall the host spent waiting on INPUT
+            # (staging/queue), i.e. device starvation by the pipeline.
+            "starvation_fraction": round(self._stage_s / wall, 6) if wall > 0 else 0.0,
+            "wall_p50_s": round(_percentile(walls, 0.50), 6),
+            "wall_p90_s": round(_percentile(walls, 0.90), 6),
+            "wall_max_s": round(walls[-1], 6) if walls else float("nan"),
+        }
+        self._logger.event("epoch_steps", **agg)
+        self._heartbeat()
+        return agg
+
+
+class NullStepClock(StepClock):
+    """Disabled-telemetry stand-in: same surface, no timestamps, no
+    events — the hot loop calls methods unconditionally."""
+
+    def __init__(self):  # noqa: D107 — deliberately empty
+        self.depth = 0
+        self.n_dispatches = 0
+        self.n_steps = 0
+
+    def stage_begin(self):
+        pass
+
+    def staged(self):
+        pass
+
+    def dispatched(self, steps=1, pinned=None, kind="single"):
+        pass
+
+    def fetched(self, wait_s, steps=1, pinned=None):
+        pass
+
+    def drained(self, wait_s, n_entries=0):
+        pass
+
+    def finish(self):
+        return {}

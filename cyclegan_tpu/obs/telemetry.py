@@ -1,0 +1,138 @@
+"""Telemetry bundle: the one object main.py/loop.py talk to.
+
+Groups the JSONL stream, per-pass StepClocks, the stall watchdog, and
+memory sampling behind a single surface so the training loop takes one
+optional `obs` argument. `NULL_TELEMETRY` is the disabled stand-in (and
+the non-primary-host one): every method is a cheap no-op, so the hot
+loop calls telemetry methods unconditionally instead of branching.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from cyclegan_tpu.obs.jsonl import MetricsLogger, NullMetricsLogger
+from cyclegan_tpu.obs.manifest import build_manifest
+from cyclegan_tpu.obs.memory import memory_watermarks
+from cyclegan_tpu.obs.stepclock import NullStepClock, StepClock
+from cyclegan_tpu.obs.watchdog import StallWatchdog
+
+
+class Telemetry:
+    def __init__(
+        self,
+        logger: MetricsLogger,
+        step_log_every: int = 1,
+        watchdog: Optional[StallWatchdog] = None,
+    ):
+        self.logger = logger
+        self.step_log_every = step_log_every
+        self.watchdog = watchdog
+        self._clock: Optional[StepClock] = None
+        if watchdog is not None:
+            watchdog.start()
+
+    @property
+    def enabled(self) -> bool:
+        return True
+
+    def manifest(self, config=None, plan=None, **extra) -> None:
+        self.logger.event(
+            "manifest", **build_manifest(config, plan=plan, **extra)
+        )
+
+    def step_clock(self, epoch: int, split: str = "train") -> StepClock:
+        """A fresh clock for one (epoch, split) pass, heartbeating the
+        watchdog and exposing its pending depth to it."""
+        beat = self.watchdog.beat if self.watchdog is not None else None
+        clock = StepClock(
+            self.logger, epoch, split=split,
+            log_every=self.step_log_every, heartbeat=beat,
+        )
+        self._clock = clock
+        if self.watchdog is not None:
+            self.watchdog.set_depth_fn(lambda: clock.depth)
+        return clock
+
+    def event(self, kind: str, /, **fields) -> None:
+        self.logger.event(kind, **fields)
+
+    def epoch(self, epoch: int, **fields) -> None:
+        """Per-epoch rollup: throughput, utilization, eval metrics."""
+        self.logger.event("epoch", epoch=epoch, **fields)
+
+    def memory(self, epoch: int) -> None:
+        self.logger.event("memory", epoch=epoch, **memory_watermarks())
+
+    def flush(self) -> None:
+        self.logger.flush()
+
+    def close(self, status: str = "completed") -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if not self.logger.closed:
+            self.logger.event("end", status=status)
+            self.logger.close()
+
+
+class NullTelemetry(Telemetry):
+    def __init__(self):
+        self.logger = NullMetricsLogger()
+        self.step_log_every = 0
+        self.watchdog = None
+        self._clock = None
+
+    @property
+    def enabled(self) -> bool:
+        return False
+
+    def manifest(self, config=None, plan=None, **extra):
+        pass
+
+    def step_clock(self, epoch, split="train"):
+        return NullStepClock()
+
+    def event(self, kind, /, **fields):
+        pass
+
+    def epoch(self, epoch, **fields):
+        pass
+
+    def memory(self, epoch):
+        pass
+
+    def flush(self):
+        pass
+
+    def close(self, status="completed"):
+        pass
+
+
+NULL_TELEMETRY = NullTelemetry()
+
+
+def make_telemetry(obs_config, output_dir: str, primary: bool = True) -> Telemetry:
+    """Build run telemetry from the config's `obs` section.
+
+    Disabled (NULL_TELEMETRY) when `obs.enabled` is false, when the
+    jsonl path resolves empty, or on non-primary hosts — every process
+    still runs the same loop (no collective divergence: telemetry is
+    all host-local), only host 0 writes the stream.
+    """
+    import os
+
+    if not primary or not getattr(obs_config, "enabled", True):
+        return NULL_TELEMETRY
+    path = getattr(obs_config, "jsonl_path", None)
+    if path is None:
+        path = os.path.join(output_dir, "telemetry.jsonl")
+    if not path or path in ("none", "off"):
+        return NULL_TELEMETRY
+    logger = MetricsLogger(path)
+    deadline = float(getattr(obs_config, "watchdog_deadline_s", 0.0) or 0.0)
+    watchdog = StallWatchdog(logger, deadline) if deadline > 0 else None
+    return Telemetry(
+        logger,
+        step_log_every=int(getattr(obs_config, "step_log_every", 1)),
+        watchdog=watchdog,
+    )

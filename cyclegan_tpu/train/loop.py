@@ -13,6 +13,7 @@ values under the right labels.
 from __future__ import annotations
 
 import time
+from time import perf_counter
 from typing import Callable, Dict
 
 import jax
@@ -20,6 +21,7 @@ import numpy as np
 
 from cyclegan_tpu.config import Config
 from cyclegan_tpu.data.pipeline import CycleGANData
+from cyclegan_tpu.obs.telemetry import NULL_TELEMETRY
 from cyclegan_tpu.parallel.mesh import MeshPlan
 from cyclegan_tpu.parallel.dp import shard_batch, shard_stacked_batch
 from cyclegan_tpu.train.state import CycleGANState
@@ -105,9 +107,13 @@ def train_epoch(
     epoch: int,
     tracer=None,
     multi_step_fn: Callable = None,
+    obs=None,
 ) -> CycleGANState:
     """One training pass (reference main.py:332-341). `tracer` is an
     optional utils.profiler.TraceCapture stepped once per train step.
+    `obs` is an optional obs.Telemetry; its StepClock timestamps the
+    staging/dispatch/deferred-fetch path WITHOUT adding any host-device
+    sync (obs/stepclock.py — enforced by tools/check_no_sync.py).
 
     With steps_per_dispatch K > 1 (`multi_step_fn` from
     shard_multi_train_step), K full batches at a time run as one fused
@@ -126,6 +132,7 @@ def train_epoch(
     """
     k = config.train.steps_per_dispatch
     accum = config.train.grad_accum
+    clock = (obs or NULL_TELEMETRY).step_clock(epoch, split="train")
     # Deferred metric fetch: device_get per step would SYNC the host to
     # every step, serializing dispatch. Holding the (tiny scalar) device
     # arrays and fetching later keeps the dispatch pipeline async — the
@@ -145,7 +152,14 @@ def train_epoch(
         pinned = steps if pinned is None else pinned
         pending.append((metrics, steps, pinned))
         while sum(p for _, _, p in pending) > max(MAX_IN_FLIGHT, pinned):
-            fetched.append(jax.device_get(pending.pop(0)))
+            # Telemetry rides the fetch the loop performs anyway: the
+            # blocked time IS device-completion attribution (metrics
+            # data-depend on their step), no sync is added.
+            oldest = pending.pop(0)
+            t_fetch = perf_counter()
+            fetched.append(jax.device_get(oldest))  # sanctioned-fetch: bounded backpressure window
+            clock.fetched(perf_counter() - t_fetch,
+                          steps=oldest[1], pinned=oldest[2])
 
     multi = multi_step_fn is not None and k > 1
     staged = _staged_batches(config, data, plan, epoch, multi)
@@ -176,24 +190,34 @@ def train_epoch(
         # no-op once stopped/disabled.)
         if tracer is not None and depth == 0:
             tracer.step()
+        # stage window: host prep + device_put at depth 0, queue wait
+        # under prefetch — either way, time the device had no next batch.
+        clock.stage_begin()
         try:
             kind, (xs, ys, ws) = next(it)
         except StopIteration:
             break
+        clock.staged()
         if tracer is not None and depth > 0:
             tracer.step()
         if kind == "multi":
             state, metrics = multi_step_fn(state, xs, ys, ws)
+            clock.dispatched(steps=k, kind="multi")
             append_metrics(metrics, steps=k)
         elif kind == "accum":
             state, metrics = step_fn(state, xs, ys, ws)
+            clock.dispatched(steps=1, pinned=accum, kind="accum")
             append_metrics(metrics, pinned=accum)
         else:
             state, metrics = step_fn(state, xs, ys, ws)
+            clock.dispatched(kind="single")
             append_metrics(metrics)
 
+    t_drain = perf_counter()
+    tail = jax.device_get(pending)  # sanctioned-fetch: end-of-epoch drain
+    clock.drained(perf_counter() - t_drain, n_entries=len(pending))
     results: Dict[str, list] = {}
-    for metrics, steps, _ in fetched + jax.device_get(pending):
+    for metrics, steps, _ in fetched + tail:
         if steps == 1:
             append_dict(results, metrics)
         else:
@@ -201,6 +225,7 @@ def train_epoch(
                 append_dict(results, {key: v[i] for key, v in metrics.items()})
     for key, value in mean_dict(results).items():
         summary.scalar(key, value, step=epoch, training=True)
+    clock.finish()
     return state
 
 
@@ -212,35 +237,56 @@ def test_epoch(
     state: CycleGANState,
     summary: Summary,
     epoch: int,
+    obs=None,
 ) -> Dict[str, float]:
     """One eval pass (reference main.py:344-355). Metric fetches defer
     to the end of the pass (same async-dispatch rationale as
-    train_epoch)."""
+    train_epoch); the StepClock hooks mirror train_epoch's."""
+    clock = (obs or NULL_TELEMETRY).step_clock(epoch, split="test")
     pending: list = []
     fetched: list = []
-    it = _progress(data.test_epoch(), data.test_steps, "Test", config.train.verbose)
-    for x, y, w in it:
+    it = iter(_progress(data.test_epoch(), data.test_steps, "Test",
+                        config.train.verbose))
+    while True:
+        clock.stage_begin()
+        try:
+            x, y, w = next(it)
+        except StopIteration:
+            break
         xs, ys, ws = shard_batch(plan, x, y, w)
+        clock.staged()
         pending.append(step_fn(state, xs, ys, ws))
+        clock.dispatched()
         if len(pending) > MAX_IN_FLIGHT:
-            fetched.append(jax.device_get(pending.pop(0)))
+            t_fetch = perf_counter()
+            fetched.append(jax.device_get(pending.pop(0)))  # sanctioned-fetch: bounded backpressure window
+            clock.fetched(perf_counter() - t_fetch)
+    t_drain = perf_counter()
+    tail = jax.device_get(pending)  # sanctioned-fetch: end-of-pass drain
+    clock.drained(perf_counter() - t_drain, n_entries=len(pending))
     results: Dict[str, list] = {}
-    for metrics in fetched + jax.device_get(pending):
+    for metrics in fetched + tail:
         append_dict(results, metrics)
     means = mean_dict(results)
     for key, value in means.items():
         summary.scalar(key, value, step=epoch, training=False)
+    clock.finish()
     return means
 
 
 def print_epoch_summary(results: Dict[str, float], elapse: float) -> None:
     """Console summary of the four error metrics (main.py:394-398,
-    with the swapped-label bug fixed)."""
+    with the swapped-label bug fixed). Missing keys print as nan
+    instead of raising — a test epoch can produce no results (empty
+    test split, preempted pass)."""
+    def _get(key: str) -> float:
+        return results.get(key, float("nan"))
+
     print(
-        f'MAE(X, F(G(X))): {results["error/MAE(X, F(G(X)))"]:.04f}\t\t'
-        f'MAE(X, F(X)): {results["error/MAE(X, F(X))"]:.04f}\n'
-        f'MAE(Y, G(F(Y))): {results["error/MAE(Y, G(F(Y)))"]:.04f}\t\t'
-        f'MAE(Y, G(Y)): {results["error/MAE(Y, G(Y))"]:.04f}\n'
+        f'MAE(X, F(G(X))): {_get("error/MAE(X, F(G(X)))"):.04f}\t\t'
+        f'MAE(X, F(X)): {_get("error/MAE(X, F(X))"):.04f}\n'
+        f'MAE(Y, G(F(Y))): {_get("error/MAE(Y, G(F(Y)))"):.04f}\t\t'
+        f'MAE(Y, G(Y)): {_get("error/MAE(Y, G(Y))"):.04f}\n'
         f'Elapse: {elapse:.02f}s\n'
     )
 

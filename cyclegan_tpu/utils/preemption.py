@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import signal
 from types import FrameType
-from typing import Iterable, Optional
+from typing import Callable, Iterable, Optional
 
 from cyclegan_tpu.utils import distributed
 
@@ -26,17 +26,42 @@ from cyclegan_tpu.utils import distributed
 class PreemptionGuard:
     """Installs handlers for `signals` (default SIGTERM) that record a
     stop request; `should_stop()` is the cross-host epoch-boundary check.
+
+    `on_signal` callbacks run INSIDE the handler, right after the stop
+    flag is set — the hook for flushing buffered observability data
+    (TensorBoard writers, the obs JSONL stream) the moment the SIGTERM
+    lands, so even a grace window that expires before the epoch-boundary
+    checkpoint loses nothing already recorded. Callbacks must be
+    async-signal tolerant: flush-style operations that only push
+    already-buffered bytes (reentrancy-safe via RLocks), never anything
+    that dispatches device work or blocks indefinitely. Exceptions are
+    swallowed — a broken callback must not break the shutdown path.
     """
 
-    def __init__(self, signals: Iterable[int] = (signal.SIGTERM,), install: bool = True):
+    def __init__(
+        self,
+        signals: Iterable[int] = (signal.SIGTERM,),
+        install: bool = True,
+        on_signal: Iterable[Callable[[], None]] = (),
+    ):
         self._requested = False
         self._prev = {}
+        self._callbacks = list(on_signal)
         if install:
             for sig in signals:
                 self._prev[sig] = signal.signal(sig, self._handle)
 
+    def add_callback(self, fn: Callable[[], None]) -> None:
+        """Register another on-signal flush hook."""
+        self._callbacks.append(fn)
+
     def _handle(self, signum: int, frame: Optional[FrameType]) -> None:
         self._requested = True
+        for fn in self._callbacks:
+            try:
+                fn()
+            except Exception:
+                pass
 
     def request_stop(self) -> None:
         """Programmatic stop request (used by tests and host callers)."""

@@ -92,6 +92,17 @@ class Summary:
             fig.tight_layout()
             self.figure(f"{tag}/{i}", fig, step=step, training=training)
 
+    def flush(self) -> None:
+        """Push buffered events to disk without closing. Called from the
+        preemption signal handler (utils/preemption.py) so a SIGTERM'd
+        run whose grace window expires mid-epoch still keeps every
+        event written so far."""
+        for w in self._writers:
+            try:
+                w.flush()
+            except Exception:
+                pass  # flushing must never turn a shutdown into a crash
+
     def close(self) -> None:
         for w in self._writers:
             w.close()

@@ -38,6 +38,7 @@ def main(args: argparse.Namespace) -> None:
         Config,
         DataConfig,
         ModelConfig,
+        ObsConfig,
         ParallelConfig,
         TrainConfig,
     )
@@ -102,6 +103,13 @@ def main(args: argparse.Namespace) -> None:
             prefetch_batches=args.prefetch_batches,
             grad_accum=args.grad_accum,
         ),
+        obs=ObsConfig(
+            enabled=not args.no_obs,
+            jsonl_path=args.obs_jsonl,
+            watchdog_deadline_s=args.watchdog_deadline,
+            step_log_every=args.obs_step_log_every,
+            memory_sample_every=args.obs_memory_every,
+        ),
     )
     if config.train.grad_accum < 1 or config.train.steps_per_dispatch < 1:
         raise SystemExit("--grad_accum and --steps_per_dispatch must be >= 1")
@@ -143,6 +151,14 @@ def main(args: argparse.Namespace) -> None:
     peak_tflops = per_chip * plan.n_devices if per_chip else None
 
     summary = make_summary(config.train.output_dir, primary)
+    # Run telemetry (cyclegan_tpu/obs): append-only JSONL event stream
+    # next to the TensorBoard writers — manifest at startup, per-dispatch
+    # timing from inside the loop, per-epoch throughput/MFU, HBM
+    # watermarks, stall watchdog. Host-local only, so the non-primary
+    # Null variant cannot skew collectives.
+    from cyclegan_tpu.obs import make_telemetry
+
+    tele = make_telemetry(config.obs, config.train.output_dir, primary)
     # Test/FID forwards have no microbatching, so they run at the real
     # per-dispatch batch (the training microbatch) — under --grad_accum
     # the effective train batch would OOM exactly the configs
@@ -153,6 +169,23 @@ def main(args: argparse.Namespace) -> None:
         print(f"Dataset {data.source.name}: {data.n_train} train / {data.n_test} test pairs, "
               f"{data.train_steps} train steps, {data.test_steps} test steps per epoch, "
               f"cache {data.cache_nbytes() / 1e6:.0f}MB")
+
+    # First event of the stream: the run manifest (config, mesh shape,
+    # versions, git SHA, host topology) — every JSONL file self-describes.
+    tele.manifest(
+        config,
+        plan=plan,
+        global_batch_size=global_batch_size,
+        flops_per_image=flops_per_image,
+        peak_tflops=peak_tflops,
+        data={
+            "source": data.source.name,
+            "n_train": data.n_train,
+            "n_test": data.n_test,
+            "train_steps": data.train_steps,
+            "test_steps": data.test_steps,
+        },
+    )
 
     state = create_state(config, jax.random.PRNGKey(config.train.seed))
 
@@ -205,10 +238,14 @@ def main(args: argparse.Namespace) -> None:
         )
 
     # Preemption (SIGTERM on TPU maintenance events): finish the epoch,
-    # checkpoint, exit; auto-resume continues from the next epoch.
-    guard = PreemptionGuard()
+    # checkpoint, exit; auto-resume continues from the next epoch. The
+    # on-signal callbacks flush buffered TensorBoard events and the
+    # telemetry tail IN the handler, so even a grace window that expires
+    # mid-epoch loses no already-recorded observability data.
+    guard = PreemptionGuard(on_signal=(summary.flush, tele.flush))
     tracer = maybe_trace(config.train.output_dir, args.trace if primary else 0)
 
+    run_status = "failed"  # until the epoch loop exits cleanly
     try:
         for epoch in range(start_epoch, config.train.epochs):
             if primary:
@@ -216,9 +253,12 @@ def main(args: argparse.Namespace) -> None:
             start = time()
             state = loop.train_epoch(
                 config, data, plan, train_step, state, summary, epoch,
-                tracer=tracer, multi_step_fn=multi_step,
+                tracer=tracer, multi_step_fn=multi_step, obs=tele,
             )
-            results = loop.test_epoch(config, data, plan, test_step, state, summary, epoch)
+            results = loop.test_epoch(
+                config, data, plan, test_step, state, summary, epoch,
+                obs=tele,
+            )
             elapse = time() - start
             summary.scalar("elapse", elapse, step=epoch)
             ips = loop.images_per_sec(2 * data.n_train, elapse)
@@ -227,15 +267,25 @@ def main(args: argparse.Namespace) -> None:
             # FLOPs (utils/flops.py) x achieved rate, plus MFU when the
             # chip's bf16 peak is known. The epoch window includes the
             # test pass, so this is a conservative lower bound.
-            summary.scalar(
-                "perf/tflops_per_sec", ips * flops_per_image / 1e12, step=epoch
+            tflops = ips * flops_per_image / 1e12
+            mfu = tflops / peak_tflops if peak_tflops else None
+            summary.scalar("perf/tflops_per_sec", tflops, step=epoch)
+            if mfu is not None:
+                summary.scalar("perf/mfu", mfu, step=epoch)
+            # Live utilization in the telemetry stream (mfu is null when
+            # the chip's peak is unknown, e.g. on CPU) + epoch-boundary
+            # HBM watermark sample.
+            tele.epoch(
+                epoch,
+                elapse_s=round(elapse, 4),
+                images_per_sec=round(ips, 4),
+                tflops_per_sec=round(tflops, 6),
+                mfu=round(mfu, 6) if mfu is not None else None,
+                test_metrics={key: float(v) for key, v in results.items()},
             )
-            if peak_tflops:
-                summary.scalar(
-                    "perf/mfu",
-                    ips * flops_per_image / 1e12 / peak_tflops,
-                    step=epoch,
-                )
+            if (config.obs.memory_sample_every > 0
+                    and epoch % config.obs.memory_sample_every == 0):
+                tele.memory(epoch)
             if primary:
                 loop.print_epoch_summary(results, elapse)
 
@@ -263,12 +313,19 @@ def main(args: argparse.Namespace) -> None:
             if preempted:
                 if primary:
                     print("preemption requested: checkpointed, exiting cleanly")
+                run_status = "preempted"
+                tele.event("preempted", epoch=epoch)
                 break
+        else:
+            run_status = "completed"
     finally:
         # Flush the in-flight trace even when an epoch raises — profiling
-        # data from a crashed run is the data you want most.
+        # data from a crashed run is the data you want most. Same for the
+        # telemetry stream: close() writes the `end` event and stops the
+        # watchdog thread.
         tracer.stop()
         summary.close()
+        tele.close(status=run_status)
 
 
 if __name__ == "__main__":
@@ -371,6 +428,31 @@ if __name__ == "__main__":
                         help="InceptionV3 weights file for --fid_features "
                              "auto/inception (without it, auto uses "
                              "random-weight Inception features)")
+    # Observability (cyclegan_tpu/obs — new `obs` config section)
+    parser.add_argument("--obs_jsonl", default=None, metavar="PATH",
+                        help="append-only JSONL telemetry stream "
+                             "(manifest, per-step timing, epoch "
+                             "throughput/MFU, memory watermarks); default "
+                             "<output_dir>/telemetry.jsonl, 'none' "
+                             "disables. Fold into a report with "
+                             "tools/obs_report.py")
+    parser.add_argument("--no_obs", action="store_true",
+                        help="disable the telemetry stream entirely")
+    parser.add_argument("--watchdog_deadline", default=0.0, type=float,
+                        metavar="S",
+                        help="stall watchdog: log a warning event (with "
+                             "pending-dispatch depth) if no step completes "
+                             "within S seconds — catches the hung-device "
+                             "failure mode (docs/TUNNEL_POSTMORTEM.md); "
+                             "0 disables")
+    parser.add_argument("--obs_step_log_every", default=1, type=int,
+                        metavar="N",
+                        help="emit a per-dispatch `step` event every N "
+                             "dispatches (0 = per-epoch aggregates only)")
+    parser.add_argument("--obs_memory_every", default=1, type=int,
+                        metavar="N",
+                        help="sample per-device HBM watermarks every N "
+                             "epochs (0 disables)")
     parser.add_argument("--expect_partial", action="store_true",
                         help="tolerate checkpoint/model mismatches on resume: "
                              "restore matching leaves, keep fresh init for the "

@@ -1,0 +1,469 @@
+"""Telemetry subsystem (cyclegan_tpu/obs): JSONL stream semantics, run
+manifest, stall watchdog, StepClock attribution, preemption-time flush,
+the no-sync static guarantee, and the real-loop integration.
+
+All CPU-runnable tier-1 — the subsystem is host-side by design, so
+nothing here needs a device beyond the suite's virtual CPU mesh.
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+sys.path.insert(0, REPO)
+
+from cyclegan_tpu.config import ObsConfig  # noqa: E402
+from cyclegan_tpu.obs import (  # noqa: E402
+    NULL_TELEMETRY,
+    MetricsLogger,
+    NullMetricsLogger,
+    StallWatchdog,
+    StepClock,
+    build_manifest,
+    make_telemetry,
+    memory_watermarks,
+)
+from cyclegan_tpu.utils.preemption import PreemptionGuard  # noqa: E402
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------- JSONL
+
+
+def test_jsonl_roundtrip_and_incremental_flush(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    log = MetricsLogger(path)
+    log.event("alpha", x=1, name="a")
+    log.event("beta", arr=np.float32(2.5), vec=np.arange(3))
+    log.event("gamma", nested={"k": [1, 2]})
+
+    # No close/flush call: line buffering must already have landed every
+    # completed event (the property that preserves a preempted run's
+    # telemetry).
+    evs = _events(path)
+    assert [e["event"] for e in evs] == ["alpha", "beta", "gamma"]
+    # Envelope: monotonic non-decreasing t offsets.
+    ts = [e["t"] for e in evs]
+    assert ts == sorted(ts) and all(t >= 0 for t in ts)
+    # numpy payloads serialized to JSON natives.
+    assert evs[1]["arr"] == 2.5 and evs[1]["vec"] == [0, 1, 2]
+    assert evs[2]["nested"] == {"k": [1, 2]}
+
+    log.close()
+    log.close()  # idempotent
+    log.event("dropped", x=1)  # post-close events drop, never raise
+    assert len(_events(path)) == 3
+
+
+def test_jsonl_unserializable_payload_is_survivable(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    log = MetricsLogger(path)
+    log.event("weird", obj=object())  # repr-coerced, not an exception
+    log.event("after", ok=True)
+    log.close()
+    evs = _events(path)
+    assert [e["event"] for e in evs] == ["weird", "after"]
+
+
+def test_null_logger_is_silent(tmp_path):
+    log = NullMetricsLogger(str(tmp_path / "never.jsonl"))
+    log.event("x", a=1)
+    log.flush()
+    log.close()
+    assert not os.path.exists(str(tmp_path / "never.jsonl"))
+
+
+# ------------------------------------------------------------- manifest
+
+
+def test_manifest_fields(tiny_config):
+    m = build_manifest(tiny_config)
+    assert m["schema_version"] >= 1
+    assert m["versions"]["jax"] == jax.__version__
+    assert "python" in m["versions"]
+    assert isinstance(m["argv"], list) and m["pid"] == os.getpid()
+    # Full config tree rides along, so the stream reproduces the run.
+    assert m["config"]["data"]["source"] == "synthetic"
+    assert m["config"]["model"]["image_size"] == 32
+    # git SHA is best-effort but this repo IS a checkout.
+    assert m["git_sha"] is None or len(m["git_sha"]) == 40
+    # Device-derived fields (CPU suite: platform cpu).
+    assert m["mesh"]["platform"] == "cpu"
+    assert m["host"]["process_count"] >= 1
+    json.dumps(m)  # the whole manifest is JSON-able
+
+
+def test_manifest_without_device_query(tiny_config):
+    """bench.py's mode: no backend query (a dead TPU transport blocks
+    them), so no mesh/host fields unless a plan provides them."""
+    m = build_manifest(None, query_devices=False, role="bench")
+    assert m["role"] == "bench"
+    assert "mesh" not in m and "host" not in m
+    assert "jax" in m["versions"]
+
+
+# ------------------------------------------------------------- watchdog
+
+
+def test_watchdog_fires_on_stall_and_rearms(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    log = MetricsLogger(path)
+    wd = StallWatchdog(log, deadline_s=0.15, poll_s=0.02,
+                       depth_fn=lambda: 5, echo=False)
+    wd.start()
+    try:
+        time.sleep(0.5)
+        evs = [e for e in _events(path) if e["event"] == "stall"]
+        # Fires once per stall episode, not once per poll.
+        assert len(evs) == 1
+        assert evs[0]["pending_depth"] == 5
+        assert evs[0]["deadline_s"] == 0.15
+        assert evs[0]["age_s"] > 0.15
+
+        wd.beat()  # progress: re-arms
+        time.sleep(0.5)
+        evs = [e for e in _events(path) if e["event"] == "stall"]
+        assert len(evs) == 2  # second episode logged
+    finally:
+        wd.stop()
+        log.close()
+
+
+def test_watchdog_quiet_while_stepping(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    log = MetricsLogger(path)
+    wd = StallWatchdog(log, deadline_s=0.3, poll_s=0.02, echo=False)
+    wd.start()
+    try:
+        for _ in range(10):
+            time.sleep(0.05)
+            wd.beat()
+    finally:
+        wd.stop()
+        log.close()
+    assert [e for e in _events(path) if e["event"] == "stall"] == []
+
+
+def test_watchdog_disabled_at_zero_deadline(tmp_path):
+    log = NullMetricsLogger()
+    wd = StallWatchdog(log, deadline_s=0.0)
+    wd.start()  # must not spawn a thread
+    assert wd._thread is None
+    wd.stop()
+
+
+# ------------------------------------------------------------ StepClock
+
+
+def _scripted_clock(times):
+    """Deterministic replacement for perf_counter."""
+    it = iter(times)
+    return lambda: next(it)
+
+
+def test_stepclock_attribution_and_aggregate(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    log = MetricsLogger(path)
+    beats = []
+    # clock() call sites: __init__, then per iteration stage_begin /
+    # staged / dispatched, and finish.
+    times = [
+        0.0,             # __init__ (t_open)
+        0.0, 1.0, 1.5,   # iter 0: stage 1.0s, dispatch 0.5s
+        2.0, 2.2, 2.7,   # iter 1 (closes iter 0 at wall 2.0): stage .2, disp .5
+        10.0,            # finish (closes iter 1 at wall 8.0)
+    ]
+    clock = StepClock(log, epoch=3, split="train", log_every=1,
+                      heartbeat=lambda: beats.append(1),
+                      clock=_scripted_clock(times))
+
+    clock.stage_begin(); clock.staged()
+    clock.dispatched(steps=2, kind="multi")
+    clock.fetched(0.25, steps=2)
+
+    clock.stage_begin(); clock.staged()
+    clock.dispatched(steps=1, pinned=4, kind="accum")
+
+    agg = clock.finish()
+
+    evs = _events(path)
+    steps = [e for e in evs if e["event"] == "step"]
+    assert len(steps) == 2
+    assert steps[0]["epoch"] == 3 and steps[0]["split"] == "train"
+    assert steps[0]["steps"] == 2 and steps[0]["kind"] == "multi"
+    assert steps[0]["stage_s"] == pytest.approx(1.0)
+    assert steps[0]["dispatch_s"] == pytest.approx(0.5)
+    assert steps[0]["fetch_block_s"] == pytest.approx(0.25)
+    assert steps[0]["wall_s"] == pytest.approx(2.0)  # closed at next begin
+    assert steps[1]["kind"] == "accum"
+
+    assert agg["n_dispatches"] == 2 and agg["n_steps"] == 3
+    assert agg["wall_s"] == pytest.approx(10.0)
+    assert agg["stage_s"] == pytest.approx(1.2)
+    assert agg["dispatch_s"] == pytest.approx(1.0)
+    assert agg["fetch_block_s"] == pytest.approx(0.25)
+    assert agg["starvation_fraction"] == pytest.approx(0.12)
+    assert agg["wall_p50_s"] in (pytest.approx(2.0), pytest.approx(8.0))
+    assert agg["wall_max_s"] == pytest.approx(8.0)
+    assert evs[-1]["event"] == "epoch_steps"
+    # Dispatches and fetches beat the watchdog heartbeat.
+    assert len(beats) >= 3
+    # accum pinned 4 then never fetched: depth drained only by finish...
+    log.close()
+
+
+def test_stepclock_depth_tracks_pinned_batches(tmp_path):
+    log = NullMetricsLogger()
+    clock = StepClock(log, epoch=0)
+    clock.stage_begin(); clock.staged(); clock.dispatched(steps=8, kind="multi")
+    assert clock.depth == 8
+    clock.stage_begin(); clock.staged()
+    clock.dispatched(steps=1, pinned=4, kind="accum")
+    assert clock.depth == 12
+    clock.fetched(0.0, steps=8)
+    assert clock.depth == 4
+    clock.fetched(0.0, steps=1, pinned=4)
+    assert clock.depth == 0
+    clock.drained(0.0)
+    assert clock.depth == 0
+
+
+def test_stepclock_log_every_zero_keeps_only_aggregate(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    log = MetricsLogger(path)
+    clock = StepClock(log, epoch=0, log_every=0)
+    for _ in range(3):
+        clock.stage_begin(); clock.staged(); clock.dispatched()
+    clock.finish()
+    log.close()
+    kinds = [e["event"] for e in _events(path)]
+    assert kinds == ["epoch_steps"]
+
+
+# ----------------------------------------------------- no-sync guarantee
+
+
+def test_hot_path_has_no_sync():
+    """The instrumentation adds no host-device synchronization: the
+    static check over train/loop.py and the whole obs/ package passes
+    (block_until_ready absent, device_get only at sanctioned-fetch
+    sites). This is the tier-1 wiring of tools/check_no_sync.py."""
+    from check_no_sync import run_check
+
+    assert run_check() == []
+
+
+def test_check_no_sync_catches_violations(tmp_path):
+    """The checker actually detects both violation classes (it isn't
+    vacuously green)."""
+    from check_no_sync import check_file
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "x.block_until_ready()\n"
+        "jax.device_get(x)\n"
+        "jax.device_get(y)  # sanctioned-fetch: test\n"
+        "# a comment mentioning block_until_ready is fine\n"
+        's = "block_until_ready in a string is fine"\n'
+    )
+    v = check_file(str(bad), allow_sanctioned=True)
+    assert len(v) == 2  # the real call + the unsanctioned device_get
+    v = check_file(str(bad), allow_sanctioned=False)
+    assert len(v) == 3  # marker comments don't sanction obs/ files
+
+
+# ------------------------------------------------------ memory sampling
+
+
+def test_memory_watermarks_shape():
+    sample = memory_watermarks()
+    assert isinstance(sample["available"], bool)
+    assert len(sample["devices"]) == jax.local_device_count()
+    for row in sample["devices"]:
+        assert "id" in row and "kind" in row
+    json.dumps(sample)
+
+
+# ------------------------------------------------- preemption-time flush
+
+
+def test_preemption_guard_runs_flush_callbacks(tmp_path):
+    calls = []
+
+    def boom():
+        raise RuntimeError("broken callback must not break shutdown")
+
+    guard = PreemptionGuard(signals=(signal.SIGUSR1,),
+                            on_signal=(boom, lambda: calls.append("a")))
+    guard.add_callback(lambda: calls.append("b"))
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        time.sleep(0.05)
+        assert guard.requested_locally
+        assert calls == ["a", "b"]
+    finally:
+        guard.uninstall()
+
+
+def test_preemption_flushes_jsonl_tail(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    log = MetricsLogger(path)
+    guard = PreemptionGuard(signals=(signal.SIGUSR1,), on_signal=(log.flush,))
+    try:
+        log.event("before_sigterm", x=1)
+        os.kill(os.getpid(), signal.SIGUSR1)
+        time.sleep(0.05)
+        # Every event written before the signal is on disk afterwards.
+        assert [e["event"] for e in _events(path)] == ["before_sigterm"]
+    finally:
+        guard.uninstall()
+        log.close()
+
+
+# ------------------------------------------------------ telemetry bundle
+
+
+def test_make_telemetry_disabled_paths(tmp_path):
+    out = str(tmp_path)
+    assert make_telemetry(ObsConfig(enabled=False), out) is NULL_TELEMETRY
+    assert make_telemetry(ObsConfig(), out, primary=False) is NULL_TELEMETRY
+    assert make_telemetry(ObsConfig(jsonl_path="none"), out) is NULL_TELEMETRY
+    # The null bundle's clock has the full no-op surface.
+    clock = NULL_TELEMETRY.step_clock(0)
+    clock.stage_begin(); clock.staged(); clock.dispatched()
+    clock.fetched(0.0); clock.drained(0.0)
+    assert clock.finish() == {}
+    NULL_TELEMETRY.manifest(None)
+    NULL_TELEMETRY.epoch(0, images_per_sec=1.0)
+    NULL_TELEMETRY.memory(0)
+    NULL_TELEMETRY.close()
+
+
+def test_make_telemetry_default_path_and_watchdog(tmp_path):
+    out = str(tmp_path / "run")
+    cfg = ObsConfig(watchdog_deadline_s=30.0)
+    tele = make_telemetry(cfg, out)
+    try:
+        assert tele.enabled
+        assert tele.logger.path == os.path.join(out, "telemetry.jsonl")
+        assert tele.watchdog is not None
+        assert tele.watchdog.deadline_s == 30.0
+        clock = tele.step_clock(0)
+        clock.stage_begin(); clock.staged(); clock.dispatched()
+        # The clock's depth feeds the watchdog's stall diagnostics.
+        assert tele.watchdog._depth_fn() == 1
+    finally:
+        tele.close()
+    evs = _events(tele.logger.path)
+    assert evs[-1]["event"] == "end" and evs[-1]["status"] == "completed"
+
+
+# ------------------------------------------------------ loop integration
+
+
+def test_train_and_test_epoch_emit_stream(tiny_config, devices, tmp_path):
+    """The real loop, instrumented: one train + one test pass over the
+    synthetic dataset write step, epoch_steps, epoch, and memory events
+    — and the run report folds them without error."""
+    from cyclegan_tpu.data import build_data
+    from cyclegan_tpu.parallel import make_mesh_plan, shard_test_step, shard_train_step
+    from cyclegan_tpu.parallel.mesh import replicated
+    from cyclegan_tpu.train import create_state, make_test_step, make_train_step
+    from cyclegan_tpu.train import loop
+    from cyclegan_tpu.utils.summary import NullSummary
+
+    config = tiny_config
+    plan = make_mesh_plan(config.parallel, devices[:4])
+    gb = 4
+    data = build_data(config, gb)
+    state = jax.device_put(create_state(config, jax.random.PRNGKey(0)),
+                           replicated(plan))
+    train_step = shard_train_step(plan, make_train_step(config, gb))
+    test_step = shard_test_step(plan, make_test_step(config, gb))
+    summary = NullSummary()
+
+    path = str(tmp_path / "telemetry.jsonl")
+    tele = make_telemetry(ObsConfig(jsonl_path=path), str(tmp_path))
+    tele.manifest(config, plan=plan)
+    state = loop.train_epoch(config, data, plan, train_step, state, summary,
+                             epoch=0, obs=tele)
+    results = loop.test_epoch(config, data, plan, test_step, state, summary,
+                              epoch=0, obs=tele)
+    tele.epoch(0, elapse_s=1.0, images_per_sec=16.0,
+               tflops_per_sec=0.001, mfu=None,
+               test_metrics={k: float(v) for k, v in results.items()})
+    tele.memory(0)
+    tele.close()
+
+    evs = _events(path)
+    kinds = [e["event"] for e in evs]
+    assert kinds[0] == "manifest" and kinds[-1] == "end"
+    train_steps = [e for e in evs
+                   if e["event"] == "step" and e["split"] == "train"]
+    test_steps = [e for e in evs
+                  if e["event"] == "step" and e["split"] == "test"]
+    assert len(train_steps) == data.train_steps
+    assert len(test_steps) == data.test_steps
+    aggs = {(e["split"]): e for e in evs if e["event"] == "epoch_steps"}
+    assert aggs["train"]["n_steps"] == data.train_steps
+    assert aggs["test"]["n_dispatches"] == data.test_steps
+    assert 0.0 <= aggs["train"]["starvation_fraction"] <= 1.0
+    epoch_evs = [e for e in evs if e["event"] == "epoch"]
+    assert epoch_evs and epoch_evs[0]["images_per_sec"] == 16.0
+    assert "mfu" in epoch_evs[0]  # present even when unknown (null)
+    assert any(e["event"] == "memory" for e in evs)
+
+    # The report tool folds the real stream.
+    from obs_report import fold, load_events, render
+
+    events, skipped = load_events(path)
+    assert skipped == 0
+    text = render(fold(events, skipped))
+    assert "starvation fraction" in text
+    assert "run end: completed" in text
+
+
+def test_train_epoch_without_obs_is_unchanged(tiny_config, devices):
+    """obs=None (every existing caller): the loop still runs — the
+    telemetry argument is strictly additive."""
+    from cyclegan_tpu.data import build_data
+    from cyclegan_tpu.parallel import make_mesh_plan, shard_train_step
+    from cyclegan_tpu.parallel.mesh import replicated
+    from cyclegan_tpu.train import create_state, make_train_step
+    from cyclegan_tpu.train import loop
+    from cyclegan_tpu.utils.summary import NullSummary
+
+    config = tiny_config
+    plan = make_mesh_plan(config.parallel, devices[:4])
+    data = build_data(config, 4)
+    state = jax.device_put(create_state(config, jax.random.PRNGKey(0)),
+                           replicated(plan))
+    step = shard_train_step(plan, make_train_step(config, 4))
+    loop.train_epoch(config, data, plan, step, state, NullSummary(), epoch=0)
+
+
+def test_print_epoch_summary_tolerates_missing_keys(capsys):
+    from cyclegan_tpu.train import loop
+
+    # A test epoch that produced no results must not raise KeyError.
+    loop.print_epoch_summary({}, elapse=1.5)
+    out = capsys.readouterr().out
+    assert "nan" in out and "Elapse: 1.50s" in out
+
+    loop.print_epoch_summary(
+        {"error/MAE(X, F(G(X)))": 0.25}, elapse=2.0)
+    out = capsys.readouterr().out
+    assert "0.2500" in out

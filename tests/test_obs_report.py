@@ -1,0 +1,203 @@
+"""tools/obs_report.py: folding and rendering telemetry JSONL streams.
+
+Pure host-side — no jax needed by the tool itself (it must render
+streams on machines without jax), so these tests exercise it on
+synthetic streams written as plain text.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from obs_report import _percentile, fold, load_events, render  # noqa: E402
+
+
+def _write_stream(path, events):
+    with open(path, "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+
+
+def _synthetic_events():
+    """A plausible 2-epoch training stream."""
+    evs = [
+        {"event": "manifest", "t": 0.0, "schema_version": 1,
+         "hostname": "tpu-host", "pid": 42, "git_sha": "a" * 40,
+         "versions": {"python": "3.10.0", "jax": "0.4.37",
+                      "jaxlib": "0.4.36"},
+         "mesh": {"n_devices": 8, "n_data": 8, "n_spatial": 1,
+                  "platform": "tpu", "device_kind": "TPU v4"},
+         "host": {"process_index": 0, "process_count": 2,
+                  "local_device_count": 4}},
+    ]
+    t = 1.0
+    for epoch in range(2):
+        for i in range(10):
+            evs.append({"event": "step", "t": t, "split": "train",
+                        "epoch": epoch, "dispatch": i, "steps": 1,
+                        "kind": "single", "stage_s": 0.01,
+                        "dispatch_s": 0.002, "fetch_block_s": 0.08,
+                        "depth": 1, "wall_s": 0.1})
+            t += 0.1
+        evs.append({"event": "epoch_steps", "t": t, "split": "train",
+                    "epoch": epoch, "n_dispatches": 10, "n_steps": 10,
+                    "wall_s": 1.0, "stage_s": 0.1, "dispatch_s": 0.02,
+                    "fetch_block_s": 0.8, "drain_s": 0.05,
+                    "starvation_fraction": 0.1, "wall_p50_s": 0.1,
+                    "wall_p90_s": 0.1, "wall_max_s": 0.1})
+        evs.append({"event": "epoch", "t": t, "epoch": epoch,
+                    "elapse_s": 1.0, "images_per_sec": 80.0,
+                    "tflops_per_sec": 5.0, "mfu": 0.3 + 0.1 * epoch})
+        evs.append({"event": "memory", "t": t, "epoch": epoch,
+                    "available": True, "devices": [
+                        {"id": 0, "kind": "TPU v4",
+                         "bytes_in_use": 1 << 30,
+                         "peak_bytes_in_use": (2 + epoch) << 30,
+                         "bytes_limit": 8 << 30}]})
+        t += 0.5
+    evs.append({"event": "stall", "t": t, "age_s": 65.0,
+                "deadline_s": 60.0, "pending_depth": 32})
+    evs.append({"event": "end", "t": t + 1, "status": "completed"})
+    return evs
+
+
+def test_fold_synthetic_stream(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    _write_stream(path, _synthetic_events())
+    events, skipped = load_events(path)
+    assert skipped == 0
+    rep = fold(events, skipped)
+
+    assert rep["manifest"]["hostname"] == "tpu-host"
+    assert len(rep["epochs"]) == 2
+    assert len(rep["epoch_steps"]) == 2
+    assert len(rep["steps"]["train"]) == 20
+    # Derived rollups.
+    assert rep["train_starvation_fraction"] == pytest.approx(0.1)
+    assert rep["mfu_trajectory"] == [(0, pytest.approx(0.3)),
+                                     (1, pytest.approx(0.4))]
+    # Memory peak is the max across samples (epoch 1's 3GB beats 2GB).
+    assert rep["memory_peaks"][0]["peak_bytes_in_use"] == 3 << 30
+    assert len(rep["stalls"]) == 1
+    assert rep["end"]["status"] == "completed"
+
+
+def test_render_synthetic_stream(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    _write_stream(path, _synthetic_events())
+    events, skipped = load_events(path)
+    text = render(fold(events, skipped))
+
+    assert "tpu-host" in text
+    assert "jax 0.4.37" in text
+    assert "8 devices" in text and "platform tpu" in text
+    assert "starvation fraction" in text
+    assert "0.3000" in text and "0.4000" in text  # MFU column
+    assert "peak 3.0GB of 8.0GB" in text
+    assert "headroom 5.0GB" in text
+    assert "pending depth 32" in text
+    assert "run end: completed" in text
+
+
+def test_tolerates_garbage_and_truncation(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    evs = _synthetic_events()
+    with open(path, "w") as f:
+        f.write("not json at all\n")
+        f.write(json.dumps(evs[0]) + "\n")
+        f.write(json.dumps({"no_event_key": 1}) + "\n")
+        f.write(json.dumps({"event": "from_the_future", "t": 9.9,
+                            "payload": [1, 2]}) + "\n")
+        # A SIGKILLed run legally truncates its last line mid-write.
+        f.write(json.dumps(evs[1])[: len(json.dumps(evs[1])) // 2])
+    events, skipped = load_events(path)
+    assert skipped == 3  # garbage + missing-event-key + truncated tail
+    rep = fold(events, skipped)
+    assert rep["manifest"] is not None
+    text = render(rep)
+    assert "skipped 3 malformed/truncated lines" in text
+    # No end event: the report must say so, not crash.
+    assert "NO end event" in text
+
+
+def test_empty_and_partial_streams(tmp_path):
+    path = str(tmp_path / "empty.jsonl")
+    open(path, "w").close()
+    events, skipped = load_events(path)
+    rep = fold(events, skipped)
+    text = render(rep)
+    assert "manifest: MISSING" in text
+    assert "stalls: none" in text
+
+    # Steps only — no manifest, no epoch events (a crashed first epoch).
+    path2 = str(tmp_path / "partial.jsonl")
+    _write_stream(path2, [
+        {"event": "step", "t": 0.1, "split": "train", "epoch": 0,
+         "wall_s": 0.2, "stage_s": 0.05},
+    ])
+    events, skipped = load_events(path2)
+    text = render(fold(events, skipped))
+    assert "per-dispatch" in text
+
+
+def test_bench_stream_sections(tmp_path):
+    path = str(tmp_path / "bench.jsonl")
+    _write_stream(path, [
+        {"event": "manifest", "t": 0.0, "role": "bench",
+         "versions": {"python": "3.10.0"}},
+        {"event": "bench", "t": 10.0, "key": "baseline",
+         "images_per_sec": 90.5, "platform": "tpu", "spent_s": 9.8},
+        {"event": "bench_error", "t": 12.0, "key": "broken",
+         "error": "boom"},
+        {"event": "bench", "t": 20.0, "key": "fused_k8",
+         "images_per_sec": 140.2, "platform": "tpu", "spent_s": 9.9},
+        {"event": "bench_summary", "t": 21.0, "value": 140.2,
+         "unit": "images/sec", "config": "fused_k8", "platform": "tpu",
+         "mfu": 0.41},
+        {"event": "end", "t": 21.1, "status": "completed"},
+    ])
+    events, skipped = load_events(path)
+    rep = fold(events, skipped)
+    assert [b["key"] for b in rep["bench"]] == ["baseline", "fused_k8"]
+    assert rep["bench_summary"]["value"] == 140.2
+    text = render(rep)
+    assert "baseline: 90.50 images/sec" in text
+    assert "bench headline: 140.20 images/sec (fused_k8" in text
+    assert "mfu 0.4100" in text
+
+
+def test_percentile_nearest_rank():
+    assert _percentile([], 0.5) != _percentile([], 0.5)  # nan
+    assert _percentile([3.0], 0.99) == 3.0
+    vals = [float(i) for i in range(1, 11)]
+    assert _percentile(vals, 0.0) == 1.0
+    assert _percentile(vals, 1.0) == 10.0
+    assert _percentile(vals, 0.5) in (5.0, 6.0)
+
+
+def test_cli_text_and_json(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    _write_stream(path, _synthetic_events())
+    tool = os.path.join(REPO, "tools", "obs_report.py")
+
+    out = subprocess.run([sys.executable, tool, path],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "telemetry run report" in out.stdout
+
+    out = subprocess.run([sys.executable, tool, path, "--json"],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["n_events"] == len(_synthetic_events())
+
+    out = subprocess.run([sys.executable, tool,
+                          str(tmp_path / "missing.jsonl")],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 2

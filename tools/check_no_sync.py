@@ -1,0 +1,125 @@
+"""Static no-sync check for the training hot path.
+
+    python tools/check_no_sync.py          # exit 1 on any violation
+
+The dispatch loop's whole performance story rests on staying
+asynchronous (train/loop.py: deferred metric fetch, bounded
+backpressure). The telemetry subsystem (cyclegan_tpu/obs) instruments
+that loop and must never re-serialize it, so this check enforces two
+rules over the hot-path files:
+
+1. `block_until_ready` is forbidden everywhere in them. It is both a
+   sync AND a lie through the remote-TPU tunnel (returns at
+   dispatch-complete — docs/TPU_RUNBOOK.md ground rule 4).
+2. `device_get` is forbidden except on lines carrying a
+   `sanctioned-fetch` marker comment — the deferred fetches the loop's
+   design already requires (backpressure window, end-of-epoch drain).
+   In `cyclegan_tpu/obs/` there are no sanctioned sites at all:
+   telemetry only timestamps fetches the loop performs.
+
+Comments and docstrings are exempt (they may DISCUSS the forbidden
+calls); only code can violate. Runs in tier-1 via
+tests/test_obs.py::test_hot_path_has_no_sync.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+import tokenize
+from typing import List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FORBIDDEN_ALWAYS = ("block_until_ready",)
+FORBIDDEN_UNSANCTIONED = ("device_get",)
+SANCTION_MARKER = "sanctioned-fetch"
+
+# (path, allow_sanctioned_fetches)
+HOT_PATH_FILES: List[Tuple[str, bool]] = [
+    ("cyclegan_tpu/train/loop.py", True),
+    ("cyclegan_tpu/obs/__init__.py", False),
+    ("cyclegan_tpu/obs/jsonl.py", False),
+    ("cyclegan_tpu/obs/manifest.py", False),
+    ("cyclegan_tpu/obs/memory.py", False),
+    ("cyclegan_tpu/obs/stepclock.py", False),
+    ("cyclegan_tpu/obs/telemetry.py", False),
+    ("cyclegan_tpu/obs/watchdog.py", False),
+]
+
+
+def _code_lines(source: str) -> dict:
+    """line number -> code-only text (comments and string literals,
+    docstrings included, stripped via the tokenizer)."""
+    lines: dict = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type in (tokenize.COMMENT, tokenize.STRING, tokenize.NL,
+                            tokenize.NEWLINE, tokenize.INDENT,
+                            tokenize.DEDENT):
+                continue
+            row = tok.start[0]
+            lines[row] = lines.get(row, "") + " " + tok.string
+    except tokenize.TokenizeError:
+        # Unparseable file: fall back to raw lines (conservative — may
+        # flag mentions inside strings, better than missing real calls).
+        for i, raw in enumerate(source.splitlines(), 1):
+            lines[i] = raw
+    return lines
+
+
+def check_file(path: str, allow_sanctioned: bool) -> List[str]:
+    violations = []
+    with open(path) as f:
+        source = f.read()
+    raw_lines = source.splitlines()
+    for row, code in sorted(_code_lines(source).items()):
+        raw = raw_lines[row - 1] if row <= len(raw_lines) else ""
+        for tok in FORBIDDEN_ALWAYS:
+            if tok in code:
+                violations.append(
+                    f"{path}:{row}: forbidden sync `{tok}` in the hot path"
+                )
+        for tok in FORBIDDEN_UNSANCTIONED:
+            if tok in code:
+                if allow_sanctioned and SANCTION_MARKER in raw:
+                    continue
+                where = ("missing `# sanctioned-fetch` marker"
+                         if allow_sanctioned
+                         else "no sanctioned sites exist in obs/")
+                violations.append(
+                    f"{path}:{row}: `{tok}` outside the sanctioned fetch "
+                    f"window ({where})"
+                )
+    return violations
+
+
+def run_check(repo: str = REPO) -> List[str]:
+    violations: List[str] = []
+    for rel, allow in HOT_PATH_FILES:
+        path = os.path.join(repo, rel)
+        if not os.path.exists(path):
+            violations.append(f"{rel}: hot-path file missing")
+            continue
+        violations.extend(check_file(path, allow))
+    return violations
+
+
+def main() -> int:
+    violations = run_check()
+    if violations:
+        print("no-sync check FAILED:", file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    n = len(HOT_PATH_FILES)
+    print(f"no-sync check passed: {n} hot-path files clean "
+          f"(block_until_ready absent; device_get only at "
+          f"sanctioned-fetch sites)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

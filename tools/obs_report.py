@@ -1,0 +1,279 @@
+"""Fold a telemetry JSONL stream (cyclegan_tpu/obs) into a run report.
+
+    python tools/obs_report.py <run_dir>/telemetry.jsonl
+
+Works on training streams (main.py) and bench streams (BENCH_OBS_JSONL=
+path python bench.py) — one tool for both, because both emit the same
+event schema. Pure stdlib on purpose: the report must render on any box
+the JSONL file lands on, including ones without jax installed.
+
+Robustness contract: unknown event types are ignored (forward
+compatibility), malformed lines are skipped and counted (a preempted or
+SIGKILLed run legally truncates its last line mid-write), and every
+section renders with whatever subset of events exists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def load_events(path: str) -> Tuple[List[dict], int]:
+    """Parse the stream; returns (events, n_skipped_lines)."""
+    events: List[dict] = []
+    skipped = 0
+    with open(path, "r", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                skipped += 1
+                continue
+            if isinstance(rec, dict) and "event" in rec:
+                events.append(rec)
+            else:
+                skipped += 1
+    return events, skipped
+
+
+def _percentile(vals: List[float], q: float) -> float:
+    if not vals:
+        return float("nan")
+    s = sorted(vals)
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[idx]
+
+
+def fold(events: List[dict], skipped: int = 0) -> dict:
+    """Aggregate the event stream into a report structure."""
+    report: dict = {
+        "n_events": len(events),
+        "skipped_lines": skipped,
+        "manifest": None,
+        "epochs": [],        # epoch events in order
+        "epoch_steps": [],   # per-(epoch, split) loop aggregates
+        "steps": {},         # split -> list of per-dispatch wall_s
+        "stage": {},         # split -> list of per-dispatch stage_s
+        "memory": [],        # memory events
+        "stalls": [],
+        "bench": [],
+        "bench_summary": None,
+        "end": None,
+    }
+    for ev in events:
+        kind = ev.get("event")
+        if kind == "manifest" and report["manifest"] is None:
+            report["manifest"] = ev
+        elif kind == "epoch":
+            report["epochs"].append(ev)
+        elif kind == "epoch_steps":
+            report["epoch_steps"].append(ev)
+        elif kind == "step":
+            split = ev.get("split", "train")
+            if "wall_s" in ev:
+                report["steps"].setdefault(split, []).append(float(ev["wall_s"]))
+            if "stage_s" in ev:
+                report["stage"].setdefault(split, []).append(float(ev["stage_s"]))
+        elif kind == "memory":
+            report["memory"].append(ev)
+        elif kind == "stall":
+            report["stalls"].append(ev)
+        elif kind == "bench":
+            report["bench"].append(ev)
+        elif kind == "bench_summary":
+            report["bench_summary"] = ev
+        elif kind == "end":
+            report["end"] = ev
+        # unknown events: ignored by design
+
+    # Derived rollups ----------------------------------------------------
+    train_aggs = [a for a in report["epoch_steps"] if a.get("split") == "train"]
+    if train_aggs:
+        walls = sum(float(a.get("wall_s", 0.0)) for a in train_aggs)
+        stage = sum(float(a.get("stage_s", 0.0)) for a in train_aggs)
+        report["train_starvation_fraction"] = stage / walls if walls > 0 else 0.0
+    report["mfu_trajectory"] = [
+        (ev.get("epoch"), ev.get("mfu")) for ev in report["epochs"]
+    ]
+
+    # Memory: per-device peak over the run + headroom vs bytes_limit.
+    peaks: Dict[int, dict] = {}
+    for ev in report["memory"]:
+        for row in ev.get("devices", []):
+            did = row.get("id")
+            peak = row.get("peak_bytes_in_use", row.get("bytes_in_use"))
+            if did is None or peak is None:
+                continue
+            cur = peaks.setdefault(did, dict(row))
+            if peak >= cur.get("peak_bytes_in_use", cur.get("bytes_in_use", 0)):
+                cur.update(row)
+    report["memory_peaks"] = peaks
+    return report
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "?"
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TB"
+
+
+def _fmt(v, spec: str = ".4f") -> str:
+    if v is None:
+        return "n/a"
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return str(v)
+    if math.isnan(f):
+        return "nan"
+    return format(f, spec)
+
+
+def render(report: dict) -> str:
+    out: List[str] = []
+    w = out.append
+
+    w("=== telemetry run report ===")
+    w(f"events: {report['n_events']}"
+      + (f"  (skipped {report['skipped_lines']} malformed/truncated lines)"
+         if report["skipped_lines"] else ""))
+
+    mani = report["manifest"]
+    if mani:
+        mesh = mani.get("mesh") or {}
+        versions = mani.get("versions") or {}
+        w("-- manifest --")
+        w(f"host: {mani.get('hostname', '?')} pid {mani.get('pid', '?')}"
+          f"  git: {mani.get('git_sha') or 'unknown'}")
+        w(f"versions: python {versions.get('python', '?')}, "
+          f"jax {versions.get('jax', '?')}, jaxlib {versions.get('jaxlib', '?')}")
+        if mesh:
+            w(f"mesh: {mesh.get('n_devices', '?')} devices "
+              f"({mesh.get('n_data', '?')} data x {mesh.get('n_spatial', '?')} "
+              f"spatial), platform {mesh.get('platform', '?')} "
+              f"{mesh.get('device_kind', '')}".rstrip())
+        host = mani.get("host") or {}
+        if host:
+            w(f"processes: {host.get('process_count', 1)} "
+              f"(this stream from index {host.get('process_index', 0)})")
+    else:
+        w("-- manifest: MISSING (stream does not self-describe) --")
+
+    if report["epochs"]:
+        w("-- epochs --")
+        w(f"{'epoch':>5}  {'elapse_s':>9}  {'img/s':>8}  {'TFLOP/s':>8}  {'MFU':>7}")
+        for ev in report["epochs"]:
+            w(f"{ev.get('epoch', '?'):>5}  {_fmt(ev.get('elapse_s'), '.2f'):>9}  "
+              f"{_fmt(ev.get('images_per_sec'), '.2f'):>8}  "
+              f"{_fmt(ev.get('tflops_per_sec'), '.3f'):>8}  "
+              f"{_fmt(ev.get('mfu'), '.4f'):>7}")
+
+    for agg in report["epoch_steps"]:
+        split = agg.get("split", "?")
+        w(f"-- {split} loop, epoch {agg.get('epoch', '?')} --")
+        w(f"dispatches: {agg.get('n_dispatches', '?')} "
+          f"({agg.get('n_steps', '?')} steps), wall {_fmt(agg.get('wall_s'), '.2f')}s")
+        w(f"time split: stage {_fmt(agg.get('stage_s'), '.3f')}s"
+          f" | dispatch {_fmt(agg.get('dispatch_s'), '.3f')}s"
+          f" | fetch-block {_fmt(agg.get('fetch_block_s'), '.3f')}s"
+          f" | drain {_fmt(agg.get('drain_s'), '.3f')}s")
+        w(f"starvation fraction: {_fmt(agg.get('starvation_fraction'))}"
+          "  (loop wall spent waiting on input)")
+        w(f"dispatch interval: p50 {_fmt(agg.get('wall_p50_s'))}s, "
+          f"p90 {_fmt(agg.get('wall_p90_s'))}s, max {_fmt(agg.get('wall_max_s'))}s")
+
+    # Raw per-dispatch percentiles across the whole run (when step
+    # events were kept — obs_step_log_every > 0).
+    for split, walls in sorted(report["steps"].items()):
+        w(f"-- {split} per-dispatch (all epochs, {len(walls)} records) --")
+        w(f"wall: p50 {_fmt(_percentile(walls, .5))}s, "
+          f"p90 {_fmt(_percentile(walls, .9))}s, "
+          f"p99 {_fmt(_percentile(walls, .99))}s, "
+          f"max {_fmt(max(walls))}s")
+
+    if "train_starvation_fraction" in report:
+        w(f"run starvation fraction (train): "
+          f"{_fmt(report['train_starvation_fraction'])}")
+
+    if report["memory"]:
+        w("-- memory watermarks --")
+        if not report["memory_peaks"]:
+            w("allocator stats unavailable on this backend "
+              "(CPU reports none; TPU/GPU report HBM watermarks)")
+        for did, row in sorted(report["memory_peaks"].items()):
+            peak = row.get("peak_bytes_in_use", row.get("bytes_in_use"))
+            limit = row.get("bytes_limit")
+            head = (f", headroom {_fmt_bytes(limit - peak)} "
+                    f"({100 * (1 - peak / limit):.1f}%)"
+                    if limit and peak is not None else "")
+            w(f"device {did} ({row.get('kind', '?')}): "
+              f"peak {_fmt_bytes(peak)} of {_fmt_bytes(limit)}{head}")
+
+    if report["stalls"]:
+        w(f"-- stalls: {len(report['stalls'])} --")
+        for ev in report["stalls"]:
+            w(f"t={_fmt(ev.get('t'), '.1f')}s: no step for "
+              f"{_fmt(ev.get('age_s'), '.1f')}s "
+              f"(deadline {_fmt(ev.get('deadline_s'), '.1f')}s, "
+              f"pending depth {ev.get('pending_depth')})")
+    else:
+        w("stalls: none")
+
+    if report["bench"]:
+        w("-- bench configs --")
+        for ev in report["bench"]:
+            w(f"{ev.get('key', '?')}: {_fmt(ev.get('images_per_sec'), '.2f')} "
+              f"images/sec  [{ev.get('platform', '?')}]")
+    if report["bench_summary"]:
+        bs = report["bench_summary"]
+        w(f"bench headline: {_fmt(bs.get('value'), '.2f')} {bs.get('unit', '')} "
+          f"({bs.get('config', '?')}, platform {bs.get('platform', '?')}"
+          + (f", mfu {_fmt(bs.get('mfu'))}" if bs.get("mfu") is not None else "")
+          + ")")
+
+    end = report["end"]
+    if end:
+        w(f"run end: {end.get('status', '?')} at t={_fmt(end.get('t'), '.1f')}s")
+    else:
+        w("run end: NO end event — stream truncated (crash, SIGKILL, or "
+          "still running)")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("jsonl", help="telemetry stream to fold")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the folded report as JSON instead of text")
+    args = parser.parse_args(argv)
+    try:
+        events, skipped = load_events(args.jsonl)
+    except OSError as e:
+        print(f"cannot read {args.jsonl}: {e}", file=sys.stderr)
+        return 2
+    report = fold(events, skipped)
+    try:
+        if args.json:
+            print(json.dumps(report, indent=2, default=str))
+        else:
+            print(render(report))
+    except BrokenPipeError:
+        # `obs_report.py ... | head` closes our stdout early — that is a
+        # reader's prerogative, not an error.
+        sys.stderr.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
