@@ -609,9 +609,31 @@ def _config_key(c: dict) -> str:
         key += "/pf"
     if c.get("pad_impl", "pad") == "fused":
         key += "/fused"
+    if c.get("pad_impl", "pad") == "epilogue":
+        key += "/epi"
     if c.get("pad_mode", "reflect") == "zero":
         key += "/zero"
     return key
+
+
+def _mosaic_compile_blocked() -> bool:
+    """Whether compiling a Pallas/Mosaic program here would cross the
+    remote-compile leg — tunnel-lethal (docs/TUNNEL_POSTMORTEM.md
+    incident 2; TPU_RUNBOOK ground rule 2b), so epilogue configs are
+    skipped rather than risked. Safe when the effective platform is cpu
+    (interpret mode), when compiles are local
+    (CYCLEGAN_AXON_LOCAL_COMPILE=1 — Mosaic runs against the in-image
+    libtpu), or under the explicit override."""
+    if os.environ.get("CYCLEGAN_ALLOW_PALLAS_REMOTE") == "1":
+        return False
+    from cyclegan_tpu.utils.axon_compat import local_compile_requested
+
+    if local_compile_requested():
+        return False
+    import jax
+
+    effective = str(getattr(jax.config, "jax_platforms", None) or "")
+    return effective.split(",")[0] != "cpu"
 
 
 def _run_configs(results: dict, configs, t_start: float, on_result=None,
@@ -636,6 +658,12 @@ def _run_configs(results: dict, configs, t_start: float, on_result=None,
             on_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
             pad_impl = c.get("pad_impl", "pad")
             pad_mode = c.get("pad_mode", "reflect")
+            if pad_impl == "epilogue" and _mosaic_compile_blocked():
+                print(f"[{tag}] {key}: skipped (Mosaic program; compiles "
+                      "would cross the remote-compile leg — ground rule "
+                      "2b; runs under local-compile windows)",
+                      file=sys.stderr, flush=True)
+                continue
             if mode == "steps":
                 # on_cpu: 2 total steps (~100s each at 256^2) — the CPU
                 # fallback is a liveness signal, not a precision number,
@@ -704,6 +732,13 @@ TPU_CONFIGS = [
     # the headline `value` (non-parity borders) — it must not spend a
     # tight budget ahead of rows that can claim the headline.
     {"mode": "scan", "dtype": "bfloat16", "batch": 16, "pad_mode": "zero"},
+    # The parity pad-gap contender: trunk IN>ReLU>reflect-pad collapsed
+    # into the Pallas epilogue kernel (pad_impl="epilogue"). A Mosaic
+    # program — _run_configs skips it whenever compiling would cross the
+    # remote-compile leg (ground rule 2b); it measures under
+    # local-compile windows and the chip_autorun epilogue_sweep step.
+    {"mode": "scan", "dtype": "bfloat16", "batch": 16,
+     "pad_impl": "epilogue"},
     # one batch-sweep point beyond the headline in the official record
     # (the full sweep lives in docs/bench_sweeps.json)
     {"mode": "scan", "dtype": "bfloat16", "batch": 24},

@@ -58,14 +58,24 @@ class ModelConfig:
     # traffic cost/benefit is quantified by tools/aot_analyze.py
     # (pad-probe jobs) and documented in docs/BENCHMARKS.md.
     pad_mode: str = "reflect"  # "reflect" | "zero"
-    # How pad_mode="reflect" is SCHEDULED (semantics unchanged):
-    # "pad"   = jnp.pad(mode="reflect") + VALID conv — bitwise parity
-    #           baseline, but each site materializes a padded copy;
-    # "fused" = ReflectConv: conv's built-in zero padding + fusible thin
-    #           border-correction convs (ops/padding.py:reflect_conv) —
-    #           same math to fp tolerance, no padded copies. Ignored when
-    #           pad_mode="zero". Param trees are identical either way.
-    pad_impl: str = "pad"  # "pad" | "fused"
+    # How pad_mode="reflect" is SCHEDULED (semantics unchanged; measured
+    # on-chip at 256^2 b16 bf16 — docs/BENCHMARKS.md round 5):
+    # "pad"      = jnp.pad(mode="reflect") + VALID conv — bitwise parity
+    #              baseline (95.33 img/s), but each site materializes a
+    #              padded copy;
+    # "fused"    = ReflectConv: conv's built-in zero padding + fusible
+    #              thin border-correction convs
+    #              (ops/padding.py:reflect_conv) — same math to fp
+    #              tolerance, no padded copies (103.95 img/s, +9.0%);
+    # "epilogue" = "fused" scheduling PLUS the residual-block
+    #              IN>ReLU>reflect-pad chains collapsed into one Pallas
+    #              kernel that writes the padded slab directly
+    #              (ops/pallas/epilogue_kernel.py) — chasing the
+    #              120.05 img/s zero-pad ceiling without giving up
+    #              reflect semantics. Param trees are identical across
+    #              all three (checkpoints interchange). Requires
+    #              pad_mode="reflect" and a Pallas-capable norm impl.
+    pad_impl: str = "pad"  # "pad" | "fused" | "epilogue"
 
     def __post_init__(self):
         # A typo like "Reflect" would otherwise silently select zero/SAME
@@ -81,10 +91,49 @@ class ModelConfig:
                 "instance_norm_impl must be 'auto', 'xla' or 'pallas', "
                 f"got {self.instance_norm_impl!r}"
             )
-        if self.pad_impl not in ("pad", "fused"):
+        if self.pad_impl not in ("pad", "fused", "epilogue"):
             raise ValueError(
-                f"pad_impl must be 'pad' or 'fused', got {self.pad_impl!r}"
+                "pad_impl must be 'pad', 'fused' or 'epilogue', "
+                f"got {self.pad_impl!r}"
             )
+        # Invalid combinations fail HERE, not at trace time (or worse,
+        # silently): "fused"/"epilogue" schedule reflect semantics, so
+        # with pad_mode="zero" there is nothing for them to schedule.
+        if self.pad_mode == "zero" and self.pad_impl != "pad":
+            raise ValueError(
+                f"pad_impl={self.pad_impl!r} requires pad_mode='reflect' "
+                "(it schedules reflect semantics; with pad_mode='zero' "
+                "there is no reflect pad to fuse)"
+            )
+        if self.pad_impl == "epilogue":
+            if self.instance_norm_impl == "xla":
+                raise ValueError(
+                    "pad_impl='epilogue' embeds a Pallas instance norm in "
+                    "the fused IN>ReLU>reflect-pad kernel; "
+                    "instance_norm_impl='xla' contradicts it — use 'auto' "
+                    "(or 'pallas')"
+                )
+            # The epilogue's win lives in the residual trunk; if even the
+            # trunk slab cannot stay VMEM-resident the flag buys nothing
+            # and every site would silently fall back to the XLA
+            # composition — reject at startup with the actual numbers.
+            from cyclegan_tpu.ops.pallas import vmem
+
+            trunk = self.image_size // (
+                2 ** self.generator.num_downsampling_blocks
+            )
+            itemsize = vmem.itemsize_for(self.compute_dtype)
+            if not vmem.epilogue_fits(trunk, trunk, 1, itemsize):
+                raise ValueError(
+                    f"pad_impl='epilogue' is ineligible at image_size="
+                    f"{self.image_size} / compute_dtype="
+                    f"{self.compute_dtype!r}: the residual-trunk slab "
+                    f"({trunk}x{trunk}, "
+                    f"{vmem.epilogue_bytes(trunk, trunk, 1, itemsize)} "
+                    f"resident bytes) exceeds the "
+                    f"{vmem.EPILOGUE_BUDGET_BYTES}-byte VMEM budget — "
+                    "use pad_impl='fused' for this configuration"
+                )
 
     @property
     def input_shape(self) -> Tuple[int, int, int]:

@@ -57,7 +57,12 @@ class ResNetGenerator(nn.Module):
     scan_blocks: bool = False
     norm_impl: str = "auto"
     pad_mode: str = "reflect"  # "zero": conv built-in SAME (same param tree)
-    pad_impl: str = "pad"  # "fused": reflect semantics via ReflectConv
+    # "fused": reflect semantics via ReflectConv; "epilogue": fused
+    # scheduling everywhere PLUS the residual-block / last-upsample
+    # IN>ReLU>reflect-pad chains collapsed into the Pallas epilogue
+    # kernel where VMEM-eligible (ops/pallas/epilogue_kernel.py). All
+    # values share one param tree.
+    pad_impl: str = "pad"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -70,7 +75,8 @@ class ResNetGenerator(nn.Module):
             x = x.astype(self.dtype)
 
         reflect = self.pad_mode == "reflect"
-        fused = reflect and self.pad_impl == "fused"
+        epilogue = reflect and self.pad_impl == "epilogue"
+        fused = reflect and self.pad_impl in ("fused", "epilogue")
 
         def edge_conv(features, use_bias, name):
             return parity_conv(features, pad=3, reflect=reflect, fused=fused,
@@ -125,14 +131,40 @@ class ResNetGenerator(nn.Module):
                     name=f"ResidualBlock_{i}",
                 )(y)
 
-        # Upsampling (model.py:159-161)
-        for _ in range(cfg.num_upsample_blocks):
+        # Upsampling (model.py:159-161). Under pad_impl="epilogue" the
+        # LAST upsample fuses its IN>ReLU tail with the tail conv's
+        # reflect-pad(3) (pad_after) — but only when the full-resolution
+        # output slab is VMEM-eligible (epilogue_eligible; at the
+        # default 256^2 it is not, and the tail keeps the ReflectConv
+        # schedule — the trunk's 9 epilogue sites are the win there).
+        # The branch is shape-dependent, never param-tree-dependent:
+        # both layouts name the norm "InstanceNorm_0" and the tail conv
+        # "Conv_1" with identical shapes.
+        tail_pad_after = 0
+        if epilogue:
+            from cyclegan_tpu.ops.pallas.epilogue_kernel import (
+                epilogue_eligible,
+            )
+
+            out_hw = y.shape[1] * (2 ** cfg.num_upsample_blocks)
+            out_shape = (y.shape[0], out_hw, out_hw, cfg.filters)
+            if epilogue_eligible(out_shape, self.dtype or y.dtype, 3):
+                tail_pad_after = 3
+        for i in range(cfg.num_upsample_blocks):
             filters //= 2
-            y = Upsample(filters, dtype=self.dtype, norm_impl=self.norm_impl)(y)
+            last = i == cfg.num_upsample_blocks - 1
+            y = Upsample(filters, dtype=self.dtype, norm_impl=self.norm_impl,
+                         pad_after=tail_pad_after if last else 0)(y)
 
         # Final block (model.py:164-167): bias on, tanh
-        y = reflect_pad(y, 3) if reflect and not fused else y
-        y = edge_conv(self.out_channels, use_bias=True, name="Conv_1")(y)
+        if tail_pad_after:
+            # input pre-padded by the upsample epilogue: plain VALID conv
+            y = parity_conv(self.out_channels, pad=3, reflect=True,
+                            fused=False, use_bias=True, dtype=self.dtype,
+                            name="Conv_1")(y)
+        else:
+            y = reflect_pad(y, 3) if reflect and not fused else y
+            y = edge_conv(self.out_channels, use_bias=True, name="Conv_1")(y)
         y = jnp.tanh(y)
         return y.astype(in_dtype)
 

@@ -17,7 +17,7 @@ from typing import Any, Callable, Optional, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
-from cyclegan_tpu.ops.norm import instance_norm
+from cyclegan_tpu.ops.norm import instance_norm, instance_norm_relu_pad
 from cyclegan_tpu.ops.padding import reflect_conv, reflect_pad
 
 Dtype = Any
@@ -110,6 +110,32 @@ class InstanceNorm(nn.Module):
         return instance_norm(x, scale, bias, eps=self.eps, impl=self.impl)
 
 
+class FusedNormReluPad(nn.Module):
+    """The residual-block epilogue as ONE op: instance-norm -> ReLU ->
+    reflect-pad(pad), emitting the consumer conv's padded input
+    directly (ops/norm.py:instance_norm_relu_pad — Pallas kernel when
+    the slab is VMEM-eligible, XLA composition otherwise).
+
+    Same "scale"/"bias" param names, shapes, and init as InstanceNorm,
+    so a module given the name the unfused layout auto-assigns
+    ("InstanceNorm_N") keeps the checkpoint tree identical across
+    pad_impl settings.
+    """
+
+    pad: int
+    eps: float = 1e-3
+    impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        ch = x.shape[-1]
+        scale = self.param("scale", init_normal, (ch,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros_init(), (ch,), jnp.float32)
+        return instance_norm_relu_pad(
+            x, scale, bias, pad=self.pad, eps=self.eps, impl=self.impl
+        )
+
+
 class ResidualBlock(nn.Module):
     """reflect-pad(1) > Conv3x3 valid > IN > ReLU > reflect-pad(1) > Conv3x3
     > IN > +skip  (reference model.py:36-74). Filters inferred from input
@@ -120,6 +146,11 @@ class ResidualBlock(nn.Module):
     interchange), different border semantics — the TPU perf option
     (ModelConfig.pad_mode). pad_impl="fused" keeps reflect semantics but
     schedules each site as ReflectConv (no materialized padded copy).
+    pad_impl="epilogue" additionally collapses the middle
+    IN > ReLU > reflect-pad chain into FusedNormReluPad (the Pallas
+    epilogue kernel when VMEM-eligible), so Conv_1 consumes the padded
+    slab directly as a plain VALID conv; the leading pad site stays
+    ReflectConv-scheduled. All three layouts share one param tree.
     """
 
     dtype: Optional[Dtype] = None
@@ -131,7 +162,8 @@ class ResidualBlock(nn.Module):
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         filters = x.shape[-1]
         reflect = self.pad_mode == "reflect"
-        fused = reflect and self.pad_impl == "fused"
+        epilogue = reflect and self.pad_impl == "epilogue"
+        fused = reflect and self.pad_impl in ("fused", "epilogue")
 
         def conv(name: str):
             return parity_conv(filters, pad=1, reflect=reflect, fused=fused,
@@ -139,17 +171,49 @@ class ResidualBlock(nn.Module):
 
         y = reflect_pad(x, 1) if reflect and not fused else x
         y = conv("Conv_0")(y)
-        y = InstanceNorm(impl=self.norm_impl)(y)
-        y = nn.relu(y)
-        y = reflect_pad(y, 1) if reflect and not fused else y
-        y = conv("Conv_1")(y)
-        y = InstanceNorm(impl=self.norm_impl)(y)
+        if epilogue:
+            y = FusedNormReluPad(pad=1, impl=self.norm_impl,
+                                 name="InstanceNorm_0")(y)
+            # Conv_1's input is pre-padded by the epilogue: plain VALID
+            # conv, identical params to the other layouts.
+            y = parity_conv(filters, pad=1, reflect=True, fused=False,
+                            use_bias=False, dtype=self.dtype,
+                            name="Conv_1")(y)
+        else:
+            y = InstanceNorm(impl=self.norm_impl, name="InstanceNorm_0")(y)
+            y = nn.relu(y)
+            y = reflect_pad(y, 1) if reflect and not fused else y
+            y = conv("Conv_1")(y)
+        y = InstanceNorm(impl=self.norm_impl, name="InstanceNorm_1")(y)
         return x + y
+
+
+def _norm_act_epilogue(y, *, pad_after, norm_impl, activation):
+    """Shared IN > activation tail of Downsample/Upsample. pad_after > 0
+    fuses the chain into FusedNormReluPad (reflect-padded output for a
+    downstream VALID conv — e.g. the generator's tail Conv7x7 consuming
+    the last upsample); the module is named "InstanceNorm_0", the name
+    the unfused layout auto-assigns, so the param tree is identical.
+    Only a ReLU epilogue has a fused form (the reference uses nothing
+    else before a pad site)."""
+    if pad_after:
+        if activation is not nn.relu:
+            raise ValueError(
+                "pad_after requires a ReLU epilogue (got "
+                f"{activation!r}); only IN>ReLU>reflect-pad has a fused form"
+            )
+        return FusedNormReluPad(pad=pad_after, impl=norm_impl,
+                                name="InstanceNorm_0")(y)
+    y = InstanceNorm(impl=norm_impl, name="InstanceNorm_0")(y)
+    if activation is not None:
+        y = activation(y)
+    return y
 
 
 class Downsample(nn.Module):
     """Conv (stride 2 default, SAME, no bias) > IN > optional activation
-    (reference model.py:77-100).
+    (reference model.py:77-100). pad_after > 0 fuses the IN > ReLU tail
+    with a reflect-pad of the output (see _norm_act_epilogue).
     """
 
     filters: int
@@ -158,6 +222,7 @@ class Downsample(nn.Module):
     activation: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = nn.relu
     dtype: Optional[Dtype] = None
     norm_impl: str = "auto"
+    pad_after: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -170,16 +235,20 @@ class Downsample(nn.Module):
             kernel_init=init_normal,
             dtype=self.dtype,
         )(x)
-        y = InstanceNorm(impl=self.norm_impl)(y)
-        if self.activation is not None:
-            y = self.activation(y)
-        return y
+        return _norm_act_epilogue(
+            y, pad_after=self.pad_after, norm_impl=self.norm_impl,
+            activation=self.activation,
+        )
 
 
 class Upsample(nn.Module):
     """ConvTranspose (3x3, stride 2, SAME, no bias) > IN > optional
     activation (reference model.py:103-126). Output spatial dims exactly
     double the input, matching TF Conv2DTranspose SAME semantics.
+    pad_after > 0 fuses the IN > ReLU tail with a reflect-pad of the
+    output (see _norm_act_epilogue) — the generator uses it on the last
+    upsample under pad_impl="epilogue" so the tail Conv7x7 consumes the
+    padded slab VALID, with no materialized pad copy.
     """
 
     filters: int
@@ -188,6 +257,7 @@ class Upsample(nn.Module):
     activation: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = nn.relu
     dtype: Optional[Dtype] = None
     norm_impl: str = "auto"
+    pad_after: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -200,7 +270,7 @@ class Upsample(nn.Module):
             kernel_init=init_normal,
             dtype=self.dtype,
         )(x)
-        y = InstanceNorm(impl=self.norm_impl)(y)
-        if self.activation is not None:
-            y = self.activation(y)
-        return y
+        return _norm_act_epilogue(
+            y, pad_after=self.pad_after, norm_impl=self.norm_impl,
+            activation=self.activation,
+        )

@@ -6,6 +6,11 @@ fusion is poor.
 """
 
 from cyclegan_tpu.ops.padding import reflect_conv, reflect_pad
-from cyclegan_tpu.ops.norm import instance_norm
+from cyclegan_tpu.ops.norm import instance_norm, instance_norm_relu_pad
 
-__all__ = ["reflect_pad", "reflect_conv", "instance_norm"]
+__all__ = [
+    "reflect_pad",
+    "reflect_conv",
+    "instance_norm",
+    "instance_norm_relu_pad",
+]

@@ -17,7 +17,14 @@ and made the 512² batch-4 remat config fit 16G HBM):
   surrounding elementwise graph.
 - "pallas": a fused single-pass Pallas TPU kernel (ops/pallas/norm_kernel.py)
   for the cases where XLA's fusion leaves the activation in HBM between the
-  moment pass and the normalize pass.
+  moment pass and the normalize pass. Its VJP is likewise a single-pass
+  Pallas kernel (x, g, dx resident) with the shared XLA math as fallback.
+
+This module also hosts `instance_norm_relu_pad`, the residual-block
+epilogue dispatch: instance-norm -> ReLU -> reflect-pad as ONE op,
+served by the fused Pallas kernel (ops/pallas/epilogue_kernel.py) when
+the slab is VMEM-eligible under the actual input dtype, and by the XLA
+composition reflect_pad(relu(instance_norm(x))) everywhere else.
 
 Both 4-D paths use jax.custom_vjp, which makes instance_norm
 REVERSE-MODE ONLY: jax.jvp/jacfwd through it raises. Training and every
@@ -151,3 +158,42 @@ def instance_norm(
         except NotImplementedError:
             pass
     return _instance_norm_xla(x, scale, bias, eps)
+
+
+@functools.partial(jax.jit, static_argnames=("pad", "eps", "impl"))
+def instance_norm_relu_pad(
+    x: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    pad: int,
+    eps: float = 1e-3,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Fused residual-block epilogue: instance_norm -> ReLU ->
+    reflect-pad(pad), [N, H, W, C] -> [N, H+2p, W+2p, C].
+
+    The padded output is exactly tf.pad REFLECT over the ReLU'd norm
+    (the reference's ReflectionPadding2D composition), so the consumer
+    conv runs VALID on it. Unlike the standalone norm — where "auto"
+    resolves to XLA because the norm fuses into its producer/consumer
+    HBM passes — the epilogue's whole point is the materialized pad
+    copy XLA cannot elide, so "auto" (and "pallas") dispatch to the
+    Pallas epilogue kernel whenever the slab is VMEM-eligible under the
+    input dtype (ops/pallas/epilogue_kernel.py; interpret mode
+    off-TPU). Ineligible shapes — e.g. the generator's outermost
+    layers — and impl="xla" compose the XLA reference path.
+    """
+    if impl != "xla":
+        from cyclegan_tpu.ops.pallas.epilogue_kernel import (
+            epilogue_eligible,
+            instance_norm_relu_pad_pallas,
+        )
+
+        if epilogue_eligible(x.shape, x.dtype, pad):
+            interpret = jax.default_backend() != "tpu"
+            return instance_norm_relu_pad_pallas(
+                x, scale, bias, pad=pad, eps=eps, interpret=interpret
+            )
+    from cyclegan_tpu.ops.padding import reflect_pad
+
+    return reflect_pad(jax.nn.relu(_instance_norm_xla(x, scale, bias, eps)), pad)

@@ -13,6 +13,17 @@ step's HBM traffic (docs/BENCHMARKS.md "what does reflection padding
 cost", docs/aot_analysis.json pad-probe). `ModelConfig.pad_mode="zero"`
 is the non-parity perf option that avoids them (conv built-in SAME,
 same parameter tree).
+
+Parity-preserving schedules of the SAME semantics, in increasing
+aggression (all share one param tree — ModelConfig.pad_impl):
+- `reflect_conv` (pad_impl="fused"): conv built-in zero padding plus
+  thin fusible border-correction convs — no materialized pad copies
+  around the convs themselves.
+- ops/norm.py:instance_norm_relu_pad (pad_impl="epilogue"): the
+  residual-block IN>ReLU>reflect-pad chain as ONE Pallas kernel that
+  writes the padded slab directly (ops/pallas/epilogue_kernel.py) —
+  the pad costs zero extra HBM traffic because the kernel was writing
+  the tensor anyway.
 """
 
 from __future__ import annotations

@@ -1,0 +1,236 @@
+"""Fused residual-block epilogue: instance-norm -> ReLU -> reflect-pad.
+
+Motivation (docs/BENCHMARKS.md "what does reflection padding cost"): the
+22 materialized reflect-pads per generator apply are ~32% of the fused
+train step's HBM traffic. pad_impl="fused" (ReflectConv) removes the
+padded copies around the convs but still leaves the IN->ReLU->pad chain
+of every residual block crossing HBM between ops. This kernel keeps the
+whole slab resident in VMEM across all three: one HBM read of the conv
+output, one write of the PADDED tensor the next conv consumes — the
+materialized pad costs zero extra traffic because the kernel was going
+to write the tensor anyway.
+
+Layout mirrors ops/pallas/norm_kernel.py: grid (N, C/C_BLK), channels
+on lanes; the block keeps [H, W] intact (not flattened) because the
+reflection is 2-D. Statistics are always float32. Reflection is built
+from STATIC slices + one concatenate per axis — no flips, gathers, or
+dynamic indexing, which Mosaic lowers poorly (pallas guide: prefer
+static slicing).
+
+tf.pad REFLECT semantics (the reference's ReflectionPadding2D,
+model.py:14-33): the border row/col is NOT repeated; pad row d mirrors
+interior row d. The backward folds the pad-transpose (mirror-accumulate
+of border cotangents), the ReLU mask, and the instance-norm VJP into
+one kernel over the same resident slab, emitting dx plus per-(n,c)
+dscale/dbias partials (summed over N outside — [N, 1, C] slivers).
+
+Eligibility is dtype-aware (ops/pallas/vmem.py) and sized by the
+BACKWARD's three slabs, so forward eligibility implies backward
+eligibility: true for the generator trunk at 256^2 input (64x64 slab,
+f32 or bf16), false for the outermost layers; ops/norm.py composes the
+XLA fallback (reflect_pad . relu . instance_norm) there.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from cyclegan_tpu.ops.pallas import vmem
+
+C_BLK = vmem.C_BLK
+
+
+def epilogue_eligible(shape: Tuple[int, ...], dtype, pad: int) -> bool:
+    """True if [N, H, W, C] can run the fused epilogue kernel: the
+    backward's three slabs (x, padded cotangent, dx) must stay
+    VMEM-resident, with the budget computed from the ACTUAL input
+    itemsize (bf16 slabs are half the f32 size)."""
+    if len(shape) != 4:
+        return False
+    _, h, w, _ = shape
+    return vmem.epilogue_fits(h, w, int(pad), np.dtype(dtype).itemsize)
+
+
+def _reflect_2d(y: jnp.ndarray, pad: int) -> jnp.ndarray:
+    """Reflect-pad [H, W, C] -> [H+2p, W+2p, C] with static slices and
+    two concatenates (the only reflection construction Mosaic handles
+    well). Row/col 0 is the mirror axis: pad offset d copies interior
+    offset d, never the border itself (tf.pad REFLECT)."""
+    h, w = y.shape[0], y.shape[1]
+    left = [y[:, d:d + 1] for d in range(pad, 0, -1)]
+    right = [y[:, w - 1 - d:w - d] for d in range(1, pad + 1)]
+    y = jnp.concatenate(left + [y] + right, axis=1)
+    top = [y[d:d + 1] for d in range(pad, 0, -1)]
+    bottom = [y[h - 1 - d:h - d] for d in range(1, pad + 1)]
+    return jnp.concatenate(top + [y] + bottom, axis=0)
+
+
+def _reflect_transpose_2d(g: jnp.ndarray, h: int, w: int, pad: int):
+    """Transpose of `_reflect_2d`: fold the padded cotangent
+    [H+2p, W+2p, C] back to [H, W, C] by mirror-accumulating each border
+    band onto the interior row/col it was copied from. Static indices
+    only — each `.at[d].add` is a static dynamic-update-slice."""
+    gh = g[pad:pad + h]
+    for d in range(1, pad + 1):
+        gh = gh.at[d].add(g[pad - d])
+        gh = gh.at[h - 1 - d].add(g[pad + h - 1 + d])
+    gc = gh[:, pad:pad + w]
+    for d in range(1, pad + 1):
+        gc = gc.at[:, d].add(gh[:, pad - d])
+        gc = gc.at[:, w - 1 - d].add(gh[:, pad + w - 1 + d])
+    return gc
+
+
+def _fwd_kernel(x_ref, scale_ref, bias_ref, y_ref, mean_ref, inv_ref,
+                *, eps, pad):
+    x = x_ref[0].astype(jnp.float32)  # [H, W, Cb]
+    hw = x.shape[0] * x.shape[1]
+    mean = jnp.sum(x, axis=(0, 1), keepdims=True) / hw  # [1, 1, Cb]
+    centered = x - mean
+    var = jnp.sum(centered * centered, axis=(0, 1), keepdims=True) / hw
+    inv = jax.lax.rsqrt(var + eps)
+    scale = scale_ref[0].astype(jnp.float32)  # [Cb]
+    bias = bias_ref[0].astype(jnp.float32)
+    y = centered * inv * scale[None, None, :] + bias[None, None, :]
+    y = jnp.maximum(y, 0.0)
+    y_ref[0] = _reflect_2d(y, pad).astype(y_ref.dtype)
+    mean_ref[0] = mean[0]
+    inv_ref[0] = inv[0]
+
+
+def _bwd_kernel(x_ref, scale_ref, bias_ref, g_ref, mean_ref, inv_ref,
+                dx_ref, dscale_ref, dbias_ref, *, pad):
+    x = x_ref[0].astype(jnp.float32)  # [H, W, Cb]
+    h, w = x.shape[0], x.shape[1]
+    hw = h * w
+    g = g_ref[0].astype(jnp.float32)  # [H+2p, W+2p, Cb]
+    g = _reflect_transpose_2d(g, h, w, pad)
+    mean = mean_ref[0][None]  # [1, 1, Cb] f32 (saved forward stats)
+    inv = inv_ref[0][None]
+    scale = scale_ref[0].astype(jnp.float32)  # [Cb]
+    bias = bias_ref[0].astype(jnp.float32)
+    xhat = (x - mean) * inv
+    # ReLU mask from the recomputed pre-ReLU output (cheap: the slab is
+    # already resident; saving the mask would cost another HBM tensor).
+    pre = xhat * scale[None, None, :] + bias[None, None, :]
+    g = jnp.where(pre > 0.0, g, 0.0)
+    gsum = jnp.sum(g, axis=(0, 1), keepdims=True)  # [1, 1, Cb]
+    gxsum = jnp.sum(g * xhat, axis=(0, 1), keepdims=True)
+    dx = scale[None, None, :] * inv * (g - gsum / hw - xhat * (gxsum / hw))
+    dx_ref[0] = dx.astype(dx_ref.dtype)
+    dscale_ref[0] = gxsum[0]
+    dbias_ref[0] = gsum[0]
+
+
+def _forward(x, scale, bias, eps, pad, interpret):
+    n, h, w, c = x.shape
+    hp, wp = h + 2 * pad, w + 2 * pad
+    c_blk = min(c, C_BLK)
+    grid = (n, pl.cdiv(c, c_blk))
+    y, mean, inv = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps, pad=pad),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, h, w, c_blk), lambda i, j: (i, 0, 0, j)),
+            pl.BlockSpec((1, c_blk), lambda i, j: (0, j)),
+            pl.BlockSpec((1, c_blk), lambda i, j: (0, j)),
+        ],
+        # Stats are [N, 1, C] for the same (8, 128) block-tiling reason
+        # as norm_kernel._forward: the block's last-two dims must be
+        # (1, C_BLK) for any N.
+        out_specs=[
+            pl.BlockSpec((1, hp, wp, c_blk), lambda i, j: (i, 0, 0, j)),
+            pl.BlockSpec((1, 1, c_blk), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, c_blk), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, hp, wp, c), x.dtype),
+            jax.ShapeDtypeStruct((n, 1, c), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1, c), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, scale.reshape(1, c), bias.reshape(1, c))
+    return y, mean, inv
+
+
+def _backward(x, scale, bias, mean, inv, g, pad, interpret):
+    n, h, w, c = x.shape
+    hp, wp = h + 2 * pad, w + 2 * pad
+    c_blk = min(c, C_BLK)
+    grid = (n, pl.cdiv(c, c_blk))
+    dx, dscale_nc, dbias_nc = pl.pallas_call(
+        functools.partial(_bwd_kernel, pad=pad),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, h, w, c_blk), lambda i, j: (i, 0, 0, j)),
+            pl.BlockSpec((1, c_blk), lambda i, j: (0, j)),
+            pl.BlockSpec((1, c_blk), lambda i, j: (0, j)),
+            pl.BlockSpec((1, hp, wp, c_blk), lambda i, j: (i, 0, 0, j)),
+            pl.BlockSpec((1, 1, c_blk), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, c_blk), lambda i, j: (i, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, h, w, c_blk), lambda i, j: (i, 0, 0, j)),
+            pl.BlockSpec((1, 1, c_blk), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, c_blk), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h, w, c), x.dtype),
+            jax.ShapeDtypeStruct((n, 1, c), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1, c), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, scale.reshape(1, c), bias.reshape(1, c), g,
+      mean.reshape(n, 1, c), inv.reshape(n, 1, c))
+    return dx, dscale_nc, dbias_nc
+
+
+@functools.lru_cache(maxsize=None)
+def _build(eps: float, pad: int, interpret: bool):
+    @jax.custom_vjp
+    def op(x, scale, bias):
+        y, _, _ = _forward(x, scale, bias, eps, pad, interpret)
+        return y
+
+    def op_fwd(x, scale, bias):
+        y, mean, inv = _forward(x, scale, bias, eps, pad, interpret)
+        # bias is saved (tiny [C]) so dbias comes back in bias's OWN
+        # dtype and the ReLU mask can be recomputed in the backward —
+        # same residual set as the norm paths plus nothing extra.
+        return y, (x, scale, bias, mean, inv)
+
+    def op_bwd(res, g):
+        x, scale, bias, mean, inv = res
+        dx, dscale_nc, dbias_nc = _backward(
+            x, scale, bias, mean, inv, g, pad, interpret)
+        dscale = jnp.sum(dscale_nc, axis=(0, 1)).astype(scale.dtype)
+        dbias = jnp.sum(dbias_nc, axis=(0, 1)).astype(bias.dtype)
+        return dx, dscale, dbias
+
+    op.defvjp(op_fwd, op_bwd)
+    return op
+
+
+def instance_norm_relu_pad_pallas(
+    x: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    pad: int,
+    eps: float = 1e-3,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused IN -> ReLU -> reflect-pad(pad): [N, H, W, C] ->
+    [N, H+2p, W+2p, C]. Raises NotImplementedError when the slab cannot
+    stay VMEM-resident (caller composes the XLA fallback)."""
+    if not epilogue_eligible(x.shape, x.dtype, pad):
+        raise NotImplementedError(
+            f"shape {x.shape} dtype {x.dtype} pad {pad} exceeds the "
+            f"epilogue slab budget ({vmem.EPILOGUE_BUDGET_BYTES} bytes)"
+        )
+    return _build(float(eps), int(pad), bool(interpret))(x, scale, bias)

@@ -17,13 +17,19 @@ Backward is a custom VJP using the saved per-(n,c) mean/inv residuals:
   dbias  = sum_{N,HW} g
   dscale = sum_{N,HW} g * xhat
   dx = scale * inv * (g - mean_hw(g) - xhat * mean_hw(g * xhat))
-implemented in XLA (fuses into two passes); the forward is the
-bandwidth-critical op inside the 9 residual blocks.
+implemented as a second single-pass Pallas kernel over the same grid
+(x, g, and dx resident — XLA's schedule of the shared-math VJP re-read
+the activation across the reduce pass and the dx pass, the same
+three-crossings problem the forward fixed), with the XLA
+instance_norm_backward as fallback for slabs past the backward budget.
 
-Eligibility: the slab (HW x 128 x 4B, x2 for in+out) must fit VMEM
-(~16MB/core) — true for the generator trunk at 256^2 input
-(64x64x256 activations, where 18 of the ~22 instance norms run), not
-for the two outermost layers; ops/norm.py falls back to XLA there.
+Eligibility is dtype-aware (ops/pallas/vmem.py): the slab is
+(H*W, C_BLK) elements of the INPUT dtype (stats are always f32 but are
+[1, C_BLK] slivers), so bf16 inputs get twice the f32 H*W bound — the
+old estimate assumed 4 B/element unconditionally. True for the
+generator trunk at 256^2 input (64x64x256 activations, where 18 of the
+~22 instance norms run), not for the two outermost layers; ops/norm.py
+falls back to XLA there.
 """
 
 from __future__ import annotations
@@ -33,23 +39,41 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Max sublane extent (H*W) for a resident slab: 8192 * 128 lanes * 4B = 4MB
-# per buffer; in + out + margin stays well under the ~16MB VMEM budget.
-MAX_RESIDENT_HW = 8192
-C_BLK = 128
+from cyclegan_tpu.ops.pallas import vmem
+
+# Max sublane extent (H*W) for a resident slab at the f32 reference
+# itemsize: 8192 * 128 lanes * 4B = 4MB per buffer; in + out + margin
+# stays well under the ~16MB VMEM budget. The dtype-aware bound
+# (vmem.norm_fwd_max_hw) doubles this for bf16 inputs.
+MAX_RESIDENT_HW = vmem.norm_fwd_max_hw(4)
+C_BLK = vmem.C_BLK
 
 
-def eligible(shape: Tuple[int, ...]) -> bool:
-    """True if [N, H, W, C] can use the single-pass resident kernel: the
-    per-grid-step slab is (H*W, C_BLK) floats (stats are f32 even for
-    bf16 inputs), so the bound is on H*W alone."""
+def eligible(shape: Tuple[int, ...], dtype=jnp.float32) -> bool:
+    """True if [N, H, W, C] of `dtype` can use the single-pass resident
+    kernel: the per-grid-step slab is (H*W, C_BLK) elements of the input
+    dtype, so the bound is on H*W scaled by the actual itemsize (bf16
+    slabs are half the f32 size — the old 4 B/element assumption
+    halved bf16 eligibility for no reason)."""
     if len(shape) != 4:
         return False
     _, h, w, _ = shape
-    return h * w <= MAX_RESIDENT_HW
+    return h * w <= vmem.norm_fwd_max_hw(np.dtype(dtype).itemsize)
+
+
+def bwd_eligible(shape: Tuple[int, ...], dtype=jnp.float32) -> bool:
+    """Whether the Pallas backward (x + g + dx resident) fits its
+    budget. With the vmem budgets this is implied by forward
+    eligibility for every itemsize; kept explicit so the dispatch
+    never depends on that coincidence."""
+    if len(shape) != 4:
+        return False
+    _, h, w, _ = shape
+    return h * w <= vmem.norm_bwd_max_hw(np.dtype(dtype).itemsize)
 
 
 def _fwd_kernel(x_ref, scale_ref, bias_ref, y_ref, mean_ref, inv_ref, *, eps):
@@ -100,6 +124,59 @@ def _forward(x4, scale, bias, eps, interpret):
     return y.reshape(n, h, w, c), mean.reshape(n, c), inv.reshape(n, c)
 
 
+def _bwd_kernel(x_ref, scale_ref, g_ref, mean_ref, inv_ref,
+                dx_ref, dscale_ref, dbias_ref):
+    x = x_ref[0].astype(jnp.float32)  # [HW, Cb]
+    g = g_ref[0].astype(jnp.float32)
+    hw = x.shape[0]
+    mean = mean_ref[0]  # [1, Cb] f32 (saved forward stats)
+    inv = inv_ref[0]
+    scale = scale_ref[0].astype(jnp.float32)  # [Cb]
+    xhat = (x - mean) * inv
+    gsum = jnp.sum(g, axis=0, keepdims=True)  # [1, Cb]
+    gxsum = jnp.sum(g * xhat, axis=0, keepdims=True)
+    dx = scale[None, :] * inv * (g - gsum / hw - xhat * (gxsum / hw))
+    dx_ref[0] = dx.astype(dx_ref.dtype)
+    dscale_ref[0] = gxsum
+    dbias_ref[0] = gsum
+
+
+def _backward(x4, scale, mean, inv, g4, interpret):
+    """Single-pass VJP: x and g cross HBM once each, dx is written once,
+    and the per-(n,c) dscale/dbias partials come back as [N, 1, C] f32
+    slivers (summed over N by the caller — a trivially small reduce)."""
+    n, h, w, c = x4.shape
+    hw = h * w
+    x = x4.reshape(n, hw, c)
+    g = g4.reshape(n, hw, c)
+    c_blk = min(c, C_BLK)
+    grid = (n, pl.cdiv(c, c_blk))
+    dx, dscale_nc, dbias_nc = pl.pallas_call(
+        _bwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, hw, c_blk), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, c_blk), lambda i, j: (0, j)),
+            pl.BlockSpec((1, hw, c_blk), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, c_blk), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, c_blk), lambda i, j: (i, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, hw, c_blk), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, c_blk), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, c_blk), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, hw, c), x.dtype),
+            jax.ShapeDtypeStruct((n, 1, c), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1, c), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, scale.reshape(1, c), g, mean.reshape(n, 1, c),
+      inv.reshape(n, 1, c))
+    return dx.reshape(n, h, w, c), dscale_nc, dbias_nc
+
+
 @functools.lru_cache(maxsize=None)
 def _build(eps: float, interpret: bool):
     @jax.custom_vjp
@@ -112,11 +189,20 @@ def _build(eps: float, interpret: bool):
         return y, (x, scale, bias, mean, inv)
 
     def op_bwd(res, g):
+        x, scale, bias, mean, inv = res
+        if bwd_eligible(x.shape, x.dtype):
+            dx, dscale_nc, dbias_nc = _backward(
+                x, scale, mean, inv, g, interpret)
+            dscale = jnp.sum(dscale_nc, axis=(0, 1)).astype(scale.dtype)
+            dbias = jnp.sum(dbias_nc, axis=(0, 1)).astype(bias.dtype)
+            return dx, dscale, dbias
+        # Shapes past the three-slab budget (can only happen if the
+        # forward was forced on an oversized input): shared XLA VJP math.
         from cyclegan_tpu.ops.norm import instance_norm_backward
 
-        x, scale, bias, mean, inv = res
         return instance_norm_backward(
-            x, scale, mean[:, None, None, :], inv[:, None, None, :], g, bias.dtype
+            x, scale, mean[:, None, None, :], inv[:, None, None, :], g,
+            bias.dtype,
         )
 
     op.defvjp(op_fwd, op_bwd)
@@ -132,8 +218,9 @@ def instance_norm_pallas(
 ) -> jnp.ndarray:
     """Fused instance norm. Raises NotImplementedError when the shape
     cannot stay VMEM-resident (caller falls back to XLA)."""
-    if not eligible(x.shape):
+    if not eligible(x.shape, x.dtype):
         raise NotImplementedError(
-            f"shape {x.shape} exceeds resident-slab limit (H*W <= {MAX_RESIDENT_HW})"
+            f"shape {x.shape} dtype {x.dtype} exceeds the resident-slab "
+            f"limit (H*W <= {vmem.norm_fwd_max_hw(np.dtype(x.dtype).itemsize)})"
         )
     return _build(float(eps), bool(interpret))(x, scale, bias)

@@ -1,0 +1,78 @@
+"""VMEM slab budgets for the Pallas kernels — pure Python, no jax.
+
+One author for every "does this shape fit in VMEM?" decision so the
+kernels (ops/pallas/norm_kernel.py, ops/pallas/epilogue_kernel.py), the
+dispatch layer (ops/norm.py), and startup config validation
+(cyclegan_tpu/config.py — which must stay importable without jax) all
+agree on the eligibility boundary.
+
+The budgets are per *grid step*: the kernels iterate grid (N, C/C_BLK),
+so the resident slab is (H*W, C_BLK) elements per input/output buffer.
+A TPU core has ~16 MB of VMEM; Mosaic double-buffers blocks whose index
+map varies across the grid, so the explicit-slab budgets below leave
+headroom for that plus register spill:
+
+- instance-norm forward: in + out slabs               -> 8 MB budget
+- instance-norm backward: x + g + dx slabs            -> 12 MB budget
+- epilogue fwd/bwd: x + padded-out (+ dx) slabs       -> 12 MB budget
+  (the backward is the worst case — x [HW], padded cotangent [HpWp],
+  and dx [HW] — and gates eligibility so fwd and bwd always agree)
+
+The original norm budget assumed 4 B/element even for bfloat16 inputs;
+these helpers take the actual itemsize, which doubles the eligible H*W
+under the default bf16 configs (stats stay f32 either way — they are
+[1, C_BLK] slivers, negligible against the activation slabs).
+"""
+
+from __future__ import annotations
+
+C_BLK = 128  # channel tile = TPU lane width
+
+NORM_FWD_BUDGET_BYTES = 8 * 1024 * 1024
+NORM_BWD_BUDGET_BYTES = 12 * 1024 * 1024
+EPILOGUE_BUDGET_BYTES = 12 * 1024 * 1024
+
+_ITEMSIZE_BY_NAME = {
+    "float32": 4,
+    "bfloat16": 2,
+    "float16": 2,
+    "float64": 8,
+}
+
+
+def itemsize_for(dtype_name: str) -> int:
+    """Bytes per element for a dtype NAME string (config.compute_dtype).
+    Unknown names fall back to 4 (the conservative f32 bound)."""
+    return _ITEMSIZE_BY_NAME.get(dtype_name, 4)
+
+
+def norm_fwd_max_hw(itemsize: int) -> int:
+    """Max H*W for the single-pass instance-norm forward: in + out
+    slabs of (H*W, C_BLK) elements within the forward budget."""
+    return NORM_FWD_BUDGET_BYTES // (2 * C_BLK * itemsize)
+
+
+def norm_bwd_max_hw(itemsize: int) -> int:
+    """Max H*W for the fused instance-norm backward: x + g + dx slabs.
+    With the budgets above this equals norm_fwd_max_hw for every
+    itemsize (12/3 == 8/2), so a shape that ran the Pallas forward can
+    always run the Pallas backward."""
+    return NORM_BWD_BUDGET_BYTES // (3 * C_BLK * itemsize)
+
+
+def epilogue_bytes(h: int, w: int, pad: int, itemsize: int) -> int:
+    """Resident bytes per grid step for the IN->ReLU->reflect-pad
+    epilogue, at its backward-pass worst case: the unpadded x slab, the
+    padded cotangent slab, and the dx slab."""
+    hw = h * w
+    hw_padded = (h + 2 * pad) * (w + 2 * pad)
+    return (2 * hw + hw_padded) * C_BLK * itemsize
+
+
+def epilogue_fits(h: int, w: int, pad: int, itemsize: int) -> bool:
+    """Whether [*, h, w, *] can run the fused epilogue kernel. Also
+    enforces the reflect constraint pad < min(h, w) (tf.pad REFLECT
+    taps up to `pad` interior rows/cols past each border)."""
+    if pad < 1 or min(h, w) <= pad:
+        return False
+    return epilogue_bytes(h, w, pad, itemsize) <= EPILOGUE_BUDGET_BYTES

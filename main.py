@@ -79,6 +79,7 @@ def main(args: argparse.Namespace) -> None:
             scan_blocks=args.scan_blocks,
             pad_mode=args.pad_mode,
             pad_impl=args.pad_impl,
+            instance_norm_impl=args.norm_impl,
             image_size=args.image_size,
         ),
         data=DataConfig(
@@ -373,17 +374,34 @@ if __name__ == "__main__":
                              "border semantics; traffic trade quantified in "
                              "docs/BENCHMARKS.md (pad-probe)")
     parser.add_argument("--pad_impl", default="pad",
-                        choices=["pad", "fused"],
-                        help="how pad_mode=reflect is scheduled: 'pad' "
-                             "materializes reflect-padded copies (bitwise "
-                             "parity baseline); 'fused' keeps exact reflect "
-                             "semantics (fp-tolerance-identical) without "
-                             "materialized pad copies — a modest measured "
-                             "win (~-2.7%% step HBM bytes; layout copies "
-                             "eat most of the gap — docs/BENCHMARKS.md "
-                             "round 4). The ~-32%% traffic lever is "
-                             "--pad_mode zero (non-parity borders). "
-                             "Checkpoints interchange")
+                        choices=["pad", "fused", "epilogue"],
+                        help="how pad_mode=reflect is scheduled (measured "
+                             "256^2/b16/bf16, docs/BENCHMARKS.md round 5): "
+                             "'pad' materializes reflect-padded copies "
+                             "(bitwise parity baseline, 95.33 img/s); "
+                             "'fused' keeps exact reflect semantics "
+                             "(fp-tolerance-identical) without materialized "
+                             "pad copies via ReflectConv (103.95 img/s, "
+                             "+9.0%%); 'epilogue' adds the Pallas "
+                             "IN>ReLU>reflect-pad kernel in the residual "
+                             "trunk (one HBM read, one padded write per "
+                             "site) — chasing the 120.05 img/s zero-pad "
+                             "ceiling with parity intact. The ~-32%% "
+                             "traffic lever is --pad_mode zero (non-parity "
+                             "borders). Checkpoints interchange across all "
+                             "pad_impl values")
+    parser.add_argument("--norm_impl", default="auto",
+                        choices=["auto", "xla", "pallas"],
+                        help="instance-norm implementation: 'auto' resolves "
+                             "to XLA for standalone norms (measured faster "
+                             "in the fused step: 95.0 vs 86.1 img/s — the "
+                             "kernel is an opaque fusion boundary) while "
+                             "epilogue sites still use the Pallas kernel "
+                             "under --pad_impl epilogue; 'pallas' forces "
+                             "the standalone kernel (single-pass fwd+bwd) "
+                             "where VMEM-eligible; 'xla' disables Pallas "
+                             "everywhere (incompatible with --pad_impl "
+                             "epilogue)")
     parser.add_argument("--spatial_parallelism", default=1, type=int,
                         help="shard the image H axis over this many mesh columns")
     parser.add_argument("--grad_accum", default=1, type=int, metavar="A",

@@ -54,7 +54,8 @@ def test_queue_order_and_budgets():
     # Highest value first (VERDICT r4 item 1): health probe, official
     # number cold then warm, the pad lever, 512^2 rows, trace, e2e run.
     assert names == ["diag", "bench_cold", "bench_warm", "pad_sweep",
-                     "accum512", "scan512", "trace", "timed_main"]
+                     "epilogue_sweep", "accum512", "scan512", "trace",
+                     "timed_main"]
     by = {s.name: s for s in q}
     assert by["diag"].abort_queue_on_fail  # diag failing = relay sick
     # cold run gets the cache-warming budget; warm run is the record
@@ -84,7 +85,20 @@ def test_local_compile_mode_sets_env_on_every_step():
         assert s.env["PALLAS_AXON_POOL_IPS"] == ""
         assert s.env["CYCLEGAN_AXON_LOCAL_COMPILE"] == "1"
     for s in build_queue("remote"):
+        if s.name == "epilogue_sweep":
+            continue  # deliberately local-compile in BOTH modes (below)
         assert "CYCLEGAN_AXON_LOCAL_COMPILE" not in s.env
+
+
+def test_epilogue_sweep_always_forces_local_compile():
+    """The epilogue row runs a Mosaic program, which must NEVER cross
+    the remote-compile leg (ground rule 2b) — so the step pins the
+    local-compile env in remote mode too, not just local_compile."""
+    for mode in ("remote", "local_compile"):
+        s = {st.name: st for st in build_queue(mode)}["epilogue_sweep"]
+        assert s.env["CYCLEGAN_AXON_LOCAL_COMPILE"] == "1"
+        assert s.env["PALLAS_AXON_POOL_IPS"] == ""
+        assert "scan:b16epi" in s.argv
 
 
 def test_timed_main_writes_outside_repo():
@@ -103,7 +117,8 @@ def test_dry_run_prints_queue_and_executes_nothing(tmp_path):
     assert r.returncode == 0, r.stderr
     assert "mode remote" in r.stdout and "mode local_compile" in r.stdout
     for name in ("diag", "bench_cold", "bench_warm", "pad_sweep",
-                 "accum512", "scan512", "trace", "timed_main"):
+                 "epilogue_sweep", "accum512", "scan512", "trace",
+                 "timed_main"):
         assert name in r.stdout
 
 

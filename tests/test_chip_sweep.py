@@ -85,6 +85,18 @@ def test_pallas_off_cpu_records_refusal_and_continues(tmp_path):
     assert all(row["error"].startswith("refused:") for row in rows)
 
 
+def test_epilogue_spec_off_cpu_records_refusal(tmp_path):
+    # pad_impl="epilogue" runs a Mosaic program inside the train step —
+    # same remote-compile hazard as pallas specs, same refusal rail.
+    rec = tmp_path / "rec.json"
+    r = _run(["scan:b16epi"], rec, platforms=None)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "refusing to send" in (r.stdout + r.stderr)
+    rows = json.loads(rec.read_text())
+    assert rows[0]["key"] == "scan:b16epi"
+    assert rows[0]["error"].startswith("refused:")
+
+
 def test_pallas_allowed_on_cpu_platform(tmp_path):
     # JAX_PLATFORMS=cpu (re-asserted into jax.config) makes pallas specs
     # legal: they never touch the remote-compile leg. Parse-only check —
@@ -152,6 +164,10 @@ def test_corrupt_record_aborts_before_measuring(tmp_path):
     ("scan:b16fused", ("scan", 16, 8, False, "reflect", "fused", False, 256)),
     ("dispatch:b16k8fusedi512",
      ("dispatch", 16, 8, False, "reflect", "fused", False, 512)),
+    # epi = pad_impl="epilogue" (Pallas trunk epilogue; local-compile only)
+    ("scan:b16epi", ("scan", 16, 8, False, "reflect", "epilogue", False, 256)),
+    ("dispatch:b16k8epii512",
+     ("dispatch", 16, 8, False, "reflect", "epilogue", False, 512)),
     ("dispatch:b16k8pf",
      ("dispatch", 16, 8, False, "reflect", "pad", True, 256)),
     ("dispatch:b16k8zeropfi512",
@@ -169,7 +185,9 @@ def test_spec_grammar(spec, expect):
 @pytest.mark.parametrize("bad", ["scan:i512b8", "scan:b0", "scan:b16k0",
                                  "steps:b1", "scan:b8i0", "scan", "",
                                  "scan:b16zeropallas", "scan:b16zerofused",
-                                 "scan:b16fusedzero", "scan:b16pf",
+                                 "scan:b16fusedzero", "scan:b16zeroepi",
+                                 "scan:b16epifused", "scan:b16epipallas",
+                                 "scan:b16pf",
                                  "dispatch:b16pfk8", "accum:b1pf",
                                  "accum:b0k8", "accum:b1k0"])
 def test_spec_grammar_rejects(bad):

@@ -27,3 +27,46 @@ def test_instance_norm_impl_typo_raises():
 
 def test_default_config_constructs():
     assert Config().model.pad_mode == "reflect"
+
+
+def test_pad_impl_typo_raises():
+    with pytest.raises(ValueError, match="pad_impl"):
+        ModelConfig(pad_impl="Epilogue")
+
+
+def test_pad_impl_valid_values_accepted():
+    assert ModelConfig(pad_impl="pad").pad_impl == "pad"
+    assert ModelConfig(pad_impl="fused").pad_impl == "fused"
+    assert ModelConfig(pad_impl="epilogue").pad_impl == "epilogue"
+
+
+def test_zero_pad_mode_rejects_reflect_schedules():
+    # "fused"/"epilogue" schedule REFLECT semantics; combining them with
+    # pad_mode="zero" is a contradiction that must fail at construction,
+    # not silently pick one interpretation at trace time.
+    for impl in ("fused", "epilogue"):
+        with pytest.raises(ValueError, match="reflect"):
+            ModelConfig(pad_mode="zero", pad_impl=impl)
+
+
+def test_epilogue_rejects_xla_norm():
+    with pytest.raises(ValueError, match="epilogue"):
+        ModelConfig(pad_impl="epilogue", instance_norm_impl="xla")
+
+
+def test_epilogue_rejects_ineligible_trunk_shape():
+    # At 512^2 the residual trunk is 128^2 — past the epilogue slab
+    # budget for either compute dtype. The flag would buy nothing (every
+    # site silently falls back), so construction fails with the numbers.
+    for dtype in ("float32", "bfloat16"):
+        with pytest.raises(ValueError, match="VMEM"):
+            ModelConfig(pad_impl="epilogue", image_size=512,
+                        compute_dtype=dtype)
+
+
+def test_epilogue_accepted_on_eligible_shapes():
+    # The default 256^2 trunk (64^2) fits in both dtypes.
+    assert ModelConfig(pad_impl="epilogue").pad_impl == "epilogue"
+    assert ModelConfig(
+        pad_impl="epilogue", compute_dtype="bfloat16"
+    ).pad_impl == "epilogue"

@@ -235,6 +235,58 @@ class TestPadImpl:
         assert (jax.tree.map(lambda l: l.shape, trees["pad"]) ==
                 jax.tree.map(lambda l: l.shape, trees["fused"]))
 
+    def test_epilogue_param_tree_identical_and_outputs_match(self):
+        # pad_impl="epilogue" re-schedules ResBlock IN->ReLU->reflect-pad
+        # into the Pallas epilogue kernel (interpret mode on CPU). Same
+        # contract as "fused": checkpoint-interchangeable tree, same-
+        # params outputs agree to fp tolerance with the reference "pad"
+        # schedule.
+        cfg = GeneratorConfig(filters=8, num_residual_blocks=2)
+        x = jax.random.uniform(jax.random.PRNGKey(1), (1, 32, 32, 3),
+                               minval=-1.0, maxval=1.0)
+        gens = {impl: ResNetGenerator(config=cfg, pad_impl=impl)
+                for impl in ("pad", "epilogue")}
+        trees = {impl: jax.eval_shape(g.init, jax.random.PRNGKey(0), x)
+                 for impl, g in gens.items()}
+        assert (jax.tree.map(lambda l: (l.shape, l.dtype), trees["pad"]) ==
+                jax.tree.map(lambda l: (l.shape, l.dtype),
+                             trees["epilogue"]))
+
+        params = gens["pad"].init(jax.random.PRNGKey(0), x)
+        out_pad = gens["pad"].apply(params, x)
+        out_epi = gens["epilogue"].apply(params, x)  # same tree loads
+        np.testing.assert_allclose(np.asarray(out_pad),
+                                   np.asarray(out_epi),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_epilogue_param_tree_identical_with_scan_blocks(self):
+        x = jnp.zeros((1, 64, 64, 3))
+        trees = {}
+        for impl in ("pad", "epilogue"):
+            gen = ResNetGenerator(pad_impl=impl, scan_blocks=True)
+            trees[impl] = jax.eval_shape(gen.init, jax.random.PRNGKey(0), x)
+        assert (jax.tree.map(lambda l: l.shape, trees["pad"]) ==
+                jax.tree.map(lambda l: l.shape, trees["epilogue"]))
+
+    def test_epilogue_grad_matches_pad_schedule(self):
+        # the Pallas custom_vjp (IN backward + pad-transpose) must
+        # produce the same parameter gradients as the XLA composition.
+        cfg = GeneratorConfig(filters=8, num_residual_blocks=1)
+        x = jax.random.uniform(jax.random.PRNGKey(1), (1, 32, 32, 3),
+                               minval=-1.0, maxval=1.0)
+        gens = {impl: ResNetGenerator(config=cfg, pad_impl=impl)
+                for impl in ("pad", "epilogue")}
+        params = gens["pad"].init(jax.random.PRNGKey(0), x)
+        grads = {}
+        for impl, gen in gens.items():
+            grads[impl] = jax.grad(
+                lambda p: jnp.sum(gen.apply(p, x) ** 2))(params)
+        flat_pad = jax.tree_util.tree_leaves(grads["pad"])
+        flat_epi = jax.tree_util.tree_leaves(grads["epilogue"])
+        for a, b in zip(flat_pad, flat_epi):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=1e-4)
+
     def test_fused_init_statistics_match_conv_init(self):
         # ReflectConv must init kernels N(0, 0.02) like nn.Conv does
         # (reference model.py:10-11) — same init fn, same param dtype.

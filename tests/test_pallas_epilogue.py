@@ -1,0 +1,236 @@
+"""Fused IN -> ReLU -> reflect-pad epilogue kernel vs the XLA reference
+composition (reflect_pad . relu . instance_norm) — forward and backward,
+interpret mode on CPU (the driver/bench exercise the compiled TPU path).
+
+Also pins the dtype-aware VMEM eligibility boundary and the dispatch
+fallback: ineligible shapes must silently get the XLA composition with
+identical semantics.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cyclegan_tpu.ops.norm import _instance_norm_xla, instance_norm_relu_pad
+from cyclegan_tpu.ops.padding import reflect_pad
+from cyclegan_tpu.ops.pallas import vmem
+from cyclegan_tpu.ops.pallas.epilogue_kernel import (
+    epilogue_eligible,
+    instance_norm_relu_pad_pallas,
+)
+
+
+def _rand(shape, seed=0, dtype=jnp.float32):
+    k = jax.random.PRNGKey(seed)
+    return (jax.random.normal(k, shape) * 2 + 0.5).astype(dtype)
+
+
+def _reference(x, scale, bias, pad, eps=1e-3):
+    return reflect_pad(jax.nn.relu(_instance_norm_xla(x, scale, bias, eps)), pad)
+
+
+# Shapes chosen to hit the cases that break naive reflection code:
+# batches > 1, non-square H != W (axis mix-ups), pad=3 (multi-row
+# mirror bands), odd extents (edge taps land off the tile boundary),
+# and channel counts below/at the 128-lane tile.
+SHAPES = [
+    ((2, 8, 8, 128), 1),
+    ((1, 16, 16, 64), 1),
+    ((1, 6, 10, 32), 1),
+    ((2, 5, 7, 16), 1),
+    ((1, 8, 8, 128), 3),
+    ((2, 7, 9, 8), 3),
+]
+
+
+@pytest.mark.parametrize("shape,pad", SHAPES)
+def test_epilogue_forward_matches_reference(shape, pad):
+    c = shape[-1]
+    x = _rand(shape)
+    scale = _rand((c,), 1)
+    bias = _rand((c,), 2)
+    got = instance_norm_relu_pad_pallas(x, scale, bias, pad=pad, interpret=True)
+    want = _reference(x, scale, bias, pad)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_epilogue_padded_border_is_exact_reflection():
+    """The mirror bands must satisfy tf.pad REFLECT exactly: pad offset
+    d equals interior offset d, the border row/col itself never
+    repeated."""
+    x = _rand((1, 6, 7, 8), 3)
+    scale = _rand((8,), 1)
+    bias = _rand((8,), 2)
+    pad = 2
+    y = np.asarray(
+        instance_norm_relu_pad_pallas(x, scale, bias, pad=pad, interpret=True)
+    )
+    core = y[:, pad:-pad, pad:-pad, :]
+    np.testing.assert_array_equal(
+        y, np.pad(core, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                  mode="reflect")
+    )
+
+
+@pytest.mark.parametrize("shape,pad", SHAPES)
+def test_epilogue_backward_matches_reference(shape, pad):
+    c = shape[-1]
+    x = _rand(shape)
+    scale = _rand((c,), 1)
+    bias = _rand((c,), 2)
+
+    def loss_pallas(x, s, b):
+        y = instance_norm_relu_pad_pallas(x, s, b, pad=pad, interpret=True)
+        return jnp.sum(jnp.sin(y) * y)
+
+    def loss_ref(x, s, b):
+        y = _reference(x, s, b, pad)
+        return jnp.sum(jnp.sin(y) * y)
+
+    g_p = jax.grad(loss_pallas, argnums=(0, 1, 2))(x, scale, bias)
+    g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(x, scale, bias)
+    for a, b_, name in zip(g_p, g_r, ["dx", "dscale", "dbias"]):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=2e-4, atol=5e-5, err_msg=name
+        )
+
+
+def test_epilogue_bfloat16_forward_and_backward():
+    shape, pad = (2, 8, 8, 64), 1
+    x = _rand(shape, dtype=jnp.bfloat16)
+    scale = _rand((64,), 1)
+    bias = _rand((64,), 2)
+    got = instance_norm_relu_pad_pallas(x, scale, bias, pad=pad, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    want = _reference(x, scale, bias, pad)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=1e-2, atol=1e-2,
+    )
+
+    def loss(fn):
+        def inner(x, s, b):
+            y = fn(x, s, b)
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+        return inner
+
+    g_p = jax.grad(
+        loss(lambda x, s, b: instance_norm_relu_pad_pallas(
+            x, s, b, pad=pad, interpret=True)), argnums=(0, 1, 2)
+    )(x, scale, bias)
+    g_r = jax.grad(
+        loss(lambda x, s, b: _reference(x, s, b, pad)), argnums=(0, 1, 2)
+    )(x, scale, bias)
+    for a, b_, name in zip(g_p, g_r, ["dx", "dscale", "dbias"]):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b_, np.float32),
+            rtol=1e-2, atol=1e-2, err_msg=name,
+        )
+
+
+# --------------------------------------------------- eligibility gate
+
+
+def test_eligibility_is_dtype_aware():
+    # generator trunk at 256^2: eligible for BOTH dtypes
+    assert epilogue_eligible((1, 64, 64, 256), jnp.float32, 1)
+    assert epilogue_eligible((1, 64, 64, 256), jnp.bfloat16, 1)
+    # the boundary: 96x96 f32 blows the budget, bf16 halves it and fits
+    assert not epilogue_eligible((1, 96, 96, 128), jnp.float32, 1)
+    assert epilogue_eligible((1, 96, 96, 128), jnp.bfloat16, 1)
+    # outermost generator layer at 256^2: ineligible either way
+    assert not epilogue_eligible((1, 256, 256, 64), jnp.float32, 3)
+    assert not epilogue_eligible((1, 256, 256, 64), jnp.bfloat16, 3)
+    # reflection needs pad < min(H, W)
+    assert not epilogue_eligible((1, 3, 64, 8), jnp.float32, 3)
+    assert not epilogue_eligible((1, 64, 64), jnp.float32, 1)  # not 4-D
+
+
+def test_vmem_budget_accounting():
+    # the backward's three slabs (x + padded g + dx) gate eligibility
+    h = w = 64
+    assert vmem.epilogue_bytes(h, w, 1, 4) == (
+        (2 * h * w + (h + 2) * (w + 2)) * vmem.C_BLK * 4
+    )
+    # dtype-aware norm bounds: f32 keeps the historical 8192 limit,
+    # bf16 doubles it (the satellite fix: 4 B/element was assumed
+    # unconditionally)
+    assert vmem.norm_fwd_max_hw(4) == 8192
+    assert vmem.norm_fwd_max_hw(2) == 16384
+    # backward budgets agree with forward for every itemsize, so a
+    # Pallas-forward shape never falls back in the backward
+    for itemsize in (2, 4):
+        assert vmem.norm_bwd_max_hw(itemsize) == vmem.norm_fwd_max_hw(itemsize)
+
+
+def test_ineligible_shape_raises():
+    x = _rand((1, 128, 128, 8))
+    with pytest.raises(NotImplementedError):
+        instance_norm_relu_pad_pallas(
+            x, jnp.ones(8), jnp.zeros(8), pad=1, interpret=True
+        )
+
+
+# ----------------------------------------------------------- dispatch
+
+
+def test_dispatch_uses_xla_fallback_on_ineligible_shape():
+    """instance_norm_relu_pad on a shape past the slab budget must
+    return the XLA composition (same semantics), not raise."""
+    x = _rand((1, 128, 128, 8))  # hw=16384: past the f32 budget
+    scale = _rand((8,), 1)
+    bias = _rand((8,), 2)
+    got = instance_norm_relu_pad(x, scale, bias, pad=1)
+    want = _reference(x, scale, bias, 1)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_dispatch_impl_xla_skips_the_kernel():
+    x = _rand((1, 8, 8, 16))
+    scale = _rand((16,), 1)
+    bias = _rand((16,), 2)
+    got = instance_norm_relu_pad(x, scale, bias, pad=1, impl="xla")
+    want = _reference(x, scale, bias, 1)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("impl", ["auto", "pallas"])
+def test_dispatch_eligible_shape_matches_reference(impl):
+    x = _rand((2, 8, 8, 32))
+    scale = _rand((32,), 1)
+    bias = _rand((32,), 2)
+    got = instance_norm_relu_pad(x, scale, bias, pad=1, impl=impl)
+    want = _reference(x, scale, bias, 1)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_dispatch_grad_through_fallback_boundary():
+    """Gradients must flow through BOTH dispatch arms with the same
+    math: one shape served by the kernel, one by the composition."""
+    scale = _rand((8,), 1)
+    bias = _rand((8,), 2)
+    for shape in [(1, 8, 8, 8), (1, 128, 128, 8)]:
+        x = _rand(shape)
+
+        def loss(x, s, b):
+            return jnp.sum(instance_norm_relu_pad(x, s, b, pad=1) ** 2)
+
+        def loss_ref(x, s, b):
+            return jnp.sum(_reference(x, s, b, 1) ** 2)
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(x, scale, bias)
+        g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(x, scale, bias)
+        for a, b_ in zip(g, g_r):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=2e-4, atol=5e-5
+            )

@@ -103,6 +103,12 @@ def official_programs() -> list:
     add("sweep scan:b24zero", "scan", "bfloat16", 24, k=8, pad_mode="zero")
     add("sweep scan:b16fused", "scan", "bfloat16", 16, k=8,
         pad_impl="fused")
+    # chip_autorun's epilogue_sweep step (pad_impl="epilogue" — the
+    # Pallas trunk-epilogue program; Mosaic lowers against the local
+    # libtpu, same as the runner's forced local-compile registration).
+    # Dedups against the TPU_CONFIGS /epi row by signature.
+    add("sweep scan:b16epi", "scan", "bfloat16", 16, k=8,
+        pad_impl="epilogue")
     add("sweep accum:b1k8i512", "accum", "bfloat16", 1, image=512, k=8,
         accum=8)
     add("sweep scan:b4k2i512", "scan", "bfloat16", 4, image=512, k=2)

@@ -15,7 +15,10 @@ rules over the hot-path files:
    `sanctioned-fetch` marker comment — the deferred fetches the loop's
    design already requires (backpressure window, end-of-epoch drain).
    In `cyclegan_tpu/obs/` there are no sanctioned sites at all:
-   telemetry only timestamps fetches the loop performs.
+   telemetry only timestamps fetches the loop performs. Likewise every
+   kernel wrapper under `cyclegan_tpu/ops/pallas/` (scanned as a
+   directory): they run INSIDE the fused train step, where any host
+   sync would serialize the dispatch pipeline.
 
 Comments and docstrings are exempt (they may DISCUSS the forbidden
 calls); only code can violate. Runs in tier-1 via
@@ -47,6 +50,31 @@ HOT_PATH_FILES: List[Tuple[str, bool]] = [
     ("cyclegan_tpu/obs/telemetry.py", False),
     ("cyclegan_tpu/obs/watchdog.py", False),
 ]
+
+# Directories whose EVERY .py file is hot-path, with no sanctioned
+# fetch sites: the Pallas kernel wrappers run inside the fused train
+# step — a host sync there would serialize every dispatch. Scanned as a
+# directory (not a file list) so a new kernel module is covered the day
+# it lands.
+HOT_PATH_DIRS: List[Tuple[str, bool]] = [
+    ("cyclegan_tpu/ops/pallas", False),
+]
+
+
+def hot_path_entries(repo: str = REPO) -> List[Tuple[str, bool]]:
+    """The static file list plus every .py under the hot-path dirs. A
+    missing directory is reported as a missing file entry (the check
+    must fail loudly, not silently shrink)."""
+    entries = list(HOT_PATH_FILES)
+    for rel, allow in HOT_PATH_DIRS:
+        d = os.path.join(repo, rel)
+        if not os.path.isdir(d):
+            entries.append((rel, allow))
+            continue
+        for name in sorted(os.listdir(d)):
+            if name.endswith(".py"):
+                entries.append((os.path.join(rel, name), allow))
+    return entries
 
 
 def _code_lines(source: str) -> dict:
@@ -98,7 +126,7 @@ def check_file(path: str, allow_sanctioned: bool) -> List[str]:
 
 def run_check(repo: str = REPO) -> List[str]:
     violations: List[str] = []
-    for rel, allow in HOT_PATH_FILES:
+    for rel, allow in hot_path_entries(repo):
         path = os.path.join(repo, rel)
         if not os.path.exists(path):
             violations.append(f"{rel}: hot-path file missing")
@@ -114,7 +142,7 @@ def main() -> int:
         for v in violations:
             print(f"  {v}", file=sys.stderr)
         return 1
-    n = len(HOT_PATH_FILES)
+    n = len(hot_path_entries())
     print(f"no-sync check passed: {n} hot-path files clean "
           f"(block_until_ready absent; device_get only at "
           f"sanctioned-fetch sites)")

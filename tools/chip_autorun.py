@@ -9,6 +9,7 @@ confirmation poll, run docs/TPU_RUNBOOK.md's queue as one supervised
 session:
 
     diag -> bench cold -> bench warm -> pad A/B sweep (zero/fused)
+    -> epilogue sweep (pad_impl=epilogue, local-compile forced)
     -> accum 512^2 row -> 512^2 scan rows -> profiler trace
     -> timed main.py run
 
@@ -28,7 +29,10 @@ Ground rules enforced (TPU_RUNBOOK "learned the hard way"):
     the remote leg). Hitting one means the tunnel is already wedged;
     the step is killed, the kill logged loudly, and the QUEUE ABORTS —
     no further clients are started against a sick relay.
-  - XLA-only programs: no step enables pallas (ground rule 2b).
+  - no Mosaic through the remote-compile leg (ground rule 2b): the
+    only pallas-bearing step (epilogue_sweep) forces the local-compile
+    registration so its Mosaic programs build against the in-image
+    libtpu; every other step is XLA-only.
   - local-compile fallback: :8082+:8083 up with :8093 down runs every
     step under PALLAS_AXON_POOL_IPS= CYCLEGAN_AXON_LOCAL_COMPILE=1
     (compiles against the in-image libtpu; the persistent cache makes
@@ -164,6 +168,18 @@ def build_queue(mode: str, round_tag: str = ROUND_TAG) -> list:
         Step("pad_sweep",
              [py, "tools/chip_sweep.py", "scan:b16zero", "scan:b24zero",
               "scan:b16fused"], 3600.0, env=env, artifacts=[sweeps]),
+        # The parity pad-gap contender (pad_impl="epilogue"): the trunk
+        # IN>ReLU>reflect-pad chains as one Pallas kernel. A Mosaic
+        # program, so this step ALWAYS forces the local-compile
+        # registration regardless of mode — ground rule 2b: Mosaic never
+        # crosses the remote-compile leg (docs/TUNNEL_POSTMORTEM.md
+        # incident 2). In a remote window whose :8083 leg is down the
+        # sweep records an error row and the queue continues.
+        Step("epilogue_sweep",
+             [py, "tools/chip_sweep.py", "scan:b16epi"], 2700.0,
+             env={**env, "PALLAS_AXON_POOL_IPS": "",
+                  "CYCLEGAN_AXON_LOCAL_COMPILE": "1"},
+             artifacts=[sweeps]),
         # 512^2 HBM-relief rows (runbook item 5): accum 8x1 (the
         # certified memory contract) and the plain/zero 512 scans.
         Step("accum512", [py, "tools/chip_sweep.py", "accum:b1k8i512"],

@@ -9,12 +9,16 @@ so the record is regenerable:
     python tools/chip_sweep.py scan:b8 scan:b24 scan:b32 scan:b16k16
 
 Spec grammar:
-<scan|dispatch|accum>:b<batch>[k<K>][pallas][zero|fused][pf][i<image>]
+<scan|dispatch|accum>:b<batch>[k<K>][pallas][zero|fused|epi][pf][i<image>]
 — parts in that order; k defaults to 8 for scan / 1 for dispatch, image
 to 256; `zero` selects pad_mode="zero" (conv built-in SAME padding, the
 compiler-certified −32% traffic variant — docs/BENCHMARKS.md pad-probe);
 `fused` selects pad_impl="fused" (ReflectConv: reflect SEMANTICS without
 materialized pads — the parity-preserving variant of the same lever);
+`epi` selects pad_impl="epilogue" (the fused scheduling PLUS the trunk
+IN>ReLU>reflect-pad chains collapsed into the Pallas epilogue kernel —
+ops/pallas/epilogue_kernel.py; a Mosaic program, so it is gated like
+`pallas` specs below);
 `pf` (dispatch only) stages inputs via the device-prefetch worker — the
 round-4 real-loop contract (`--prefetch_batches`), same XLA program as
 the plain dispatch spec.
@@ -29,11 +33,14 @@ spec refused off-CPU — is recorded as an error row and the sweep
 continues; only a malformed spec or a corrupt record file aborts (both
 before any compile).
 
-`pallas` specs are REFUSED off the CPU backend unless
-CYCLEGAN_ALLOW_PALLAS_REMOTE=1: remote-compiling the Mosaic program
-hung the compile service and cost the session its tunnel
-(docs/TUNNEL_POSTMORTEM.md incident 2, runbook ground rule 2b). The
-kernel's characterization lives in docs/aot_analysis.json instead.
+`pallas` and `epi` specs carry Mosaic programs and are REFUSED off the
+CPU backend unless compiles are LOCAL (CYCLEGAN_AXON_LOCAL_COMPILE=1 —
+Mosaic compiles against the in-image libtpu and never touches the
+remote-compile service) or CYCLEGAN_ALLOW_PALLAS_REMOTE=1:
+remote-compiling the Mosaic program hung the compile service and cost
+the session its tunnel (docs/TUNNEL_POSTMORTEM.md incident 2, runbook
+ground rule 2b). The norm kernel's characterization lives in
+docs/aot_analysis.json instead.
 """
 
 from __future__ import annotations
@@ -51,7 +58,7 @@ RECORD_PATH = os.environ.get("CYCLEGAN_SWEEP_RECORD") or os.path.join(
     "docs", "bench_sweeps.json")
 
 SPEC_RE = re.compile(
-    r"(scan|dispatch|accum):b(\d+)(?:k(\d+))?(pallas)?(zero|fused)?(pf)?"
+    r"(scan|dispatch|accum):b(\d+)(?:k(\d+))?(pallas)?(zero|fused|epi)?(pf)?"
     r"(?:i(\d+))?")
 
 
@@ -72,7 +79,7 @@ def parse_spec(spec: str):
         bool(m.group(4)), bool(m.group(6)),
         int(m.group(7)) if m.group(7) else 256)
     pad_mode = "zero" if pad_word == "zero" else "reflect"
-    pad_impl = "fused" if pad_word == "fused" else "pad"
+    pad_impl = {"fused": "fused", "epi": "epilogue"}.get(pad_word, "pad")
     if batch < 1 or image < 1 or (k is not None and k < 1):
         raise SystemExit(f"bad spec: {spec} (batch/k/image must be >= 1)")
     if prefetch and mode != "dispatch":
@@ -116,6 +123,13 @@ def _pallas_blocked() -> str | None:
     the tunnel. Reading the config does not initialize a backend."""
     if os.environ.get("CYCLEGAN_ALLOW_PALLAS_REMOTE") == "1":
         return None
+    from cyclegan_tpu.utils.axon_compat import local_compile_requested
+
+    if local_compile_requested():
+        # Local-compile mode builds every program (Mosaic included)
+        # against the in-image libtpu; nothing crosses the
+        # remote-compile leg, so pallas/epi specs are safe to run.
+        return None
     import jax
 
     effective = str(getattr(jax.config, "jax_platforms", None) or "")
@@ -139,7 +153,10 @@ def run_spec(spec: str) -> None:
 
     t0 = time.perf_counter()
     rec = {"key": spec, "ts": time.strftime("%Y-%m-%dT%H:%MZ", time.gmtime())}
-    blocked = _pallas_blocked() if pallas else None
+    # `epi` specs compile the Mosaic epilogue kernel — same refusal gate
+    # as explicit `pallas` specs (ground rule 2b).
+    blocked = (_pallas_blocked()
+               if (pallas or pad_impl == "epilogue") else None)
     if blocked:
         # A refusal is a RECORDED result, like an OOM: it costs no
         # compile, and aborting here would silently drop the remaining
