@@ -1,0 +1,386 @@
+"""Benchmark: CycleGAN serving throughput/latency on one chip.
+
+Measures the serve/ pipeline (engine + micro-batcher + pipelined
+executor) against the historical translate.py serial loop — decode a
+chunk, one jit call, BLOCKING np.asarray, PNG encode — at the same
+batch bucket and resolution, with the same per-image decode + encode
+work on both paths, so the delta is purely pipeline overlap + the
+skipped cycle pass. Then sweeps offered load (requests/sec) to map the
+latency/throughput curve: p50/p95/p99 end-to-end latency per load, and
+the saturated sustained images/sec.
+
+Methodology notes:
+- Both paths run the SINGLE-pass forward program (the translate.py
+  default since the cycle-pass satellite fix) — the serial baseline is
+  the fixed loop, not the historical double-FLOPs one, so the reported
+  speedup understates the win over the pre-fix CLI.
+- "Sustained" = closed-loop saturation: a producer submits as fast as
+  decode allows, the executor's bounded in-flight window paces it.
+- The load sweep is open-loop: requests arrive on a timer at the target
+  rate; a rate the pipeline cannot sustain shows as queue growth and a
+  latency blow-up — the honest serving curve.
+- p95 at LOW offered load should sit near one bucket's compute time +
+  the micro-batcher max-wait budget (acceptance bound; the low-load
+  row's p95 is emitted as `latency_low_load_ms.p95`).
+
+Prints ONE JSON line to stdout (the bench.py contract); per-config
+detail goes to stderr. Emits the same JSONL obs schema as training
+under BENCH_OBS_JSONL. Runs on whatever backend JAX_PLATFORMS selects;
+on CPU the workload auto-shrinks (tiny model, small images) so the line
+lands inside the budget — flagged platform="cpu", a plumbing liveness
+signal, not a chip number.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+
+from cyclegan_tpu.utils.platform import (
+    enable_compilation_cache,
+    ensure_platform_from_env,
+)
+
+ensure_platform_from_env()
+enable_compilation_cache()
+
+TIME_BUDGET_S = float(os.environ.get("BENCH_SERVE_TIME_BUDGET_S", "480"))
+
+_OBS_LOGGER = None
+
+
+def _obs_event(kind: str, **fields) -> None:
+    if _OBS_LOGGER is not None:
+        try:
+            _OBS_LOGGER.event(kind, **fields)
+            _OBS_LOGGER.flush()
+        except Exception:
+            pass
+
+
+def _obs_open() -> None:
+    global _OBS_LOGGER
+    path = os.environ.get("BENCH_OBS_JSONL")
+    if not path:
+        return
+    try:
+        from cyclegan_tpu.obs import MetricsLogger, build_manifest
+
+        _OBS_LOGGER = MetricsLogger(path)
+        _OBS_LOGGER.event("manifest", **build_manifest(
+            None, query_devices=False, role="bench_serve"))
+    except Exception:
+        _OBS_LOGGER = None
+
+
+def _percentile(vals, q):
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[idx]
+
+
+def _synthetic_images(n: int, size: int) -> list:
+    """Deterministic uint8 'uploads' at a size that exercises the
+    decode-stage resize (off-bucket, like real user images)."""
+    rng = np.random.RandomState(0)
+    return [rng.randint(0, 255, (size + 24, size + 8, 3), np.uint8)
+            for _ in range(n)]
+
+
+def _encode(img_float: np.ndarray) -> int:
+    """The encode stage both paths pay: [-1,1] float -> PNG bytes.
+    Falls back to uint8 quantization alone if PIL is absent."""
+    from cyclegan_tpu.utils.plotting import to_uint8
+
+    arr = to_uint8(img_float)
+    try:
+        from PIL import Image
+    except ImportError:
+        return arr.nbytes
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getbuffer().nbytes
+
+
+def _build(model_cfg):
+    """Random-init generator params (bench contract: program identity,
+    not checkpoint quality — same as bench.py's create_state)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cyclegan_tpu.serve.engine import build_generator
+
+    gen = build_generator(model_cfg)
+    dummy = jnp.zeros((1, model_cfg.image_size, model_cfg.image_size, 3),
+                      jnp.float32)
+    return gen.init(jax.random.PRNGKey(0), dummy)
+
+
+def bench_serial(model_cfg, fwd_params, images, batch: int,
+                 dtype: str) -> float:
+    """The pre-engine translate.py loop: decode chunk -> jit -> blocking
+    fetch -> encode, one thread, device idle through decode/encode."""
+    import jax
+
+    from cyclegan_tpu.serve.engine import forward_fn, preprocess_request
+
+    size = model_cfg.image_size
+    import dataclasses
+
+    fwd = jax.jit(forward_fn(
+        dataclasses.replace(model_cfg, compute_dtype=dtype),
+        with_cycle=False))
+    # Warmup compile outside the timed region (the engine's AOT startup
+    # is likewise untimed).
+    warm = np.zeros((batch, size, size, 3), np.float32)
+    np.asarray(fwd(fwd_params, warm))
+    t0 = time.perf_counter()
+    for lo in range(0, len(images), batch):
+        chunk = images[lo:lo + batch]
+        x = np.stack([preprocess_request(im, size) for im in chunk])
+        pad = batch - len(chunk)
+        if pad:
+            x = np.concatenate(
+                [x, np.zeros((pad,) + x.shape[1:], np.float32)])
+        fake = np.asarray(fwd(fwd_params, x))  # the blocking fetch
+        for j in range(len(chunk)):
+            _encode(fake[j])
+    return len(images) / (time.perf_counter() - t0)
+
+
+def bench_engine_saturated(executor, images) -> dict:
+    """Closed-loop saturation: submit as fast as decode allows; the
+    bounded in-flight window paces the producer. Returns sustained
+    imgs/sec + latency percentiles over the run."""
+    lats = []
+    done = []
+    t0 = time.perf_counter()
+    for im in images:
+        fut = executor.submit_raw(im)
+        done.append((fut, time.perf_counter()))
+    for fut, t_sub in done:
+        res = fut.result(timeout=600)
+        _encode(res["fake"])
+        lats.append(time.perf_counter() - t_sub)
+    wall = time.perf_counter() - t0
+    return {
+        "images_per_sec": len(images) / wall,
+        "p50_ms": _percentile(lats, 0.5) * 1e3,
+        "p95_ms": _percentile(lats, 0.95) * 1e3,
+        "p99_ms": _percentile(lats, 0.99) * 1e3,
+    }
+
+
+def bench_engine_open_loop(executor, images, rate: float) -> dict:
+    """Open-loop offered load: submit on a timer at `rate` req/s from a
+    producer thread; consumers encode as futures resolve. Latency here
+    includes any queueing the pipeline could not hide."""
+    results = []
+    lock = threading.Lock()
+
+    def consume(fut, t_sub):
+        res = fut.result(timeout=600)
+        _encode(res["fake"])
+        with lock:
+            results.append(time.perf_counter() - t_sub)
+
+    threads = []
+    t0 = time.perf_counter()
+    for i, im in enumerate(images):
+        target = t0 + i / rate
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        t_sub = time.perf_counter()
+        fut = executor.submit_raw(im)
+        th = threading.Thread(target=consume, args=(fut, t_sub),
+                              daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=600)
+    wall = time.perf_counter() - t0
+    return {
+        "offered_rate": rate,
+        "achieved_images_per_sec": len(results) / wall,
+        "p50_ms": _percentile(results, 0.5) * 1e3,
+        "p95_ms": _percentile(results, 0.95) * 1e3,
+        "p99_ms": _percentile(results, 0.99) * 1e3,
+    }
+
+
+def _emit(line: dict) -> None:
+    _obs_event("bench_serve_summary", **line)
+    print(json.dumps(line), flush=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--image", type=int, default=256)
+    ap.add_argument("--batch", type=int, default=8,
+                    help="batch bucket (the acceptance config is 8)")
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"],
+                    help="serving dtype; f32 matches the serial "
+                         "baseline's historical path, bf16 is the chip "
+                         "fast path")
+    ap.add_argument("--n", type=int, default=None,
+                    help="images per measurement (default: scaled to "
+                         "platform)")
+    ap.add_argument("--max_wait_ms", type=float, default=5.0)
+    ap.add_argument("--skip_sweep", action="store_true",
+                    help="saturation + serial only (quick mode)")
+    args = ap.parse_args(argv)
+    t_start = time.perf_counter()
+    _obs_open()
+
+    emitted = [False]
+    emit_lock = threading.Lock()
+    partial_line = {
+        "metric": "cyclegan_serve_images_per_sec_1chip", "value": 0.0,
+        "unit": "images/sec", "error": "no measurement completed",
+        "partial": True,
+    }
+
+    def emit_once(line) -> bool:
+        with emit_lock:
+            if emitted[0]:
+                return False
+            emitted[0] = True
+        _emit(line)
+        return True
+
+    def on_kill(signum, frame):
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        signal.signal(signal.SIGALRM, signal.SIG_IGN)
+        if emit_once(dict(partial_line)):
+            os._exit(0)
+
+    signal.signal(signal.SIGTERM, on_kill)
+    signal.signal(signal.SIGALRM, on_kill)
+    signal.alarm(max(0, int(TIME_BUDGET_S) + 120))
+
+    import jax
+
+    from cyclegan_tpu.config import GeneratorConfig, ModelConfig
+    from cyclegan_tpu.serve.engine import (
+        InferenceEngine,
+        ServeConfig,
+        serve_model_config,
+    )
+    from cyclegan_tpu.serve.executor import PipelinedExecutor
+
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu and args.image == 256 and args.n is None:
+        # A 256^2 forward takes ~seconds/image on host cores; shrink to a
+        # toy geometry so the full harness path still runs end-to-end.
+        print("[bench_serve] cpu backend: shrinking to toy geometry "
+              "(plumbing measurement, not chip numbers)",
+              file=sys.stderr, flush=True)
+        args.image, args.batch = 64, 4
+        model_cfg = ModelConfig(
+            generator=GeneratorConfig(filters=8, num_residual_blocks=2),
+            image_size=args.image, compute_dtype=args.dtype)
+        n = args.n or 24
+    else:
+        model_cfg = serve_model_config(args.dtype, args.image)
+        n = args.n or 64
+    platform = jax.default_backend()
+    key = f"serve/{args.dtype}/b{args.batch}/i{args.image}"
+    partial_line["config"] = key
+    partial_line["platform"] = platform
+
+    say = lambda m: print(f"[bench_serve] {m}", file=sys.stderr, flush=True)
+    say(f"{key}: building params + compiling programs")
+    fwd_params = _build(model_cfg)
+    engine = InferenceEngine(
+        model_cfg, fwd_params, bwd_params=None,
+        serve_cfg=ServeConfig(batch_buckets=tuple(sorted({1, args.batch})),
+                              sizes=(args.image,), dtype=args.dtype,
+                              with_cycle=False))
+    executor = PipelinedExecutor(engine, max_batch=args.batch,
+                                 max_wait_ms=args.max_wait_ms,
+                                 logger=_OBS_LOGGER)
+    images = _synthetic_images(n, args.image)
+
+    # 1) serial baseline (the pre-engine translate.py loop)
+    serial_ips = bench_serial(model_cfg, fwd_params, images, args.batch,
+                              args.dtype)
+    say(f"{key}: serial loop {serial_ips:.2f} images/sec")
+    _obs_event("bench", key=key + "/serial",
+               images_per_sec=round(serial_ips, 4), platform=platform)
+
+    # 2) saturated engine throughput
+    sat = bench_engine_saturated(executor, images)
+    say(f"{key}: engine saturated {sat['images_per_sec']:.2f} images/sec "
+        f"(p95 {sat['p95_ms']:.0f} ms)")
+    _obs_event("bench", key=key + "/saturated",
+               images_per_sec=round(sat["images_per_sec"], 4),
+               platform=platform)
+
+    # 3) offered-load sweep: low / half / near-capacity of the measured
+    #    saturation rate. The LOW row carries the p95 acceptance bound
+    #    (single-bucket compute + max-wait budget).
+    sweep = []
+    if not args.skip_sweep:
+        cap = max(sat["images_per_sec"], 1e-6)
+        for frac in (0.25, 0.5, 0.9):
+            if time.perf_counter() - t_start > TIME_BUDGET_S:
+                say(f"load sweep truncated (budget {TIME_BUDGET_S:.0f}s)")
+                break
+            rate = max(cap * frac, 0.5)
+            row = bench_engine_open_loop(executor, images, rate)
+            row["load_fraction"] = frac
+            sweep.append(row)
+            say(f"{key}: offered {rate:.2f}/s -> "
+                f"p50 {row['p50_ms']:.0f} / p95 {row['p95_ms']:.0f} / "
+                f"p99 {row['p99_ms']:.0f} ms")
+            _obs_event("bench", key=f"{key}/load{frac}",
+                       images_per_sec=round(
+                           row["achieved_images_per_sec"], 4),
+                       platform=platform)
+
+    summary = executor.close()
+    line = {
+        "metric": "cyclegan_serve_images_per_sec_1chip",
+        "value": round(sat["images_per_sec"], 2),
+        "unit": "images/sec",
+        "config": key,
+        "platform": platform,
+        "serial_images_per_sec": round(serial_ips, 2),
+        "speedup_vs_serial": round(sat["images_per_sec"]
+                                   / max(serial_ips, 1e-9), 3),
+        "latency_saturated_ms": {k: round(sat[k], 1)
+                                 for k in ("p50_ms", "p95_ms", "p99_ms")},
+        "n_images": n,
+        "n_flushes": summary.get("n_flushes"),
+        "max_queue_depth": summary.get("max_queue_depth"),
+    }
+    if sweep:
+        line["load_sweep"] = [
+            {k: (round(v, 3) if isinstance(v, float) else v)
+             for k, v in row.items()} for row in sweep]
+        line["latency_low_load_ms"] = {
+            k: round(sweep[0][k], 1) for k in ("p50_ms", "p95_ms", "p99_ms")}
+    if platform != "tpu":
+        line["note"] = ("Non-TPU backend — plumbing numbers at toy "
+                        "geometry, not chip numbers; chip methodology in "
+                        "docs/BENCHMARKS.md.")
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    signal.signal(signal.SIGALRM, signal.SIG_IGN)
+    emit_once(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

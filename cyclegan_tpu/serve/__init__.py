@@ -1,0 +1,52 @@
+"""Throughput-first inference engine (the serving workload).
+
+Three layers, one per latency-hiding trick:
+
+- engine.py    — AOT-bucketed programs: every (resolution bucket,
+                 batch bucket, dtype) generator forward compiled at
+                 startup; zero-padded ragged tails; donated input
+                 buffers; optional bf16 path over f32 params.
+- batcher.py   — dynamic micro-batching: flush on max-batch or
+                 max-wait, so sparse traffic bounds latency and heavy
+                 traffic fills buckets.
+- executor.py  — the pipeline: decode || dispatch || deferred D2H ||
+                 encode with bounded in-flight backpressure (the
+                 train/loop.py discipline) and obs JSONL events.
+
+server.py is a stdlib HTTP front-end; translate.py (repo root) is the
+batch-CLI front-end; bench_serve.py sweeps offered load into
+latency/throughput numbers. tools/check_no_sync.py scans this package
+as hot-path (deferred fetches only at sanctioned-fetch sites).
+"""
+
+from cyclegan_tpu.serve.batcher import MicroBatcher, Request
+from cyclegan_tpu.serve.engine import (
+    DEFAULT_BATCH_BUCKETS,
+    DEFAULT_SIZES,
+    InferenceEngine,
+    ServeConfig,
+    build_generator,
+    forward_fn,
+    lower_forward,
+    param_specs,
+    preprocess_request,
+    serve_model_config,
+)
+from cyclegan_tpu.serve.executor import MAX_IN_FLIGHT, PipelinedExecutor
+
+__all__ = [
+    "DEFAULT_BATCH_BUCKETS",
+    "DEFAULT_SIZES",
+    "InferenceEngine",
+    "MAX_IN_FLIGHT",
+    "MicroBatcher",
+    "PipelinedExecutor",
+    "Request",
+    "ServeConfig",
+    "build_generator",
+    "forward_fn",
+    "lower_forward",
+    "param_specs",
+    "preprocess_request",
+    "serve_model_config",
+]

@@ -1,0 +1,274 @@
+"""AOT-bucketed generator inference programs.
+
+The serving hot path must never trace, compile, or retrace once traffic
+is flowing: every admissible program — one generator forward (or the
+fused forward+cycle two-pass when panels are requested) per
+(resolution bucket, batch bucket, dtype) — is lowered and compiled UP
+FRONT via the same AOT ``.lower().compile()`` story
+``tools/cache_warm.py`` uses for the training programs, against the
+persistent compile cache, so a warm container pays zero compiles at
+first request. Ragged request tails are zero-padded to the bucket's
+static batch (the training pipeline's weight-mask convention: padded
+rows are dead weight the caller discards — data/pipeline.py), so
+exactly one XLA program per bucket ever exists.
+
+Input buffers are donated: the forward's output has the input's shape
+and dtype, so XLA reuses the request buffer's HBM for the result
+instead of allocating a second image slab per flush.
+
+The bf16 path reuses the SAME float32 params (flax compute-dtype
+casting, exactly like training's compute_dtype="bfloat16"); outputs are
+cast back to float32 inside the program so both paths hand the encoder
+identical dtypes. tests/test_serve.py pins bf16 against f32 output
+tolerance.
+
+No host-device synchronization lives here: ``run`` returns DEVICE
+arrays; the pipelined executor (serve/executor.py) owns the deferred
+D2H fetch. tools/check_no_sync.py scans this directory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+# The bucket grammar served by default (and warmed by tools/cache_warm.py
+# so a fresh chip lease compiles serve programs offline, not at first
+# request): batch buckets are flush sizes the micro-batcher may emit —
+# a singleton bucket keeps low-load latency at one image's compute;
+# sizes are the resolutions requests are resized into.
+DEFAULT_BATCH_BUCKETS: Tuple[int, ...] = (1, 8)
+DEFAULT_SIZES: Tuple[int, ...] = (256,)
+
+
+def build_generator(model_cfg):
+    """The generator module serving applies — the SAME constructor
+    train/state.py:build_models uses, so a training checkpoint's param
+    tree applies unchanged."""
+    import jax.numpy as jnp
+
+    from cyclegan_tpu.models import ResNetGenerator
+
+    dtype = jnp.bfloat16 if model_cfg.compute_dtype == "bfloat16" else None
+    return ResNetGenerator(
+        config=model_cfg.generator,
+        out_channels=model_cfg.channels,
+        dtype=dtype,
+        remat=model_cfg.remat,
+        scan_blocks=model_cfg.scan_blocks,
+        norm_impl=model_cfg.instance_norm_impl,
+        pad_mode=model_cfg.pad_mode,
+        pad_impl=model_cfg.pad_impl,
+    )
+
+
+def forward_fn(model_cfg, with_cycle: bool):
+    """The python callable every serve program traces. Shared with
+    tools/cache_warm.py so offline warming lowers the byte-for-byte
+    identical HLO the engine requests at startup (the bench._config_for
+    contract, applied to serving).
+
+    with_cycle=False is the default serving program: ONE generator pass
+    (translate.py historically always ran the cycle generator too —
+    pure waste without --panels, half the inference FLOPs). True fuses
+    both passes into one program for panel requests.
+    """
+    import jax.numpy as jnp
+
+    gen = build_generator(model_cfg)
+
+    if with_cycle:
+        def fwd(fwd_params, bwd_params, x):
+            fake = gen.apply(fwd_params, x)
+            cycled = gen.apply(bwd_params, fake)
+            return fake.astype(jnp.float32), cycled.astype(jnp.float32)
+    else:
+        def fwd(fwd_params, x):
+            return gen.apply(fwd_params, x).astype(jnp.float32)
+
+    return fwd
+
+
+def lower_forward(model_cfg, fwd_params, bwd_params, batch: int, size: int,
+                  with_cycle: bool):
+    """Lower the exact serve program for one (size, batch) bucket.
+    Params may be concrete arrays (engine startup) or ShapeDtypeStruct
+    trees (tools/cache_warm.py) — lowering only consumes avals, so both
+    produce the same program. The image buffer is donated (last arg)."""
+    import jax
+    import jax.numpy as jnp
+
+    fwd = forward_fn(model_cfg, with_cycle)
+    x = jax.ShapeDtypeStruct((batch, size, size, 3), jnp.float32)
+    if with_cycle:
+        return jax.jit(fwd, donate_argnums=(2,)).lower(
+            fwd_params, bwd_params, x)
+    return jax.jit(fwd, donate_argnums=(1,)).lower(fwd_params, x)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine-level knobs (the executor adds latency/backpressure ones).
+
+    ``dtype`` overrides the checkpoint's compute dtype for serving
+    (bf16 halves MXU time on chip; params stay float32 either way).
+    """
+
+    batch_buckets: Tuple[int, ...] = DEFAULT_BATCH_BUCKETS
+    sizes: Tuple[int, ...] = DEFAULT_SIZES
+    dtype: str = "float32"  # "float32" | "bfloat16"
+    with_cycle: bool = False
+
+    def __post_init__(self):
+        if self.dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"serve dtype must be 'float32' or "
+                             f"'bfloat16', got {self.dtype!r}")
+        if not self.batch_buckets or not self.sizes:
+            raise ValueError("serve buckets must be non-empty")
+        if any(b <= 0 for b in self.batch_buckets) or any(
+                s <= 0 for s in self.sizes):
+            raise ValueError("serve buckets must be positive")
+
+
+class InferenceEngine:
+    """All serve programs for one checkpoint, compiled at startup.
+
+    ``run`` is the entire device story: pick the batch bucket, zero-pad
+    the ragged tail, call the pre-compiled executable, hand back device
+    arrays + the valid count. No fetch, no sync, no compile."""
+
+    def __init__(self, model_cfg, fwd_params, bwd_params=None, *,
+                 serve_cfg: ServeConfig = ServeConfig(), logger=None):
+        if serve_cfg.with_cycle and bwd_params is None:
+            raise ValueError("with_cycle=True needs the cycle generator's "
+                             "params (bwd_params)")
+        # Serving dtype overrides the checkpoint's recorded compute
+        # dtype; the param tree is dtype-independent (flax casts at
+        # apply time), so the same weights serve both paths.
+        self.model_cfg = dataclasses.replace(
+            model_cfg, compute_dtype=serve_cfg.dtype)
+        self.serve_cfg = serve_cfg
+        self._fwd_params = fwd_params
+        self._bwd_params = bwd_params
+        self._logger = logger
+        self._batch_buckets = tuple(sorted(set(serve_cfg.batch_buckets)))
+        self._sizes = tuple(sorted(set(serve_cfg.sizes)))
+        # (size, batch) -> compiled executable. Populated ONCE, here:
+        # the serving loop never mutates this dict, so every later
+        # request is a dict hit on an already-compiled program.
+        self.programs: Dict[Tuple[int, int], Any] = {}
+        for size in self._sizes:
+            for batch in self._batch_buckets:
+                t0 = time.perf_counter()
+                self.programs[(size, batch)] = lower_forward(
+                    self.model_cfg, fwd_params, bwd_params, batch, size,
+                    serve_cfg.with_cycle,
+                ).compile()
+                self._event(
+                    "serve_compile", size=size, batch=batch,
+                    dtype=serve_cfg.dtype, with_cycle=serve_cfg.with_cycle,
+                    seconds=round(time.perf_counter() - t0, 3),
+                )
+
+    def _event(self, kind: str, **fields) -> None:
+        if self._logger is not None:
+            self._logger.event(kind, **fields)
+
+    # -- bucket grammar ---------------------------------------------------
+    @property
+    def max_batch(self) -> int:
+        return self._batch_buckets[-1]
+
+    def batch_bucket(self, n: int) -> Optional[int]:
+        """Smallest batch bucket holding n requests; None when n exceeds
+        the largest bucket (the caller splits the flush)."""
+        for b in self._batch_buckets:
+            if n <= b:
+                return b
+        return None
+
+    def size_bucket(self, h: int, w: int) -> int:
+        """Smallest resolution bucket covering an (h, w) request;
+        oversized requests clamp to the largest bucket (they are resized
+        DOWN rather than rejected — boundary behavior pinned by
+        tests/test_serve.py)."""
+        m = max(h, w)
+        for s in self._sizes:
+            if m <= s:
+                return s
+        return self._sizes[-1]
+
+    # -- the device call --------------------------------------------------
+    def run(self, batch_np: np.ndarray, size: Optional[int] = None):
+        """Dispatch one flush. ``batch_np``: float32 [n, size, size, 3],
+        n <= max_batch, already preprocessed to a size bucket. Returns
+        (outputs, n_valid): outputs is a tuple of DEVICE arrays —
+        (fake,) or (fake, cycled) — still padded to the bucket; the
+        first n_valid rows are real. The deferred fetch is the
+        executor's job."""
+        n = batch_np.shape[0]
+        if size is None:
+            size = batch_np.shape[1]
+        if (size, size) != batch_np.shape[1:3]:
+            raise ValueError(
+                f"flush shape {batch_np.shape[1:3]} does not match its "
+                f"size bucket {size} — preprocess before run()")
+        bucket = self.batch_bucket(n)
+        if bucket is None:
+            raise ValueError(
+                f"flush of {n} exceeds the largest batch bucket "
+                f"{self.max_batch} — the batcher must split it")
+        if (size, bucket) not in self.programs:
+            raise KeyError(
+                f"no compiled program for bucket (size={size}, "
+                f"batch={bucket}) — not in the engine's bucket grammar")
+        pad = bucket - n
+        if pad:
+            # Training's ragged-tail convention (data/pipeline.py): pad
+            # with zeros to the bucket's static shape, mask the dead
+            # rows — here the mask is simply n_valid, since inference
+            # has no weighted reduction to feed.
+            batch_np = np.concatenate(
+                [batch_np,
+                 np.zeros((pad,) + batch_np.shape[1:], np.float32)])
+        program = self.programs[(size, bucket)]
+        if self.serve_cfg.with_cycle:
+            outs = program(self._fwd_params, self._bwd_params, batch_np)
+        else:
+            outs = (program(self._fwd_params, batch_np),)
+        return outs, n
+
+
+def preprocess_request(img: np.ndarray, size: int) -> np.ndarray:
+    """Decode-stage preprocessing for one request: the SAME test-time
+    transform training/eval used (half-pixel-center bilinear resize +
+    [-1, 1] normalize — data/augment.py preprocess_test)."""
+    from cyclegan_tpu.data.augment import preprocess_test
+
+    return preprocess_test(np.asarray(img), size)
+
+
+def serve_model_config(dtype: str = "float32", image: int = 256):
+    """Default-architecture ModelConfig for serve program identity —
+    shared with tools/cache_warm.py (the bench._config_for contract):
+    what cache_warm warms must be byte-for-byte what bench_serve.py and
+    a default checkpoint's engine request."""
+    from cyclegan_tpu.config import ModelConfig
+
+    return ModelConfig(compute_dtype=dtype, image_size=image)
+
+
+def param_specs(model_cfg, sizes: Sequence[int]):
+    """ShapeDtypeStruct tree of generator params (no weights needed) —
+    the cache-warm path's stand-in for a real checkpoint. Param shapes
+    are resolution-independent, so any size from the grammar works."""
+    import jax
+    import jax.numpy as jnp
+
+    gen = build_generator(model_cfg)
+    dummy = jnp.zeros((1, sizes[0], sizes[0], 3), jnp.float32)
+    return jax.eval_shape(lambda r: gen.init(r, dummy),
+                          jax.random.PRNGKey(0))

@@ -1,0 +1,232 @@
+"""Pipelined serving executor: decode || H2D+compute || D2H || encode.
+
+The serial translate.py loop paid every stage on one thread: decode a
+chunk, dispatch, BLOCK on np.asarray, encode — the device idled through
+decode/encode and the host idled through compute. This executor splits
+the stages across threads exactly the way train/loop.py's dispatch
+pipeline does, with the same two disciplines:
+
+- **No per-item sync.** The batcher thread dispatches a flush and moves
+  on; device outputs queue as DEVICE arrays and a dedicated completer
+  thread performs the one deferred ``jax.device_get`` per flush
+  (sanctioned-fetch sites below — tools/check_no_sync.py scans this
+  directory). Because outputs data-depend on their flush, a fetch
+  completing at T proves the flush finished by T: per-flush device
+  latency comes free with the fetch the pipeline performs anyway
+  (the obs/stepclock.py argument, applied to serving).
+- **Bounded in-flight.** At most ``max_in_flight`` dispatched-but-
+  unfetched flushes exist (train/loop.py's MAX_IN_FLIGHT backpressure):
+  the dispatcher blocks past the window, so pinned request buffers stay
+  a bounded slice of HBM no matter how deep the request queue grows.
+
+Stage ownership: callers (CLI loop / server handler threads) run decode
+via ``submit_raw`` and encode on the resolved future — so decode and
+encode naturally overlap compute without a thread pool of their own.
+
+Telemetry (PR-1 JSONL schema, folded by tools/obs_report.py):
+``serve_flush`` per flush (fill, trigger, queue depth, queue-wait /
+device / e2e latency splits) and a ``serve_summary`` rollup at close
+(sustained imgs/sec, latency percentiles, queue-depth watermark).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from cyclegan_tpu.serve.batcher import MicroBatcher, Request
+from cyclegan_tpu.serve.engine import InferenceEngine, preprocess_request
+
+# Default bounded-in-flight window, in FLUSHES (each pins one bucket of
+# input images + one bucket of outputs): small enough that pinned serve
+# buffers stay a sliver of HBM, deep enough to hide D2H + encode behind
+# the next flushes' compute.
+MAX_IN_FLIGHT = 4
+
+_STOP = object()
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class PipelinedExecutor:
+    """Ties batcher -> engine -> completer into one serving pipeline."""
+
+    def __init__(self, engine: InferenceEngine, *,
+                 max_batch: Optional[int] = None,
+                 max_wait_ms: float = 5.0,
+                 max_in_flight: int = MAX_IN_FLIGHT,
+                 max_queue: int = 1024,
+                 logger=None):
+        self.engine = engine
+        self._logger = logger
+        max_batch = engine.max_batch if max_batch is None else max_batch
+        if engine.batch_bucket(max_batch) is None:
+            raise ValueError(
+                f"max_batch={max_batch} exceeds the engine's largest "
+                f"batch bucket {engine.max_batch}")
+        # One batcher per size bucket (created lazily): flushes are
+        # homogeneous in resolution so each maps to exactly one
+        # pre-compiled program.
+        self._batchers: Dict[int, MicroBatcher] = {}
+        self._batcher_lock = threading.Lock()
+        self._max_batch = max_batch
+        self._max_wait_s = max_wait_ms / 1000.0
+        self._max_queue = max_queue
+        self._inflight = threading.BoundedSemaphore(max_in_flight)
+        self._pending: "queue.Queue" = queue.Queue()
+        self._completer = threading.Thread(
+            target=self._complete_loop, daemon=True, name="serve-completer")
+        self._completer.start()
+        self._closed = False
+        # Rollup state (completer-thread writes, close() reads after join)
+        self._latencies: List[float] = []
+        self._n_done = 0
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+
+    # -- submission (decode stage runs on the caller's thread) ------------
+    def submit_raw(self, img: np.ndarray) -> Future:
+        """Decode-side entry: uint8/float HWC image of any size ->
+        preprocess into its resolution bucket, then queue."""
+        size = self.engine.size_bucket(img.shape[0], img.shape[1])
+        return self.submit(preprocess_request(img, size))
+
+    def submit(self, image: np.ndarray) -> Future:
+        """Queue one preprocessed float32 [s, s, 3] image (s must be a
+        resolution bucket). Returns a Future resolving to {"fake": ...}
+        (+ "cycled" when the engine fuses the cycle pass)."""
+        if self._closed:
+            raise RuntimeError("executor is closed")
+        size = int(image.shape[0])
+        return self._batcher_for(size).submit(Request(image, size))
+
+    def _batcher_for(self, size: int) -> MicroBatcher:
+        with self._batcher_lock:
+            b = self._batchers.get(size)
+            if b is None:
+                if (size, self.engine.batch_bucket(1)) not in \
+                        self.engine.programs:
+                    raise ValueError(
+                        f"size {size} is not a compiled resolution bucket "
+                        f"{tuple(sorted({s for s, _ in self.engine.programs}))}")
+                b = MicroBatcher(
+                    self._flush, self._max_batch, self._max_wait_s,
+                    max_queue=self._max_queue,
+                    name=f"serve-batcher-{size}")
+                self._batchers[size] = b
+            return b
+
+    # -- dispatch stage (batcher worker thread) ---------------------------
+    def _flush(self, batch: List[Request], trigger: str) -> None:
+        # Backpressure BEFORE staging: past the in-flight window the
+        # dispatcher blocks here, bounding pinned device buffers (the
+        # train-loop MAX_IN_FLIGHT discipline).
+        self._inflight.acquire()
+        try:
+            t0 = time.perf_counter()
+            x = np.stack([r.image for r in batch])
+            outs, n = self.engine.run(x, size=batch[0].size)
+            t_dispatched = time.perf_counter()
+        except BaseException:
+            self._inflight.release()
+            raise
+        self._pending.put((batch, outs, n, trigger, t0, t_dispatched))
+
+    # -- completion stage (D2H + future resolution) -----------------------
+    def _complete_loop(self) -> None:
+        import jax
+
+        while True:
+            item = self._pending.get()
+            if item is _STOP:
+                return
+            batch, outs, n, trigger, t0, t_dispatched = item
+            try:
+                t_fetch = time.perf_counter()
+                host = jax.device_get(outs)  # sanctioned-fetch: the pipeline's one deferred D2H per flush
+                t_done = time.perf_counter()
+            except BaseException as e:  # fetch failed: fail this flush only
+                self._inflight.release()
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                continue
+            self._inflight.release()
+            fake = host[0]
+            cycled = host[1] if len(host) > 1 else None
+            now = t_done
+            for i, r in enumerate(batch):
+                result = {"fake": fake[i]}
+                if cycled is not None:
+                    result["cycled"] = cycled[i]
+                if not r.future.done():
+                    r.future.set_result(result)
+            # Rollup + per-flush event. Latency anchors at submit time,
+            # so queue wait + batching wait + device + fetch all count.
+            lats = [now - r.t_submit for r in batch]
+            self._latencies.extend(lats)
+            self._n_done += n
+            if self._t_first is None:
+                self._t_first = t0
+            self._t_last = now
+            if self._logger is not None:
+                depth = self._batchers[batch[0].size].depth \
+                    if batch[0].size in self._batchers else 0
+                self._logger.event(
+                    "serve_flush",
+                    n=n, bucket=self.engine.batch_bucket(n),
+                    size=batch[0].size, trigger=trigger,
+                    queue_depth=depth,
+                    queue_wait_s=round(t0 - batch[0].t_submit, 6),
+                    dispatch_s=round(t_dispatched - t0, 6),
+                    fetch_block_s=round(t_done - t_fetch, 6),
+                    e2e_p50_s=round(_percentile(sorted(lats), 0.5), 6),
+                )
+
+    # -- shutdown ---------------------------------------------------------
+    def close(self) -> dict:
+        """Drain every stage, stop the threads, emit (and return) the
+        ``serve_summary`` rollup."""
+        if self._closed:
+            return {}
+        self._closed = True
+        for b in self._batchers.values():
+            b.close()
+        self._pending.put(_STOP)
+        self._completer.join(timeout=60.0)
+        wall = ((self._t_last - self._t_first)
+                if self._t_first is not None and self._t_last is not None
+                else 0.0)
+        lats = sorted(self._latencies)
+
+        def pct(q: float):
+            # None (JSON null), not NaN: the stream must stay parseable
+            # by strict JSON readers even for an empty run.
+            return round(_percentile(lats, q), 6) if lats else None
+
+        summary = {
+            "n_images": self._n_done,
+            "n_flushes": sum(b.n_flushes for b in self._batchers.values()),
+            "wall_s": round(wall, 6),
+            "images_per_sec": round(self._n_done / wall, 4) if wall > 0
+            else 0.0,
+            "latency_p50_s": pct(0.50),
+            "latency_p95_s": pct(0.95),
+            "latency_p99_s": pct(0.99),
+            "max_queue_depth": max(
+                (b.max_depth for b in self._batchers.values()), default=0),
+        }
+        if self._logger is not None:
+            self._logger.event("serve_summary", **summary)
+        return summary

@@ -1,0 +1,241 @@
+"""Lightweight HTTP front-end over the serving pipeline.
+
+Pure stdlib (http.server) on purpose: the container bakes no web
+framework, and the engine does the heavy lifting anyway — a handler
+thread only decodes the upload, submits to the PipelinedExecutor, and
+encodes the resolved result. ThreadingHTTPServer gives one thread per
+connection, which is exactly the decode/encode stage parallelism the
+executor's design assumes (serve/executor.py docstring).
+
+Endpoints:
+  POST /translate   image bytes (PNG/JPEG/any PIL format, or a raw
+                    .npy float array) -> translated PNG bytes.
+                    ?panels=1 additionally returns the
+                    [input | translated | cycled] panel when the engine
+                    was built with the fused cycle program.
+  GET  /healthz     200 once the engine's programs are compiled —
+                    readiness probe for a load balancer.
+  GET  /stats       JSON snapshot: requests served, queue depth.
+
+Run:
+  python -m cyclegan_tpu.serve.server --output_dir runs --port 8080 \
+      [--dtype bfloat16] [--batch_bucket 8] [--max_wait_ms 5] [--panels]
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+
+class ServeApp:
+    """The handler-visible application state: executor + counters."""
+
+    def __init__(self, executor, with_cycle: bool):
+        self.executor = executor
+        self.with_cycle = with_cycle
+        self.n_requests = 0
+        self.n_errors = 0
+        self._lock = threading.Lock()
+
+    def count(self, error: bool = False) -> None:
+        with self._lock:
+            self.n_requests += 1
+            if error:
+                self.n_errors += 1
+
+    def stats(self) -> dict:
+        depths = {str(s): b.depth
+                  for s, b in self.executor._batchers.items()}
+        return {"n_requests": self.n_requests, "n_errors": self.n_errors,
+                "queue_depths": depths}
+
+
+def _decode_upload(body: bytes) -> np.ndarray:
+    """Upload bytes -> HWC uint8/float image array."""
+    if body[:6] == b"\x93NUMPY":  # .npy magic
+        return np.load(io.BytesIO(body), allow_pickle=False)
+    from PIL import Image
+
+    return np.asarray(Image.open(io.BytesIO(body)).convert("RGB"))
+
+
+def _encode_png(img_float: np.ndarray) -> bytes:
+    """[-1, 1] float HWC -> PNG bytes (the encode stage)."""
+    from PIL import Image
+
+    from cyclegan_tpu.utils.plotting import to_uint8
+
+    buf = io.BytesIO()
+    Image.fromarray(to_uint8(img_float)).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def make_handler(app: ServeApp):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _reply(self, code: int, body: bytes,
+                   ctype: str = "application/json") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, b'{"status": "ok"}')
+            elif self.path == "/stats":
+                self._reply(200, json.dumps(app.stats()).encode())
+            else:
+                self._reply(404, b'{"error": "not found"}')
+
+        def do_POST(self):
+            path = self.path.split("?", 1)[0]
+            if path != "/translate":
+                self._reply(404, b'{"error": "not found"}')
+                return
+            want_panel = "panels=1" in (self.path.split("?", 1) + [""])[1]
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                img = _decode_upload(self.rfile.read(length))
+                # Decode runs HERE (handler thread), compute is batched
+                # across connections by the executor, encode runs here
+                # again once the future resolves — the pipeline stages
+                # of serve/executor.py.
+                result = app.executor.submit_raw(img).result(timeout=120)
+                if want_panel and "cycled" in result:
+                    size = result["fake"].shape[0]
+                    from cyclegan_tpu.serve.engine import preprocess_request
+
+                    panel = np.concatenate(
+                        [preprocess_request(img, size), result["fake"],
+                         result["cycled"]], axis=1)
+                    body = _encode_png(panel)
+                else:
+                    body = _encode_png(result["fake"])
+                app.count()
+                self._reply(200, body, ctype="image/png")
+            except Exception as e:  # noqa: BLE001 — a request must not kill the server
+                app.count(error=True)
+                self._reply(500, json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}).encode())
+
+    return Handler
+
+
+def make_server(executor, host: str = "127.0.0.1", port: int = 0,
+                with_cycle: bool = False):
+    """Build (but do not start) the HTTP server; port 0 picks a free
+    one (server.server_address reports it). Returns (server, app)."""
+    app = ServeApp(executor, with_cycle)
+    server = ThreadingHTTPServer((host, port), make_handler(app))
+    server.daemon_threads = True
+    return server, app
+
+
+def main(argv: Optional[list] = None) -> None:
+    from cyclegan_tpu.utils.platform import (
+        enable_compilation_cache,
+        ensure_platform_from_env,
+    )
+
+    ensure_platform_from_env()
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--output_dir", default="runs")
+    p.add_argument("--direction", default="AtoB", choices=["AtoB", "BtoA"])
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", default=8080, type=int)
+    p.add_argument("--image_size", default=None, type=int)
+    p.add_argument("--dtype", default=None,
+                   choices=["float32", "bfloat16"],
+                   help="serving compute dtype (default: the checkpoint's)")
+    p.add_argument("--batch_bucket", default=8, type=int,
+                   help="largest flush size (bucket grammar: {1, this})")
+    p.add_argument("--max_wait_ms", default=5.0, type=float,
+                   help="max ms a lone request waits for batch companions")
+    p.add_argument("--panels", action="store_true",
+                   help="compile the fused forward+cycle program so "
+                        "?panels=1 works (costs a second generator pass)")
+    p.add_argument("--obs_jsonl", default=None,
+                   help="telemetry stream path (PR-1 schema; fold with "
+                        "tools/obs_report.py)")
+    args = p.parse_args(argv)
+
+    from cyclegan_tpu.utils.axon_compat import cli_startup
+
+    cli_startup()
+    enable_compilation_cache()
+    import jax
+
+    from cyclegan_tpu.config import Config, TrainConfig
+    from cyclegan_tpu.serve.engine import InferenceEngine, ServeConfig
+    from cyclegan_tpu.serve.executor import PipelinedExecutor
+    from cyclegan_tpu.train import create_state
+    from cyclegan_tpu.utils.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(args.output_dir)
+    model_cfg = Config.model_from_cli_and_meta(
+        ckpt.read_meta(), image_size=args.image_size)
+    config = Config(model=model_cfg,
+                    train=TrainConfig(output_dir=args.output_dir))
+    state = create_state(config, jax.random.PRNGKey(config.train.seed))
+    state, _, resumed = ckpt.restore_for_cli(state)
+    if not resumed:
+        raise SystemExit(f"no checkpoint under {args.output_dir}/checkpoints")
+    fwd_params, bwd_params = (
+        (state.g_params, state.f_params) if args.direction == "AtoB"
+        else (state.f_params, state.g_params))
+
+    logger = None
+    if args.obs_jsonl:
+        from cyclegan_tpu.obs import MetricsLogger, build_manifest
+
+        logger = MetricsLogger(args.obs_jsonl)
+        logger.event("manifest",
+                     **build_manifest(config, query_devices=False,
+                                      role="serve"))
+
+    serve_cfg = ServeConfig(
+        batch_buckets=tuple(sorted({1, args.batch_bucket})),
+        sizes=(model_cfg.image_size,),
+        dtype=args.dtype or model_cfg.compute_dtype,
+        with_cycle=args.panels,
+    )
+    print(f"compiling {len(serve_cfg.batch_buckets) * len(serve_cfg.sizes)} "
+          f"serve programs (warm cache makes this instant — "
+          f"tools/cache_warm.py)...", flush=True)
+    engine = InferenceEngine(model_cfg, fwd_params, bwd_params,
+                             serve_cfg=serve_cfg, logger=logger)
+    executor = PipelinedExecutor(engine, max_wait_ms=args.max_wait_ms,
+                                 logger=logger)
+    server, _app = make_server(executor, args.host, args.port,
+                               with_cycle=args.panels)
+    host, port = server.server_address[:2]
+    print(f"serving on http://{host}:{port}  "
+          f"(buckets {serve_cfg.batch_buckets} @ {serve_cfg.sizes}, "
+          f"dtype {serve_cfg.dtype})", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        executor.close()
+        if logger is not None:
+            logger.event("end", status="completed")
+            logger.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,406 @@
+"""Serving engine (cyclegan_tpu/serve): bucket grammar, micro-batcher
+edge cases, ragged-tail padding, bf16 numerics, pipelined executor
+telemetry, and the HTTP front-end.
+
+All tier-1: tiny generator (filters=4, 1 residual block) at 16/32 px on
+the virtual CPU mesh, so every AOT program compiles in seconds and
+caches across runs (conftest compile cache).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from cyclegan_tpu.config import GeneratorConfig, ModelConfig  # noqa: E402
+from cyclegan_tpu.serve.batcher import MicroBatcher, Request  # noqa: E402
+from cyclegan_tpu.serve.engine import (  # noqa: E402
+    InferenceEngine,
+    ServeConfig,
+    build_generator,
+    preprocess_request,
+)
+from cyclegan_tpu.serve.executor import PipelinedExecutor  # noqa: E402
+
+
+def _tiny_model_cfg(dtype="float32"):
+    return ModelConfig(
+        generator=GeneratorConfig(filters=4, num_residual_blocks=1),
+        image_size=32,
+        compute_dtype=dtype,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    import jax
+    import jax.numpy as jnp
+
+    gen = build_generator(_tiny_model_cfg())
+    dummy = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    return gen.init(jax.random.PRNGKey(0), dummy)
+
+
+@pytest.fixture(scope="module")
+def engine(tiny_params):
+    """f32 engine over the full bucket grammar exercised below:
+    batch buckets {1, 4}, resolution buckets {16, 32}."""
+    return InferenceEngine(
+        _tiny_model_cfg(), tiny_params,
+        serve_cfg=ServeConfig(batch_buckets=(1, 4), sizes=(16, 32),
+                              dtype="float32"))
+
+
+def _images(n, size=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.uniform(-1, 1, (n, size, size, 3)).astype(np.float32)
+
+
+# -- config validation ----------------------------------------------------
+
+def test_serve_config_validation():
+    with pytest.raises(ValueError):
+        ServeConfig(dtype="float16")
+    with pytest.raises(ValueError):
+        ServeConfig(batch_buckets=())
+    with pytest.raises(ValueError):
+        ServeConfig(sizes=(0,))
+    with pytest.raises(ValueError):
+        ServeConfig(batch_buckets=(1, -4))
+
+
+def test_with_cycle_requires_bwd_params(tiny_params):
+    with pytest.raises(ValueError, match="bwd_params"):
+        InferenceEngine(_tiny_model_cfg(), tiny_params, bwd_params=None,
+                        serve_cfg=ServeConfig(with_cycle=True))
+
+
+# -- micro-batcher edge cases ---------------------------------------------
+
+def _resolving_flush(record, fail=None):
+    def flush(batch, trigger):
+        if fail is not None and fail[0]:
+            raise RuntimeError("poisoned flush")
+        record.append((len(batch), trigger))
+        for r in batch:
+            r.future.set_result(len(batch))
+    return flush
+
+
+def test_batcher_flushes_full_buckets():
+    record = []
+    b = MicroBatcher(_resolving_flush(record), max_batch=4, max_wait_s=5.0)
+    futs = [b.submit(Request(i, 32)) for i in range(8)]
+    assert all(f.result(timeout=30) == 4 for f in futs)
+    b.close()
+    assert record == [(4, "full"), (4, "full")]
+    assert b.n_requests == 8 and b.n_flushes == 2
+
+
+def test_batcher_deadline_flush_with_slow_producer():
+    """A partial bucket must flush at the max-wait deadline — a lone
+    request never waits for companions that are not coming."""
+    record = []
+    b = MicroBatcher(_resolving_flush(record), max_batch=8, max_wait_s=0.05)
+    t0 = time.perf_counter()
+    futs = [b.submit(Request(i, 32)) for i in range(2)]
+    assert all(f.result(timeout=30) == 2 for f in futs)
+    waited = time.perf_counter() - t0
+    b.close()
+    assert record == [(2, "deadline")]
+    # Deadline anchors at the FIRST request's submit time.
+    assert 0.05 <= waited < 5.0
+
+
+def test_batcher_drains_residue_on_close():
+    record = []
+    b = MicroBatcher(_resolving_flush(record), max_batch=8, max_wait_s=60.0)
+    futs = [b.submit(Request(i, 32)) for i in range(3)]
+    b.close()
+    assert record == [(3, "drain")]
+    assert all(f.result(timeout=5) == 3 for f in futs)
+
+
+def test_batcher_flush_exception_fails_futures_not_engine():
+    """flush_fn raising fails THAT flush's futures; the worker keeps
+    serving later submissions."""
+    record, fail = [], [True]
+    b = MicroBatcher(_resolving_flush(record, fail),
+                     max_batch=2, max_wait_s=0.02)
+    bad = [b.submit(Request(i, 32)) for i in range(2)]
+    for f in bad:
+        with pytest.raises(RuntimeError, match="poisoned"):
+            f.result(timeout=30)
+    fail[0] = False
+    good = b.submit(Request(9, 32))
+    assert good.result(timeout=30) == 1
+    b.close()
+    assert record == [(1, "deadline")]
+
+
+def test_batcher_max_queue_watermark():
+    release = threading.Event()
+
+    def slow_flush(batch, trigger):
+        release.wait(timeout=30)
+        for r in batch:
+            r.future.set_result(None)
+
+    b = MicroBatcher(slow_flush, max_batch=1, max_wait_s=0.0, max_queue=64)
+    futs = [b.submit(Request(i, 32)) for i in range(5)]
+    assert b.max_depth >= 1
+    release.set()
+    for f in futs:
+        f.result(timeout=30)
+    b.close()
+
+
+# -- bucket grammar -------------------------------------------------------
+
+def test_exactly_one_program_per_bucket(engine):
+    assert set(engine.programs) == {(16, 1), (16, 4), (32, 1), (32, 4)}
+    assert engine.max_batch == 4
+
+
+def test_batch_bucket_boundaries(engine):
+    assert engine.batch_bucket(1) == 1
+    assert engine.batch_bucket(2) == 4
+    assert engine.batch_bucket(4) == 4
+    assert engine.batch_bucket(5) is None  # caller must split
+
+
+def test_size_bucket_boundaries(engine):
+    assert engine.size_bucket(8, 8) == 16
+    assert engine.size_bucket(16, 16) == 16
+    assert engine.size_bucket(17, 4) == 32
+    assert engine.size_bucket(32, 32) == 32
+    # Oversized requests clamp to the largest bucket (resized down).
+    assert engine.size_bucket(100, 40) == 32
+
+
+def test_run_rejects_off_grammar_flushes(engine):
+    with pytest.raises(ValueError, match="exceeds the largest"):
+        engine.run(_images(5))
+    with pytest.raises(ValueError, match="size bucket"):
+        engine.run(_images(2, size=32), size=16)
+    with pytest.raises(KeyError):
+        engine.run(_images(2, size=24))  # 24 is not a resolution bucket
+
+
+# -- numerics -------------------------------------------------------------
+
+def test_ragged_tail_padding_matches_direct_apply(engine, tiny_params):
+    """A ragged flush of 3 into the 4-bucket must produce the same first
+    3 rows as applying the generator to those 3 images directly — the
+    zero rows are dead weight, never numerics."""
+    x = _images(3)
+    outs, n = engine.run(x, size=32)
+    assert n == 3
+    fake = np.asarray(outs[0])
+    assert fake.shape == (4, 32, 32, 3) and fake.dtype == np.float32
+    gen = build_generator(_tiny_model_cfg())
+    ref = np.asarray(gen.apply(tiny_params, x))
+    np.testing.assert_allclose(fake[:3], ref, atol=1e-5, rtol=1e-5)
+
+
+def test_bf16_serving_pinned_against_f32(engine, tiny_params):
+    """The bf16 path reuses the SAME f32 params (compute-dtype casting);
+    its float32 outputs must track the f32 program within bf16 noise.
+    tanh-bounded outputs in [-1, 1] make an absolute tolerance the right
+    pin."""
+    bf16 = InferenceEngine(
+        _tiny_model_cfg(), tiny_params,
+        serve_cfg=ServeConfig(batch_buckets=(4,), sizes=(32,),
+                              dtype="bfloat16"))
+    x = _images(4, seed=3)
+    ref = np.asarray(engine.run(x, size=32)[0][0])
+    got = np.asarray(bf16.run(x, size=32)[0][0])
+    assert got.dtype == np.float32  # cast back inside the program
+    assert float(np.max(np.abs(got - ref))) < 0.1
+    assert float(np.mean(np.abs(got - ref))) < 0.02
+
+
+def test_fused_cycle_program(engine, tiny_params):
+    """with_cycle=True fuses both generator passes into ONE program; its
+    fake output must match the single-pass program and its cycled output
+    must be the cycle generator applied to that fake."""
+    import jax
+
+    gen = build_generator(_tiny_model_cfg())
+    bwd = gen.init(jax.random.PRNGKey(7),
+                   np.zeros((1, 32, 32, 3), np.float32))
+    cyc = InferenceEngine(
+        _tiny_model_cfg(), tiny_params, bwd_params=bwd,
+        serve_cfg=ServeConfig(batch_buckets=(4,), sizes=(32,),
+                              dtype="float32", with_cycle=True))
+    x = _images(4, seed=5)
+    outs, n = cyc.run(x, size=32)
+    assert len(outs) == 2 and n == 4
+    fake, cycled = np.asarray(outs[0]), np.asarray(outs[1])
+    ref_fake = np.asarray(engine.run(x, size=32)[0][0])
+    np.testing.assert_allclose(fake, ref_fake, atol=1e-5, rtol=1e-5)
+    ref_cycled = np.asarray(gen.apply(bwd, fake))
+    np.testing.assert_allclose(cycled, ref_cycled, atol=1e-5, rtol=1e-5)
+
+
+# -- pipelined executor ---------------------------------------------------
+
+def test_executor_end_to_end_with_telemetry(engine, tmp_path):
+    """Raw uploads of assorted sizes route to their resolution buckets,
+    every future resolves, and the run leaves a foldable obs stream
+    (serve_flush + serve_summary on the PR-1 schema)."""
+    from obs_report import fold, load_events, render
+
+    from cyclegan_tpu.obs import MetricsLogger
+
+    stream = tmp_path / "serve.jsonl"
+    logger = MetricsLogger(str(stream))
+    ex = PipelinedExecutor(engine, max_wait_ms=20.0, logger=logger)
+    rng = np.random.RandomState(0)
+    shapes = [(40, 40), (16, 12), (33, 20), (8, 8), (32, 32)] * 2
+    futs = [ex.submit_raw(rng.randint(0, 255, s + (3,), np.uint8))
+            for s in shapes]
+    results = [f.result(timeout=120) for f in futs]
+    for s, res in zip(shapes, results):
+        expect = engine.size_bucket(*s)
+        assert res["fake"].shape == (expect, expect, 3)
+        assert "cycled" not in res  # single-pass engine: no cycle output
+    summary = ex.close()
+    logger.close()
+    assert summary["n_images"] == len(shapes)
+    assert summary["n_flushes"] >= 2  # at least one flush per size bucket
+    assert summary["images_per_sec"] > 0
+    assert summary["latency_p95_s"] >= summary["latency_p50_s"]
+
+    events, skipped = load_events(str(stream))
+    assert skipped == 0
+    report = fold(events)
+    assert len(report["serve_flushes"]) == summary["n_flushes"]
+    assert report["serve_summary"]["n_images"] == len(shapes)
+    roll = report["serve_rollup"]
+    assert roll["n_images"] == len(shapes)
+    assert set(roll["triggers"]) <= {"full", "deadline", "drain"}
+    text = render(report)
+    assert "serving:" in text and "serve summary:" in text
+
+
+def test_executor_rejects_unbucketed_max_batch(engine):
+    with pytest.raises(ValueError, match="exceeds"):
+        PipelinedExecutor(engine, max_batch=16)
+
+
+def test_executor_closed_rejects_submissions(engine):
+    ex = PipelinedExecutor(engine, max_wait_ms=1.0)
+    ex.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        ex.submit(_images(1)[0])
+    assert ex.close() == {}  # idempotent
+
+
+# -- HTTP front-end -------------------------------------------------------
+
+def test_http_server_round_trip(engine):
+    import io
+    import urllib.request
+
+    from cyclegan_tpu.serve.server import make_server
+
+    ex = PipelinedExecutor(engine, max_wait_ms=5.0)
+    server, app = make_server(ex, port=0)
+    host, port = server.server_address[:2]
+    th = threading.Thread(target=server.serve_forever, daemon=True)
+    th.start()
+    try:
+        base = f"http://{host}:{port}"
+        with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
+            assert r.status == 200
+
+        buf = io.BytesIO()
+        np.save(buf, np.random.RandomState(0)
+                .randint(0, 255, (20, 28, 3), np.uint8))
+        req = urllib.request.Request(
+            f"{base}/translate", data=buf.getvalue(), method="POST")
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "image/png"
+            body = r.read()
+        assert body[:8] == b"\x89PNG\r\n\x1a\n"
+
+        with urllib.request.urlopen(f"{base}/stats", timeout=30) as r:
+            stats = json.loads(r.read())
+        assert stats["n_requests"] == 1 and stats["n_errors"] == 0
+
+        # A garbage upload 500s without killing the server.
+        req = urllib.request.Request(
+            f"{base}/translate", data=b"not an image", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 500
+        with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
+            assert r.status == 200
+    finally:
+        server.shutdown()
+        ex.close()
+
+
+# -- hot-path no-sync coverage --------------------------------------------
+
+def test_no_sync_check_covers_serve_directory():
+    from check_no_sync import hot_path_entries, run_check
+
+    entries = dict(hot_path_entries())
+    for mod in ("engine", "batcher", "executor", "server", "__init__"):
+        assert entries.get(f"cyclegan_tpu/serve/{mod}.py") is True
+    assert run_check() == []
+
+
+# -- bench_serve contract -------------------------------------------------
+
+def test_bench_serve_emits_one_json_line(capsys):
+    import bench_serve
+
+    bench_serve._emit({"metric": "cyclegan_serve_images_per_sec_1chip",
+                       "value": 1.0, "unit": "images/sec"})
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    d = json.loads(out[0])
+    assert d["metric"] == "cyclegan_serve_images_per_sec_1chip"
+
+
+def test_bench_serve_percentile_empty_is_finite():
+    import bench_serve
+
+    assert bench_serve._percentile([], 0.95) == 0.0
+    assert bench_serve._percentile([1.0, 2.0, 3.0], 0.5) == 2.0
+
+
+@pytest.mark.slow
+def test_bench_serve_cpu_end_to_end(tmp_path):
+    """Full bench_serve.py subprocess on the CPU toy geometry: exactly
+    one JSON line, speedup + latency fields present, obs stream foldable."""
+    import subprocess
+
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               BENCH_SERVE_TIME_BUDGET_S="240",
+               BENCH_OBS_JSONL=str(tmp_path / "bench_serve.jsonl"))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_serve.py"),
+         "--n", "8", "--skip_sweep"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, proc.stdout
+    d = json.loads(lines[0])
+    assert d["metric"] == "cyclegan_serve_images_per_sec_1chip"
+    assert d["value"] > 0 and d["serial_images_per_sec"] > 0
+    assert "speedup_vs_serial" in d and "latency_saturated_ms" in d
+    assert d["platform"] == "cpu" and "note" in d

@@ -57,7 +57,9 @@ def say(msg: str) -> None:
 def official_programs() -> list:
     """Every distinct XLA program the driver window can request:
     bench.TPU_CONFIGS (the official list) + chip_autorun's sweep/accum
-    specs. Returned as (key, spec-dict) with spec fields mirroring
+    specs + the serving engine's bucket programs (serve_programs — so a
+    fresh chip lease pays serve compiles offline, not at first request).
+    Returned as (key, spec-dict) with spec fields mirroring
     bench's call parameters; duplicate programs (e.g. dispatch k8 vs
     its pf variant — same XLA program, host-side staging only) are
     deduplicated by program signature."""
@@ -114,6 +116,46 @@ def official_programs() -> list:
     add("sweep scan:b4k2i512", "scan", "bfloat16", 4, image=512, k=2)
     add("sweep scan:b4k2zeroi512", "scan", "bfloat16", 4, image=512, k=2,
         pad_mode="zero")
+    progs.extend(serve_programs())
+    return progs
+
+
+def serve_programs() -> list:
+    """The serving engine's AOT programs (cyclegan_tpu/serve/engine.py):
+    one generator forward per (size, batch bucket, dtype) of the default
+    bucket grammar, traced through engine.lower_forward — byte-for-byte
+    what InferenceEngine compiles at startup, so a warmed chip lease
+    answers its first request without a compile. Warmed for both serving
+    dtypes (f32 = checkpoint default, bf16 = the chip fast path) plus
+    the fused forward+cycle program translate.py --panels requests."""
+    from cyclegan_tpu.serve.engine import (
+        DEFAULT_BATCH_BUCKETS,
+        DEFAULT_SIZES,
+    )
+
+    progs = []
+    for size in DEFAULT_SIZES:
+        for batch in DEFAULT_BATCH_BUCKETS:
+            for dtype in ("float32", "bfloat16"):
+                short = "bf16" if dtype == "bfloat16" else "f32"
+                progs.append({
+                    "key": f"serve {short}:b{batch}i{size}",
+                    "mode": "serve", "dtype": dtype, "batch": batch,
+                    "image": size, "k": 1, "pad_mode": "reflect",
+                    "pad_impl": "pad", "accum": None, "with_cycle": False,
+                    "covers": [f"serve/{dtype}/b{batch}/i{size}"],
+                })
+        # The --panels fused two-pass program, largest bucket only
+        # (panel requests are batch-CLI traffic, not the server's
+        # low-latency path).
+        big = DEFAULT_BATCH_BUCKETS[-1]
+        progs.append({
+            "key": f"serve f32cycle:b{big}i{size}",
+            "mode": "serve", "dtype": "float32", "batch": big,
+            "image": size, "k": 1, "pad_mode": "reflect",
+            "pad_impl": "pad", "accum": None, "with_cycle": True,
+            "covers": [f"serve/float32/b{big}/i{size}/cycle"],
+        })
     return progs
 
 
@@ -126,6 +168,23 @@ def _lower(prog: dict):
     from cyclegan_tpu.train import create_state, make_train_step
 
     batch, image, k = prog["batch"], prog["image"], prog["k"]
+    if prog["mode"] == "serve":
+        # Serving engine program: engine.lower_forward IS the trace the
+        # InferenceEngine compiles at startup; params enter as
+        # ShapeDtypeStruct trees (no weights needed — lowering only
+        # consumes avals).
+        from cyclegan_tpu.serve.engine import (
+            lower_forward,
+            param_specs,
+            serve_model_config,
+        )
+
+        model_cfg = serve_model_config(prog["dtype"], image)
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            p_spec = param_specs(model_cfg, (image,))
+        bwd = p_spec if prog.get("with_cycle") else None
+        return lower_forward(model_cfg, p_spec, bwd, batch, image,
+                             bool(prog.get("with_cycle")))
     if prog["mode"] == "accum":
         from cyclegan_tpu.train.steps import make_accum_train_step
 

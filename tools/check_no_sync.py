@@ -18,7 +18,11 @@ rules over the hot-path files:
    telemetry only timestamps fetches the loop performs. Likewise every
    kernel wrapper under `cyclegan_tpu/ops/pallas/` (scanned as a
    directory): they run INSIDE the fused train step, where any host
-   sync would serialize the dispatch pipeline.
+   sync would serialize the dispatch pipeline. The serving path
+   (`cyclegan_tpu/serve/`, also scanned as a directory) follows the
+   loop's rule: its one deferred D2H per flush lives on the completer
+   thread behind a `sanctioned-fetch` marker; everywhere else a fetch
+   would stall the dispatch/batching threads.
 
 Comments and docstrings are exempt (they may DISCUSS the forbidden
 calls); only code can violate. Runs in tier-1 via
@@ -51,13 +55,17 @@ HOT_PATH_FILES: List[Tuple[str, bool]] = [
     ("cyclegan_tpu/obs/watchdog.py", False),
 ]
 
-# Directories whose EVERY .py file is hot-path, with no sanctioned
-# fetch sites: the Pallas kernel wrappers run inside the fused train
-# step — a host sync there would serialize every dispatch. Scanned as a
-# directory (not a file list) so a new kernel module is covered the day
-# it lands.
+# Directories whose EVERY .py file is hot-path. Scanned as a directory
+# (not a file list) so a new module is covered the day it lands:
+# - ops/pallas (no sanctioned sites): kernel wrappers run INSIDE the
+#   fused train step — a host sync there would serialize every dispatch.
+# - serve (sanctioned sites allowed): the serving pipeline's whole
+#   design is deferred fetches — the completer thread's one bounded
+#   `device_get` per flush carries the marker; anything else (an
+#   engine/batcher/server sync) would re-serialize the pipeline.
 HOT_PATH_DIRS: List[Tuple[str, bool]] = [
     ("cyclegan_tpu/ops/pallas", False),
+    ("cyclegan_tpu/serve", True),
 ]
 
 

@@ -65,6 +65,9 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
         "stalls": [],
         "bench": [],
         "bench_summary": None,
+        "serve_compiles": [],   # serve engine AOT program compiles
+        "serve_flushes": [],    # per-flush serving events
+        "serve_summary": None,  # executor close() rollup
         "end": None,
     }
     for ev in events:
@@ -89,6 +92,12 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             report["bench"].append(ev)
         elif kind == "bench_summary":
             report["bench_summary"] = ev
+        elif kind == "serve_compile":
+            report["serve_compiles"].append(ev)
+        elif kind == "serve_flush":
+            report["serve_flushes"].append(ev)
+        elif kind == "serve_summary":
+            report["serve_summary"] = ev
         elif kind == "end":
             report["end"] = ev
         # unknown events: ignored by design
@@ -115,6 +124,34 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             if peak >= cur.get("peak_bytes_in_use", cur.get("bytes_in_use", 0)):
                 cur.update(row)
     report["memory_peaks"] = peaks
+
+    # Serving rollup: trigger mix + fill factor quantify whether the
+    # micro-batcher is running throughput-bound (full flushes) or
+    # latency-bound (deadline flushes), queue-depth watermark shows how
+    # close admission backpressure came to engaging.
+    flushes = report["serve_flushes"]
+    if flushes:
+        triggers: Dict[str, int] = {}
+        for ev in flushes:
+            trig = str(ev.get("trigger", "?"))
+            triggers[trig] = triggers.get(trig, 0) + 1
+        fills = [float(ev["n"]) / float(ev["bucket"]) for ev in flushes
+                 if ev.get("n") and ev.get("bucket")]
+        report["serve_rollup"] = {
+            "n_flushes": len(flushes),
+            "n_images": sum(int(ev.get("n", 0)) for ev in flushes),
+            "triggers": triggers,
+            "mean_fill": (sum(fills) / len(fills)) if fills else None,
+            "max_queue_depth": max(
+                (int(ev.get("queue_depth", 0)) for ev in flushes),
+                default=0),
+            "dispatch_p50_s": _percentile(
+                [float(ev["dispatch_s"]) for ev in flushes
+                 if "dispatch_s" in ev], .5),
+            "fetch_block_p50_s": _percentile(
+                [float(ev["fetch_block_s"]) for ev in flushes
+                 if "fetch_block_s" in ev], .5),
+        }
     return report
 
 
@@ -241,6 +278,33 @@ def render(report: dict) -> str:
           f"({bs.get('config', '?')}, platform {bs.get('platform', '?')}"
           + (f", mfu {_fmt(bs.get('mfu'))}" if bs.get("mfu") is not None else "")
           + ")")
+
+    if report["serve_compiles"]:
+        w(f"-- serve engine: {len(report['serve_compiles'])} AOT programs --")
+        for ev in report["serve_compiles"]:
+            w(f"b{ev.get('batch', '?')} i{ev.get('size', '?')} "
+              f"{ev.get('dtype', '?')}"
+              + (" +cycle" if ev.get("with_cycle") else "")
+              + f": compile {_fmt(ev.get('seconds'), '.2f')}s")
+
+    roll = report.get("serve_rollup")
+    if roll:
+        w(f"-- serving: {roll['n_images']} images in "
+          f"{roll['n_flushes']} flushes --")
+        trig = ", ".join(f"{k}={v}" for k, v in sorted(roll["triggers"].items()))
+        w(f"flush triggers: {trig}  (full=throughput-bound, "
+          f"deadline=latency-bound)")
+        w(f"mean bucket fill: {_fmt(roll.get('mean_fill'), '.3f')}  "
+          f"max queue depth: {roll['max_queue_depth']}")
+        w(f"per-flush medians: dispatch {_fmt(roll.get('dispatch_p50_s'))}s, "
+          f"fetch-block {_fmt(roll.get('fetch_block_p50_s'))}s")
+    if report["serve_summary"]:
+        ss = report["serve_summary"]
+        w(f"serve summary: {_fmt(ss.get('images_per_sec'), '.2f')} images/sec "
+          f"sustained ({ss.get('n_images', '?')} images), latency "
+          f"p50 {_fmt(ss.get('latency_p50_s'))}s / "
+          f"p95 {_fmt(ss.get('latency_p95_s'))}s / "
+          f"p99 {_fmt(ss.get('latency_p99_s'))}s")
 
     end = report["end"]
     if end:

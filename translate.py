@@ -8,6 +8,13 @@ generator (G: X->Y by default, F: Y->X with --direction BtoA), and writes
 PNGs to --output. Optionally emits [input, translated, cycled] panels
 like the training-time plots (--panels).
 
+This CLI drives the serving engine (cyclegan_tpu/serve): the generator
+forward is AOT-compiled per batch bucket at startup, decode -> dispatch
+-> D2H -> encode run pipelined across threads with bounded in-flight
+backpressure, and — unless --panels asks for the cycle image — only ONE
+generator pass runs per image (the historical loop always paid the
+cycle pass too: pure waste, double the inference FLOPs).
+
 Usage:
   python translate.py --output_dir runs --input path/to/images \
       --output translated/ [--direction BtoA] [--image_size 256] [--panels]
@@ -17,12 +24,21 @@ from __future__ import annotations
 
 import argparse
 import os
+import time
 
 import numpy as np
 
-from cyclegan_tpu.utils.platform import ensure_platform_from_env
+from cyclegan_tpu.utils.platform import (
+    enable_compilation_cache,
+    ensure_platform_from_env,
+)
 
 IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".webp", ".npy")
+
+# How many resolved-but-unwritten results the writer holds before it
+# stops decoding and drains one: keeps decode ahead of encode without
+# letting every decoded image in a huge folder sit in host memory.
+WRITE_WINDOW = 32
 
 
 def load_image(path: str, size: int) -> np.ndarray:
@@ -45,16 +61,38 @@ def save_image(path: str, x: np.ndarray) -> None:
     Image.fromarray(to_uint8(x)).save(path)
 
 
+def output_stems(names: list) -> list:
+    """Output stems: strip the extension unless that would collide
+    (a.jpg + a.png), then uniquify whatever still collides (a.jpg +
+    a.png + a.jpg.png) so no translation silently overwrites another."""
+    from collections import Counter
+
+    bare = [os.path.splitext(n)[0] for n in names]
+    counts = Counter(bare)
+    used, stems = set(), []
+    for n, b in zip(names, bare):
+        s = b if counts[b] == 1 else n
+        cand, i = s, 1
+        while cand in used:
+            cand = f"{s}__{i}"
+            i += 1
+        used.add(cand)
+        stems.append(cand)
+    return stems
+
+
 def main(args: argparse.Namespace) -> None:
     ensure_platform_from_env()
     from cyclegan_tpu.utils.axon_compat import cli_startup
 
     cli_startup()  # local-compile workaround + relay diagnosis
+    enable_compilation_cache()
     import jax
 
     from cyclegan_tpu.config import Config, TrainConfig
+    from cyclegan_tpu.serve.engine import InferenceEngine, ServeConfig
+    from cyclegan_tpu.serve.executor import PipelinedExecutor
     from cyclegan_tpu.train import create_state
-    from cyclegan_tpu.train.state import build_models
     from cyclegan_tpu.utils.checkpoint import Checkpointer
 
     # Self-describing checkpoints: the slot's meta.json records the model
@@ -78,19 +116,12 @@ def main(args: argparse.Namespace) -> None:
     if not resumed:
         raise SystemExit(f"no checkpoint under {args.output_dir}/checkpoints")
 
-    gen, _ = build_models(config)
     # AtoB: translate with G, cycle back with F; BtoA: the reverse.
     fwd_params, bwd_params = (
         (state.g_params, state.f_params)
         if args.direction == "AtoB"
         else (state.f_params, state.g_params)
     )
-
-    @jax.jit
-    def translate(x):
-        fake = gen.apply(fwd_params, x)
-        cycled = gen.apply(bwd_params, fake)
-        return fake, cycled
 
     if os.path.isdir(args.input):
         names = sorted(
@@ -103,41 +134,73 @@ def main(args: argparse.Namespace) -> None:
         names = [os.path.basename(args.input)]
     if not paths:
         raise SystemExit(f"no images found in {args.input}")
-    # Output stems: strip the extension unless that would collide
-    # (a.jpg + a.png), then uniquify whatever still collides (a.jpg +
-    # a.png + a.jpg.png) so no translation silently overwrites another.
-    from collections import Counter
+    stems = output_stems(names)
 
-    bare = [os.path.splitext(n)[0] for n in names]
-    counts = Counter(bare)
-    used, stems = set(), []
-    for n, b in zip(names, bare):
-        s = b if counts[b] == 1 else n
-        cand, i = s, 1
-        while cand in used:
-            cand = f"{s}__{i}"
-            i += 1
-        used.add(cand)
-        stems.append(cand)
+    logger = None
+    if args.obs_jsonl:
+        from cyclegan_tpu.obs import MetricsLogger, build_manifest
+
+        logger = MetricsLogger(args.obs_jsonl)
+        logger.event("manifest", **build_manifest(
+            config, query_devices=False, role="translate"))
+
+    # The serving engine: one AOT program per batch bucket. A singleton
+    # bucket rides along so a final ragged chunk of exactly 1 doesn't pay
+    # a full bucket of padded compute; bigger tails zero-pad into the
+    # batch bucket (exactly one program per bucket ever compiles).
+    # Without --panels the program is the SINGLE-pass forward — the cycle
+    # generator never runs, halving inference FLOPs.
+    serve_cfg = ServeConfig(
+        batch_buckets=tuple(sorted({1, args.batch_size})),
+        sizes=(config.model.image_size,),
+        dtype=args.dtype or model_cfg.compute_dtype,
+        with_cycle=args.panels,
+    )
+    engine = InferenceEngine(model_cfg, fwd_params, bwd_params,
+                             serve_cfg=serve_cfg, logger=logger)
+    # max_wait is generous for a batch CLI: the producer loop below fills
+    # buckets as fast as it decodes, so the deadline only matters for the
+    # final ragged tail.
+    executor = PipelinedExecutor(engine, max_batch=args.batch_size,
+                                 max_wait_ms=args.max_wait_ms,
+                                 logger=logger)
 
     os.makedirs(args.output, exist_ok=True)
-    bs = args.batch_size
-    for lo in range(0, len(paths), bs):
-        chunk = paths[lo : lo + bs]
-        # model_cfg.image_size, NOT args.image_size: the flag defaults to
-        # None (= "use the checkpoint-recorded size").
-        batch = np.stack([load_image(p, config.model.image_size) for p in chunk])
-        # Pad the final chunk so there is exactly one compiled program.
-        pad = bs - len(chunk)
-        if pad:
-            batch = np.concatenate([batch, np.zeros((pad,) + batch.shape[1:], np.float32)])
-        fake, cycled = (np.asarray(a) for a in translate(batch))
-        for j, stem in enumerate(stems[lo : lo + bs]):
-            save_image(os.path.join(args.output, f"{stem}.png"), fake[j])
-            if args.panels:
-                panel = np.concatenate([batch[j], fake[j], cycled[j]], axis=1)
-                save_image(os.path.join(args.output, f"{stem}_panel.png"), panel)
-    print(f"translated {len(paths)} images -> {args.output}")
+    t0 = time.perf_counter()
+
+    def write(stem: str, src_path: str, result: dict) -> None:
+        save_image(os.path.join(args.output, f"{stem}.png"), result["fake"])
+        if args.panels:
+            # model_cfg.image_size, NOT args.image_size: the flag
+            # defaults to None (= "use the checkpoint-recorded size").
+            inp = load_image(src_path, config.model.image_size)
+            panel = np.concatenate(
+                [inp, result["fake"], result["cycled"]], axis=1)
+            save_image(os.path.join(args.output, f"{stem}_panel.png"), panel)
+
+    # Pipelined batch loop: decode on this thread, submit, and write
+    # results as their futures resolve — decode of image N+k overlaps
+    # device compute of N and PNG encode of N-k.
+    in_flight: list = []
+    for path, stem in zip(paths, stems):
+        in_flight.append(
+            (stem, path,
+             executor.submit(load_image(path, config.model.image_size))))
+        while len(in_flight) > WRITE_WINDOW:
+            s, p, fut = in_flight.pop(0)
+            write(s, p, fut.result())
+    for s, p, fut in in_flight:
+        write(s, p, fut.result())
+
+    elapse = time.perf_counter() - t0
+    summary = executor.close()
+    if logger is not None:
+        logger.event("end", status="completed")
+        logger.close()
+    print(f"translated {len(paths)} images -> {args.output} "
+          f"({len(paths) / max(elapse, 1e-9):.2f} images/sec"
+          + (f", p95 latency {summary['latency_p95_s'] * 1e3:.0f} ms"
+             if summary.get("n_images") else "") + ")")
 
 
 if __name__ == "__main__":
@@ -159,7 +222,20 @@ if __name__ == "__main__":
                         "for legacy checkpoints without recorded architecture")
     p.add_argument("--residual_blocks", default=None, type=int,
                    help="generator trunk depth — legacy checkpoints only")
-    p.add_argument("--batch_size", default=8, type=int)
+    p.add_argument("--batch_size", default=8, type=int,
+                   help="largest batch bucket (flush size) for the engine")
+    p.add_argument("--dtype", default=None,
+                   choices=["float32", "bfloat16"],
+                   help="serving compute dtype (default: the checkpoint's; "
+                        "bf16 halves MXU time, numerically pinned by "
+                        "tests/test_serve.py)")
+    p.add_argument("--max_wait_ms", default=50.0, type=float,
+                   help="micro-batcher deadline before a ragged flush")
     p.add_argument("--panels", action="store_true",
-                   help="also save [input | translated | cycled] panels")
+                   help="also save [input | translated | cycled] panels "
+                        "(compiles the fused two-pass program; without "
+                        "this the cycle generator never runs)")
+    p.add_argument("--obs_jsonl", default=None,
+                   help="telemetry stream path (PR-1 schema; fold with "
+                        "tools/obs_report.py)")
     main(p.parse_args())
