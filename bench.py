@@ -428,6 +428,183 @@ def bench_accum(compute_dtype: str, micro: int, image: int = 512,
     return 2 * effective * iters / dt
 
 
+def bench_e2e(epochs: int = 3, batch: int = 4, image: int = 64,
+              filters: int = 16, blocks: int = 3, train_size: int = 64,
+              test_size: int = 8, out_dir: str | None = None):
+    """End-to-end loop overhead: the REAL `train_epoch`/`test_epoch`
+    driver — summary writers, telemetry, async checkpoint + cycle plots,
+    prefetch — against the bare-kernel row (same jitted sharded step,
+    device-resident batches, python dispatch).
+
+    Two numbers the epoch loop must defend:
+    - `overhead_fraction`: 1 − train-only img/s ÷ bare-kernel img/s,
+      pinned <5% on CPU. Everything the loop adds around the step
+      (staging, backpressure bookkeeping, per-dispatch telemetry)
+      has to fit in that margin.
+    - `boundary_s` vs `dispatch_wall_p50_s`: the epoch-boundary
+      microbench. With checkpoint + plots ENABLED, the main-thread cost
+      of the boundary (Orbax D2H + commit handoff, cycle inference +
+      fetch, render/write submission) must stay under one dispatch's
+      rolling-median wall — i.e. the dispatch path is never blocked on
+      host I/O (the services thread absorbs it).
+
+    Returns the full measurement dict; the `e2e` CLI mode wraps it in
+    the one-JSON-line contract.
+    """
+    import shutil
+    import tempfile
+
+    from cyclegan_tpu.config import (
+        Config, DataConfig, ModelConfig, ObsConfig, TrainConfig,
+        DiscriminatorConfig, GeneratorConfig,
+    )
+    from cyclegan_tpu.data import build_data
+    from cyclegan_tpu.obs import make_telemetry
+    from cyclegan_tpu.parallel import (
+        make_mesh_plan, shard_batch, shard_test_step, shard_train_step,
+    )
+    from cyclegan_tpu.train import (
+        create_state, loop, make_cycle_step, make_test_step, make_train_step,
+    )
+    from cyclegan_tpu.utils.checkpoint import Checkpointer
+    from cyclegan_tpu.utils.plotting import plot_cycle
+    from cyclegan_tpu.utils.services import EpochServices
+    from cyclegan_tpu.utils.summary import Summary
+
+    tmp = out_dir or tempfile.mkdtemp(prefix="bench_e2e_")
+    cleanup = out_dir is None
+    config = Config(
+        model=ModelConfig(
+            generator=GeneratorConfig(filters=filters,
+                                      num_residual_blocks=blocks),
+            discriminator=DiscriminatorConfig(filters=filters),
+            image_size=image,
+        ),
+        data=DataConfig(
+            source="synthetic", crop_size=image,
+            resize_size=int(image * 286 / 256),
+            synthetic_train_size=train_size, synthetic_test_size=test_size,
+        ),
+        train=TrainConfig(
+            output_dir=tmp, epochs=epochs, batch_size=batch, verbose=0,
+            checkpoint_every=1, plot_samples=2,
+        ),
+        obs=ObsConfig(jsonl_path=os.path.join(tmp, "telemetry.jsonl")),
+    )
+    plan = make_mesh_plan(config.parallel)
+    global_batch = plan.n_data * batch
+    data = build_data(config, global_batch, test_batch_size=global_batch)
+    state = create_state(config, jax.random.PRNGKey(0))
+    global _PLATFORM, _DEVICE_KIND
+    _PLATFORM = jax.default_backend()
+    _DEVICE_KIND = jax.devices()[0].device_kind
+    train_step = shard_train_step(plan, make_train_step(config, global_batch))
+    test_step = shard_test_step(plan, make_test_step(config, global_batch))
+    cycle_step = jax.jit(make_cycle_step(config))
+
+    # --- bare-kernel row: IDENTICAL jitted program, device-resident
+    # sharded batch, python dispatch, one sync at the end.
+    rng = np.random.RandomState(0)
+    x = rng.rand(global_batch, image, image, 3).astype(np.float32) * 2 - 1
+    y = rng.rand(global_batch, image, image, 3).astype(np.float32) * 2 - 1
+    w = np.ones((global_batch,), np.float32)
+    xs, ys, ws = shard_batch(plan, x, y, w)
+    for _ in range(2):  # compile + warm
+        state, metrics = train_step(state, xs, ys, ws)
+    _sync(metrics)
+    iters = 2 * data.train_steps
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = train_step(state, xs, ys, ws)
+    _sync(metrics)
+    kernel_ips = 2 * global_batch * iters / (time.perf_counter() - t0)
+
+    # --- the real loop, full epoch services enabled.
+    summary = Summary(tmp)
+    tele = make_telemetry(config.obs, tmp, primary=True)
+    services = EpochServices(telemetry=tele)
+    ckpt = Checkpointer(tmp)
+    train_ips, boundaries = [], []
+    try:
+        for epoch in range(epochs):
+            t0 = time.perf_counter()
+            state = loop.train_epoch(config, data, plan, train_step, state,
+                                     summary, epoch, obs=tele)
+            train_elapse = time.perf_counter() - t0
+            loop.test_epoch(config, data, plan, test_step, state, summary,
+                            epoch, obs=tele)
+            train_ips.append(
+                loop.images_per_sec(2 * data.n_train, train_elapse))
+            # Epoch boundary, checkpoint + plots enabled: what the next
+            # epoch's first dispatch would have waited on.
+            t_b = time.perf_counter()
+            ckpt.save(state, epoch, meta=config.model_meta(),
+                      services=services)
+            plot_cycle(data.plot_pairs(), cycle_step, state, summary, epoch,
+                       services=services)
+            boundaries.append(time.perf_counter() - t_b)
+    finally:
+        services.close()
+        ckpt.close()
+        summary.close()
+        tele.close()
+
+    # Per-dispatch attribution straight from the stream the run wrote.
+    steps_seen = 0
+    attribution_ok = True
+    wall_p50 = None
+    n_stalls = 0
+    with open(config.obs.jsonl_path) as f:
+        for raw in f:
+            ev = json.loads(raw)
+            if ev.get("event") == "step" and ev.get("split") == "train":
+                steps_seen += 1
+                attribution_ok = attribution_ok and all(
+                    k in ev for k in
+                    ("submit_ready_s", "data_wait_s", "host_work_s"))
+            elif (ev.get("event") == "epoch_steps"
+                  and ev.get("split") == "train"):
+                wall_p50 = ev.get("wall_p50_s")
+                n_stalls += int(ev.get("n_loop_stalls", 0))
+    if cleanup:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # Warm epochs only: epoch 0 pays the test-step/cycle compiles, and
+    # its boundary pays Orbax's first-save setup.
+    loop_ips = max(train_ips[1:] or train_ips)
+    boundary_s = boundaries[-1]
+    overhead = 1.0 - loop_ips / kernel_ips if kernel_ips > 0 else 1.0
+    return {
+        "kernel_ips": round(kernel_ips, 2),
+        "loop_ips": round(loop_ips, 2),
+        "train_ips_per_epoch": [round(v, 2) for v in train_ips],
+        "overhead_fraction": round(overhead, 4),
+        "overhead_ok": overhead < 0.05,
+        "boundary_s": round(boundary_s, 4),
+        "boundaries_s": [round(b, 4) for b in boundaries],
+        "dispatch_wall_p50_s": wall_p50,
+        "boundary_ok": (wall_p50 is not None and boundary_s < wall_p50),
+        "train_step_events": steps_seen,
+        "attribution_ok": attribution_ok,
+        "n_loop_stalls": n_stalls,
+        "epochs": epochs,
+        "train_steps_per_epoch": data.train_steps,
+    }
+
+
+def _e2e_main() -> None:
+    """`python bench.py e2e` — one JSON line, same contract as main()."""
+    res = bench_e2e()
+    line = {
+        "metric": "cyclegan_e2e_loop_overhead_fraction",
+        "value": res["overhead_fraction"],
+        "unit": "fraction",
+        "platform": _backend(),
+        **res,
+    }
+    print(json.dumps(line), flush=True)
+
+
 # Cached by the first successful _build; the emit path must NEVER call
 # jax.default_backend() itself — against a dead TPU transport that call
 # blocks indefinitely, which would wedge the watchdog/signal emitters.
@@ -968,5 +1145,7 @@ def main():
 if __name__ == "__main__":
     if os.environ.get("BENCH_ROLE") == "cpu-worker":
         _cpu_worker_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "e2e":
+        _e2e_main()
     else:
         main()

@@ -235,6 +235,12 @@ class ObsConfig:
     step_log_every: int = 1
     # Sample per-device HBM watermarks every N epochs.
     memory_sample_every: int = 1
+    # Per-dispatch stall detection: emit a `loop_stall` event when one
+    # loop iteration's wall exceeds this multiple of the rolling median
+    # of recent dispatch walls (32-dispatch window, armed after 5
+    # samples so the compile dispatch can't seed false positives).
+    # 0 disables detection.
+    stall_multiple: float = 10.0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -20,21 +20,48 @@ StepClock instead timestamps ONLY work the loop already does:
   dispatch-to-dispatch interval (`wall`) paces to the device step time
   without any added sync.
 
+From those timestamps each dispatch record carries full attribution:
+
+- `data_wait_s` — the stage window (host had no batch ready).
+- `host_work_s` — loop-iteration wall not inside the stage/dispatch/
+  fetch windows: metric bookkeeping, progress bar, summary writes —
+  pure host overhead between the device call returning and the next
+  batch being requested.
+- `submit_ready_s` — submit→ready latency of the dispatch itself.
+  The loop passes the completion timestamp (`at=`) of each deferred
+  fetch it already performs; because that fetch data-depends on its
+  dispatch, the oldest pending dispatch is proven finished by then.
+  It is an upper bound tightened by backpressure: at steady state the
+  window is full and fetches track device completion closely.
+
 No `block_until_ready`, no extra `device_get`, no synchronization of
 any kind is introduced — `tools/check_no_sync.py` enforces this file
 stays that way.
 
 Per-dispatch `step` events are emitted every `log_every` dispatches
-(every dispatch by default); `finish()` always emits an `epoch_steps`
-aggregate (totals, wall percentiles, starvation fraction). `depth`
-tracks pinned in-flight batches for the stall watchdog, and every
-dispatch/fetch beats the watchdog's heartbeat.
+(every dispatch by default); a record is held until BOTH its wall is
+closed (next stage_begin) and its readiness is known (its fetch, the
+drain, or finish), so `submit_ready_s` lands in the dispatch's own
+event. `finish()` always emits an `epoch_steps` aggregate (totals,
+wall percentiles, starvation fraction, submit→ready percentiles).
+A `loop_stall` event fires (regardless of `log_every`) when a
+dispatch's loop-iteration wall exceeds `stall_multiple` x the rolling
+median of recent walls — the wedged-tunnel epochs get attributed, not
+asserted. `depth` tracks pinned in-flight batches for the stall
+watchdog, and every dispatch/fetch beats the watchdog's heartbeat.
 """
 
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Callable, List, Optional
+
+# Rolling window (dispatch count) for the loop_stall median, and how
+# many walls must accumulate before stall detection arms — the compile
+# dispatch and warm-up jitter must not seed false positives.
+STALL_WINDOW = 32
+STALL_MIN_SAMPLES = 5
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -58,6 +85,7 @@ class StepClock:
         log_every: int = 1,
         heartbeat: Optional[Callable[[], None]] = None,
         clock=time.perf_counter,
+        stall_multiple: float = 0.0,
     ):
         self._logger = logger
         self._epoch = epoch
@@ -65,28 +93,93 @@ class StepClock:
         self._log_every = max(0, int(log_every))
         self._heartbeat = heartbeat or (lambda: None)
         self._clock = clock
+        self._stall_multiple = float(stall_multiple or 0.0)
         self.depth = 0  # pinned in-flight batches (watchdog reads this)
         self.n_dispatches = 0
         self.n_steps = 0
+        self.n_loop_stalls = 0
         self._walls: List[float] = []  # per-dispatch loop-iteration wall
+        self._recent = deque(maxlen=STALL_WINDOW)  # loop_stall median basis
         self._stage_s = 0.0
         self._dispatch_s = 0.0
         self._fetch_s = 0.0
         self._drain_s = 0.0
+        self._host_s = 0.0
         self._t_open = clock()
         self._t_iter: Optional[float] = None  # current iteration start
         self._t0 = None  # stage_begin timestamp
         self._cur: Optional[dict] = None  # current dispatch record
+        # submit→ready plumbing: FIFO of (dispatch idx, submit time)
+        # awaiting their deferred fetch; records closed but awaiting
+        # readiness; latencies resolved before their record closed.
+        self._submits: deque = deque()
+        self._open: dict = {}
+        self._ready: dict = {}
+        self._ready_vals: List[float] = []
+
+    def _emit_record(self, rec: dict) -> None:
+        if rec.pop("_emit"):
+            self._logger.event("step", **rec)
+
+    def _resolve_ready(self, idx: int, submit_ready_s: float) -> None:
+        """Dispatch `idx` is proven finished: attach its submit→ready
+        latency and emit its record if the wall is already closed."""
+        self._ready_vals.append(submit_ready_s)
+        rec = self._open.pop(idx, None)
+        if rec is not None:
+            rec["submit_ready_s"] = round(submit_ready_s, 6)
+            self._emit_record(rec)
+        else:  # record still current — attach at close
+            self._ready[idx] = submit_ready_s
 
     def _close_record(self, now: float) -> None:
         if self._cur is None:
             return
-        wall = now - self._t_iter
-        self._cur["wall_s"] = round(wall, 6)
-        self._walls.append(wall)
-        if self._log_every and (self.n_dispatches % self._log_every == 0):
-            self._logger.event("step", **self._cur)
+        rec = self._cur
         self._cur = None
+        wall = now - self._t_iter
+        rec["wall_s"] = round(wall, 6)
+        host = max(
+            0.0,
+            wall - rec["stage_s"] - rec["dispatch_s"] - rec["fetch_block_s"],
+        )
+        rec["host_work_s"] = round(host, 6)
+        self._host_s += host
+        self._walls.append(wall)
+        self._check_stall(rec, wall)
+        rec["_emit"] = bool(
+            self._log_every and (self.n_dispatches % self._log_every == 0)
+        )
+        idx = rec["dispatch"]
+        if idx in self._ready:
+            rec["submit_ready_s"] = round(self._ready.pop(idx), 6)
+            self._emit_record(rec)
+        else:
+            self._open[idx] = rec
+
+    def _check_stall(self, rec: dict, wall: float) -> None:
+        """Compare this wall to the rolling median of the previous ones;
+        emitted regardless of log_every — a stall is the event the whole
+        stream exists to attribute."""
+        recent = self._recent
+        if self._stall_multiple > 0 and len(recent) >= STALL_MIN_SAMPLES:
+            med = sorted(recent)[len(recent) // 2]
+            if med > 0 and wall > self._stall_multiple * med:
+                self.n_loop_stalls += 1
+                self._logger.event(
+                    "loop_stall",
+                    split=self._split,
+                    epoch=self._epoch,
+                    dispatch=rec["dispatch"],
+                    wall_s=round(wall, 6),
+                    median_s=round(med, 6),
+                    multiple=self._stall_multiple,
+                    data_wait_s=rec["data_wait_s"],
+                    dispatch_s=rec["dispatch_s"],
+                    fetch_block_s=rec["fetch_block_s"],
+                    host_work_s=rec["host_work_s"],
+                )
+        recent.append(wall)
 
     def stage_begin(self) -> None:
         now = self._clock()
@@ -110,24 +203,30 @@ class StepClock:
         self.depth += steps if pinned is None else pinned
         self.n_dispatches += 1
         self.n_steps += steps
+        stage = round(getattr(self, "_last_stage", 0.0), 6)
         self._cur = {
             "split": self._split,
             "epoch": self._epoch,
             "dispatch": self.n_dispatches - 1,
             "steps": steps,
             "kind": kind,
-            "stage_s": round(getattr(self, "_last_stage", 0.0), 6),
+            "stage_s": stage,
+            "data_wait_s": stage,  # the stage window IS the data wait
             "dispatch_s": round(d, 6),
             "fetch_block_s": 0.0,
             "depth": self.depth,
         }
+        self._submits.append((self.n_dispatches - 1, now))
         self._heartbeat()
 
     def fetched(self, wait_s: float, steps: int = 1,
-                pinned: Optional[int] = None) -> None:
+                pinned: Optional[int] = None,
+                at: Optional[float] = None) -> None:
         """One deferred metric fetch completed on the backpressure path
         (wait_s = how long the host was blocked in the device_get the
-        loop performs anyway)."""
+        loop performs anyway; `at` = the completion timestamp from the
+        same perf_counter read the loop already took, which proves the
+        oldest pending dispatch finished and yields its submit→ready)."""
         self.depth = max(0, self.depth - (steps if pinned is None else pinned))
         self._fetch_s += wait_s
         if self._cur is not None:
@@ -135,21 +234,35 @@ class StepClock:
                 self._cur["fetch_block_s"] + wait_s, 6
             )
             self._cur["depth"] = self.depth
+        if self._submits:
+            idx, t_submit = self._submits.popleft()
+            if at is not None:
+                self._resolve_ready(idx, max(0.0, at - t_submit))
         self._heartbeat()
 
-    def drained(self, wait_s: float, n_entries: int = 0) -> None:
-        """End-of-pass fetch of all still-pending metric entries."""
+    def drained(self, wait_s: float, n_entries: int = 0,
+                at: Optional[float] = None) -> None:
+        """End-of-pass fetch of all still-pending metric entries; every
+        remaining dispatch is proven finished at `at`."""
         self._drain_s += wait_s
         self.depth = 0
+        while self._submits:
+            idx, t_submit = self._submits.popleft()
+            if at is not None:
+                self._resolve_ready(idx, max(0.0, at - t_submit))
         self._heartbeat()
 
     def finish(self) -> dict:
-        """Close the pass: emit and return the `epoch_steps` aggregate."""
+        """Close the pass: flush records still awaiting readiness (a
+        legacy caller may never pass `at`), then emit and return the
+        `epoch_steps` aggregate."""
         now = self._clock()
         self._close_record(now)
+        for idx in sorted(self._open):
+            self._emit_record(self._open.pop(idx))
         wall = now - self._t_open
         walls = sorted(self._walls)
-        busy = self._stage_s + self._dispatch_s + self._fetch_s
+        ready = sorted(self._ready_vals)
         agg = {
             "split": self._split,
             "epoch": self._epoch,
@@ -166,6 +279,11 @@ class StepClock:
             "wall_p50_s": round(_percentile(walls, 0.50), 6),
             "wall_p90_s": round(_percentile(walls, 0.90), 6),
             "wall_max_s": round(walls[-1], 6) if walls else float("nan"),
+            "host_work_s": round(self._host_s, 6),
+            "submit_ready_p50_s": round(_percentile(ready, 0.50), 6) if ready else None,
+            "submit_ready_p90_s": round(_percentile(ready, 0.90), 6) if ready else None,
+            "submit_ready_max_s": round(ready[-1], 6) if ready else None,
+            "n_loop_stalls": self.n_loop_stalls,
         }
         self._logger.event("epoch_steps", **agg)
         self._heartbeat()
@@ -180,6 +298,7 @@ class NullStepClock(StepClock):
         self.depth = 0
         self.n_dispatches = 0
         self.n_steps = 0
+        self.n_loop_stalls = 0
 
     def stage_begin(self):
         pass
@@ -190,10 +309,10 @@ class NullStepClock(StepClock):
     def dispatched(self, steps=1, pinned=None, kind="single"):
         pass
 
-    def fetched(self, wait_s, steps=1, pinned=None):
+    def fetched(self, wait_s, steps=1, pinned=None, at=None):
         pass
 
-    def drained(self, wait_s, n_entries=0):
+    def drained(self, wait_s, n_entries=0, at=None):
         pass
 
     def finish(self):

@@ -24,9 +24,11 @@ class Telemetry:
         logger: MetricsLogger,
         step_log_every: int = 1,
         watchdog: Optional[StallWatchdog] = None,
+        stall_multiple: float = 0.0,
     ):
         self.logger = logger
         self.step_log_every = step_log_every
+        self.stall_multiple = stall_multiple
         self.watchdog = watchdog
         self._clock: Optional[StepClock] = None
         if watchdog is not None:
@@ -48,6 +50,7 @@ class Telemetry:
         clock = StepClock(
             self.logger, epoch, split=split,
             log_every=self.step_log_every, heartbeat=beat,
+            stall_multiple=self.stall_multiple,
         )
         self._clock = clock
         if self.watchdog is not None:
@@ -79,6 +82,7 @@ class NullTelemetry(Telemetry):
     def __init__(self):
         self.logger = NullMetricsLogger()
         self.step_log_every = 0
+        self.stall_multiple = 0.0
         self.watchdog = None
         self._clock = None
 
@@ -135,4 +139,5 @@ def make_telemetry(obs_config, output_dir: str, primary: bool = True) -> Telemet
         logger,
         step_log_every=int(getattr(obs_config, "step_log_every", 1)),
         watchdog=watchdog,
+        stall_multiple=float(getattr(obs_config, "stall_multiple", 0.0) or 0.0),
     )

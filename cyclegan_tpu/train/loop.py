@@ -158,8 +158,12 @@ def train_epoch(
             oldest = pending.pop(0)
             t_fetch = perf_counter()
             fetched.append(jax.device_get(oldest))  # sanctioned-fetch: bounded backpressure window
-            clock.fetched(perf_counter() - t_fetch,
-                          steps=oldest[1], pinned=oldest[2])
+            t_ready = perf_counter()
+            # The completion timestamp doubles as the submit→ready proof
+            # for the fetched dispatch (stepclock attribution) — same
+            # perf_counter read, no extra sync.
+            clock.fetched(t_ready - t_fetch,
+                          steps=oldest[1], pinned=oldest[2], at=t_ready)
 
     multi = multi_step_fn is not None and k > 1
     staged = _staged_batches(config, data, plan, epoch, multi)
@@ -215,7 +219,8 @@ def train_epoch(
 
     t_drain = perf_counter()
     tail = jax.device_get(pending)  # sanctioned-fetch: end-of-epoch drain
-    clock.drained(perf_counter() - t_drain, n_entries=len(pending))
+    t_ready = perf_counter()
+    clock.drained(t_ready - t_drain, n_entries=len(pending), at=t_ready)
     results: Dict[str, list] = {}
     for metrics, steps, _ in fetched + tail:
         if steps == 1:
@@ -260,10 +265,12 @@ def test_epoch(
         if len(pending) > MAX_IN_FLIGHT:
             t_fetch = perf_counter()
             fetched.append(jax.device_get(pending.pop(0)))  # sanctioned-fetch: bounded backpressure window
-            clock.fetched(perf_counter() - t_fetch)
+            t_ready = perf_counter()
+            clock.fetched(t_ready - t_fetch, at=t_ready)
     t_drain = perf_counter()
     tail = jax.device_get(pending)  # sanctioned-fetch: end-of-pass drain
-    clock.drained(perf_counter() - t_drain, n_entries=len(pending))
+    t_ready = perf_counter()
+    clock.drained(t_ready - t_drain, n_entries=len(pending), at=t_ready)
     results: Dict[str, list] = {}
     for metrics in fetched + tail:
         append_dict(results, metrics)

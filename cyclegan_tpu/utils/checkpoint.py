@@ -30,15 +30,39 @@ class Checkpointer:
         self.meta_path = os.path.join(self.dir, "meta.json")
         self._ckptr = ocp.StandardCheckpointer()
 
-    def save(self, state: CycleGANState, epoch: int, meta: Optional[dict] = None) -> None:
+    def save(self, state: CycleGANState, epoch: int, meta: Optional[dict] = None,
+             services=None) -> None:
         """Overwrite the single slot (reference .write semantics,
         main.py:157-160) and record the epoch counter plus any extra
         metadata (main.py passes the model architecture, making the slot
         self-describing — translate.py rebuilds the right network without
-        the user re-specifying --filters etc.)."""
+        the user re-specifying --filters etc.).
+
+        `services` (an utils.services.EpochServices) makes the save
+        asynchronous: Orbax's `save()` returns once the state is fetched
+        to host (so the caller may immediately donate/overwrite the
+        device buffers), and the commit barrier + sidecar write move to
+        the service thread. The caller owns the completion contract:
+        `services.barrier()` (or close()) before process exit.
+
+        Crash semantics either way: Orbax materializes the slot in a tmp
+        dir and renames it into place, so `restore_if_exists` sees the
+        previous complete slot or the new complete slot, never a torn
+        one. The sidecar is written only AFTER the commit barrier, so a
+        crash mid-save leaves the previous epoch's meta.json paired with
+        whichever complete slot survives. (Worst case — crash between
+        slot rename and sidecar write — resume re-runs the last saved
+        epoch; it never reads a half-written state.)"""
         self._ckptr.save(self.slot, state, force=True)
-        # StandardCheckpointer saves asynchronously; block until the slot
-        # is committed so the overwrite/auto-resume contract holds.
+        if services is not None:
+            services.submit(f"checkpoint:e{epoch}", self._finalize_save,
+                            epoch, meta)
+        else:
+            self._finalize_save(epoch, meta)
+
+    def _finalize_save(self, epoch: int, meta: Optional[dict]) -> None:
+        """Block until the slot is committed, then write the epoch
+        sidecar. Runs synchronously or on the epoch-services thread."""
         self._ckptr.wait_until_finished()
         if jax.process_index() == 0:
             record = dict(meta or {})

@@ -18,19 +18,35 @@ def to_uint8(x: np.ndarray) -> np.ndarray:
     return np.clip((np.asarray(x, np.float32) + 1.0) * 127.5, 0, 255).astype(np.uint8)
 
 
-def plot_cycle(plot_pairs, cycle_fn, state, summary: Summary, epoch: int) -> None:
+def plot_cycle(plot_pairs, cycle_fn, state, summary: Summary, epoch: int,
+               services=None) -> None:
     """cycle_fn: (state, x, y) -> (fake_x, fake_y, cycle_x, cycle_y)
-    (the jitted inference step, train/steps.py make_cycle_step)."""
+    (the jitted inference step, train/steps.py make_cycle_step).
+
+    The device inference and the D2H pull (`to_uint8`'s np.asarray) run
+    on the calling thread — they are data-dependent on `state`, which
+    the next train step may donate. The expensive part — matplotlib
+    panel rendering + PNG encode inside `summary.image_cycle` — takes
+    only the fetched uint8 host copies, so with `services` (an
+    utils.services.EpochServices) it moves off the dispatch path onto
+    the worker thread."""
     x_rows, y_rows = [], []
     for x, y in plot_pairs:
         fake_x, fake_y, cycle_x, cycle_y = cycle_fn(state, x, y)
         x_rows.append(np.stack([to_uint8(x[0]), to_uint8(fake_y[0]), to_uint8(cycle_x[0])]))
         y_rows.append(np.stack([to_uint8(y[0]), to_uint8(fake_x[0]), to_uint8(cycle_y[0])]))
-    x_cycle = np.stack(x_rows)  # [n, 3, H, W, C]
+    x_cycle = np.stack(x_rows)  # [n, 3, H, W, C] uint8, host-resident
     y_cycle = np.stack(y_rows)
-    summary.image_cycle(
-        "X_cycle", x_cycle, titles=["X", "G(X)", "F(G(X))"], step=epoch, training=False
-    )
-    summary.image_cycle(
-        "Y_cycle", y_cycle, titles=["Y", "F(Y)", "G(F(Y))"], step=epoch, training=False
-    )
+
+    def write() -> None:
+        summary.image_cycle(
+            "X_cycle", x_cycle, titles=["X", "G(X)", "F(G(X))"], step=epoch, training=False
+        )
+        summary.image_cycle(
+            "Y_cycle", y_cycle, titles=["Y", "F(Y)", "G(F(Y))"], step=epoch, training=False
+        )
+
+    if services is not None:
+        services.submit(f"plot_cycle:e{epoch}", write)
+    else:
+        write()

@@ -1,0 +1,114 @@
+"""Background epoch services: host-side I/O off the dispatch path.
+
+The r5 chip run (docs/chip_logs/r05/timed_main.log) showed the epoch
+boundary serializing the dispatch pipeline: checkpoint commit waits,
+matplotlib cycle panels, and summary/image encoding all ran on the loop
+thread between the last dispatch of one epoch and the first of the
+next. None of that work needs the device or the loop thread — it
+operates on already-fetched host copies.
+
+`EpochServices` is a single daemon worker thread with a job queue:
+
+- `submit(name, fn, *args)` enqueues a job and returns immediately;
+  the loop thread never blocks on host I/O.
+- `barrier()` blocks until every submitted job has finished — called
+  at preemption and at process exit (`close()`), the ONLY points where
+  the training loop is allowed to wait on epoch services. This is the
+  async-checkpoint completion contract: a clean exit (or a preemption
+  grace window) always commits the last save first.
+- Job exceptions never propagate into the worker (the thread survives);
+  they are recorded in `errors`, echoed once, and emitted as
+  `service_error` telemetry events. Each completed job emits a
+  `service_job` event with its wall time so obs_report can show what
+  the boundary cost would have been on the dispatch path.
+
+One worker on purpose: jobs run in submission order, so a checkpoint
+commit barrier queued before a plot render finishes first, and two
+saves can never interleave their sidecar writes.
+
+The worker must never touch the device (a `device_get` here would
+re-serialize what this module exists to overlap) — the file is on
+`tools/check_no_sync.py`'s hot-path list with no sanctioned sites.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from time import perf_counter
+from typing import Callable, List, Optional
+
+
+class EpochServices:
+    def __init__(self, telemetry=None, echo: Callable[[str], None] = print):
+        self._tele = telemetry
+        self._echo = echo
+        self._q: "queue.Queue" = queue.Queue()
+        self._cv = threading.Condition()
+        self._pending = 0
+        self._closed = False
+        self.errors: List[str] = []
+        self._thread = threading.Thread(
+            target=self._run, name="epoch-services", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def pending(self) -> int:
+        with self._cv:
+            return self._pending
+
+    def submit(self, name: str, fn: Callable, *args, **kwargs) -> None:
+        """Enqueue `fn(*args, **kwargs)`; returns immediately. After
+        close() the job runs inline — late work (a final flush in an
+        exit path) must not be dropped silently."""
+        if self._closed:
+            self._run_job(name, fn, args, kwargs)
+            return
+        with self._cv:
+            self._pending += 1
+        self._q.put((name, fn, args, kwargs))
+
+    def _run_job(self, name, fn, args, kwargs) -> None:
+        t0 = perf_counter()
+        try:
+            fn(*args, **kwargs)
+        except Exception as e:  # job failures must not kill the worker
+            msg = f"{name}: {type(e).__name__}: {e}"
+            self.errors.append(msg)
+            self._echo(f"epoch-services job failed — {msg}")
+            if self._tele is not None:
+                self._tele.event("service_error", job=name, error=msg[:500])
+            return
+        if self._tele is not None:
+            self._tele.event(
+                "service_job", job=name, seconds=round(perf_counter() - t0, 6)
+            )
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            name, fn, args, kwargs = item
+            try:
+                self._run_job(name, fn, args, kwargs)
+            finally:
+                with self._cv:
+                    self._pending -= 1
+                    self._cv.notify_all()
+
+    def barrier(self, timeout: Optional[float] = None) -> bool:
+        """Wait until all submitted jobs completed. Returns False on
+        timeout (jobs still pending), True otherwise."""
+        with self._cv:
+            return self._cv.wait_for(lambda: self._pending == 0, timeout)
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Barrier, then stop the worker. Idempotent."""
+        done = self.barrier(timeout)
+        if not self._closed:
+            self._closed = True
+            self._q.put(None)
+            self._thread.join(timeout)
+        return done

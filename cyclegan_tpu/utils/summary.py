@@ -7,6 +7,12 @@ matplotlib-figure summaries under the same tag names.
 
 Implemented over tensorboardX (pure-Python event writer) — no TF runtime
 in the logging path.
+
+Thread use: epoch-boundary image/figure writes may run on the
+epoch-services worker thread (utils/services.py) while the loop thread
+keeps writing scalars — tensorboardX serializes appends through its own
+event-writer queue, and the figure path pins the headless Agg backend
+below so matplotlib never needs the main thread.
 """
 
 from __future__ import annotations
@@ -16,6 +22,11 @@ import os
 from typing import Optional
 
 import numpy as np
+
+# Figure rendering can happen on a background thread; GUI backends are
+# main-thread-only (and absent in training containers anyway). Set
+# before any matplotlib import resolves the backend.
+os.environ.setdefault("MPLBACKEND", "Agg")
 
 
 class Summary:

@@ -51,6 +51,7 @@ def main(args: argparse.Namespace) -> None:
     from cyclegan_tpu.utils.checkpoint import Checkpointer
     from cyclegan_tpu.utils.preemption import PreemptionGuard
     from cyclegan_tpu.utils.profiler import maybe_trace
+    from cyclegan_tpu.utils.services import EpochServices
 
     # Multi-host pods: one process per host, global arrays, DCN-aware
     # collectives. No-op on single-host (SURVEY.md §2.3 — the capability
@@ -110,6 +111,7 @@ def main(args: argparse.Namespace) -> None:
             watchdog_deadline_s=args.watchdog_deadline,
             step_log_every=args.obs_step_log_every,
             memory_sample_every=args.obs_memory_every,
+            stall_multiple=args.obs_stall_multiple,
         ),
     )
     if config.train.grad_accum < 1 or config.train.steps_per_dispatch < 1:
@@ -246,6 +248,24 @@ def main(args: argparse.Namespace) -> None:
     guard = PreemptionGuard(on_signal=(summary.flush, tele.flush))
     tracer = maybe_trace(config.train.output_dir, args.trace if primary else 0)
 
+    # Epoch-boundary host I/O (checkpoint commit + sidecar, cycle-panel
+    # rendering, FID host math) runs on this worker so the next epoch's
+    # first dispatch is never held hostage to it; the loop only barriers
+    # at preemption/exit. Every host runs one (the checkpoint commit
+    # wait is per-process); non-primary jobs are cheap no-op writes.
+    services = EpochServices(telemetry=tele)
+    # FID off the critical path is single-process only: from the worker
+    # thread its device dispatches interleave with the next epoch's, but
+    # on multi-host meshes that interleaving could reorder collectives
+    # differently per host — there the sweep stays synchronous.
+    async_fid = jax.process_count() == 1
+
+    def run_fid(fid_state, epoch):
+        for key, value in fid_eval(fid_state).items():
+            summary.scalar(key, value, step=epoch, training=False)
+            if primary:
+                print(f"{key}: {value:.4f}")
+
     run_status = "failed"  # until the epoch loop exits cleanly
     try:
         for epoch in range(start_epoch, config.train.epochs):
@@ -256,6 +276,7 @@ def main(args: argparse.Namespace) -> None:
                 config, data, plan, train_step, state, summary, epoch,
                 tracer=tracer, multi_step_fn=multi_step, obs=tele,
             )
+            train_elapse = time() - start
             results = loop.test_epoch(
                 config, data, plan, test_step, state, summary, epoch,
                 obs=tele,
@@ -264,11 +285,16 @@ def main(args: argparse.Namespace) -> None:
             summary.scalar("elapse", elapse, step=epoch)
             ips = loop.images_per_sec(2 * data.n_train, elapse)
             summary.scalar("images_per_sec", ips, step=epoch)
+            # Train-only throughput next to the whole-epoch number: the
+            # epoch window includes the test pass, so `images_per_sec`
+            # under-reads the training rate (the "two-phase mush") —
+            # perf/* utilization derives from the train-only elapse.
+            train_ips = loop.images_per_sec(2 * data.n_train, train_elapse)
+            summary.scalar("perf/train_images_per_sec", train_ips, step=epoch)
             # Absolute utilization next to raw throughput: analytic step
-            # FLOPs (utils/flops.py) x achieved rate, plus MFU when the
-            # chip's bf16 peak is known. The epoch window includes the
-            # test pass, so this is a conservative lower bound.
-            tflops = ips * flops_per_image / 1e12
+            # FLOPs (utils/flops.py) x achieved TRAIN rate, plus MFU when
+            # the chip's bf16 peak is known.
+            tflops = train_ips * flops_per_image / 1e12
             mfu = tflops / peak_tflops if peak_tflops else None
             summary.scalar("perf/tflops_per_sec", tflops, step=epoch)
             if mfu is not None:
@@ -279,7 +305,9 @@ def main(args: argparse.Namespace) -> None:
             tele.epoch(
                 epoch,
                 elapse_s=round(elapse, 4),
+                train_elapse_s=round(train_elapse, 4),
                 images_per_sec=round(ips, 4),
+                train_images_per_sec=round(train_ips, 4),
                 tflops_per_sec=round(tflops, 6),
                 mfu=round(mfu, 6) if mfu is not None else None,
                 test_metrics={key: float(v) for key, v in results.items()},
@@ -297,21 +325,44 @@ def main(args: argparse.Namespace) -> None:
             if fid_eval is not None and not preempted and (
                 last or (epoch + 1) % args.fid_every == 0
             ):
-                for key, value in fid_eval(state).items():
-                    summary.scalar(key, value, step=epoch, training=False)
-                    if primary:
-                        print(f"{key}: {value:.4f}")
-                # The FID sweep takes minutes at full size — a SIGTERM
-                # landing during it must still checkpoint below.
-                preempted = preempted or guard.should_stop()
+                if async_fid:
+                    # Snapshot the generator params (device-side copy, no
+                    # sync): the next epoch's first train step donates
+                    # `state`'s buffers, and FID's device work must
+                    # interleave with — not read from under — it.
+                    import types
+
+                    import jax.numpy as jnp
+
+                    snap = types.SimpleNamespace(
+                        g_params=jax.tree.map(jnp.copy, state.g_params),
+                        f_params=jax.tree.map(jnp.copy, state.f_params),
+                    )
+                    services.submit(f"fid:e{epoch}", run_fid, snap, epoch)
+                else:
+                    run_fid(state, epoch)
+                    # The FID sweep takes minutes at full size — a SIGTERM
+                    # landing during it must still checkpoint below.
+                    preempted = preempted or guard.should_stop()
             if preempted or last or epoch % config.train.checkpoint_every == 0:
-                ckpt.save(state, epoch, meta=config.model_meta())
+                # Async save: Orbax fetches the state before returning
+                # (safe against the next step's donation); commit barrier
+                # + sidecar land on the services thread.
+                ckpt.save(state, epoch, meta=config.model_meta(),
+                          services=services)
                 if primary:
-                    print(f"saved checkpoint to {ckpt.slot}")
+                    print(f"saving checkpoint to {ckpt.slot} "
+                          f"(commit off the dispatch path)")
                 # Every host must run the jitted cycle inference (state is
                 # a global array); only host 0's summary writes anything.
-                plot_cycle(data.plot_pairs(), cycle_step, state, summary, epoch)
+                # Panel rendering rides the services thread too.
+                plot_cycle(data.plot_pairs(), cycle_step, state, summary,
+                           epoch, services=services)
             if preempted:
+                # The one mid-run barrier: the grace window belongs to the
+                # checkpoint commit, so block until it (and any queued
+                # plot/FID work) lands before exiting.
+                services.barrier()
                 if primary:
                     print("preemption requested: checkpointed, exiting cleanly")
                 run_status = "preempted"
@@ -323,8 +374,14 @@ def main(args: argparse.Namespace) -> None:
         # Flush the in-flight trace even when an epoch raises — profiling
         # data from a crashed run is the data you want most. Same for the
         # telemetry stream: close() writes the `end` event and stops the
-        # watchdog thread.
+        # watchdog thread. The services barrier comes first: a queued
+        # checkpoint commit must land before the writers close (this is
+        # the async-save exit contract).
         tracer.stop()
+        services.close()
+        if services.errors and primary:
+            print(f"epoch-services: {len(services.errors)} background "
+                  f"job(s) failed: " + "; ".join(services.errors[:3]))
         summary.close()
         tele.close(status=run_status)
 
@@ -471,6 +528,12 @@ if __name__ == "__main__":
                         metavar="N",
                         help="sample per-device HBM watermarks every N "
                              "epochs (0 disables)")
+    parser.add_argument("--obs_stall_multiple", default=10.0, type=float,
+                        metavar="X",
+                        help="emit a `loop_stall` telemetry event when one "
+                             "dispatch's loop-iteration wall exceeds X times "
+                             "the rolling median (32-dispatch window, armed "
+                             "after 5 dispatches); 0 disables")
     parser.add_argument("--expect_partial", action="store_true",
                         help="tolerate checkpoint/model mismatches on resume: "
                              "restore matching leaves, keep fresh init for the "

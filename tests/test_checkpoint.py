@@ -58,6 +58,86 @@ def test_single_slot_overwrite(tiny_config, tmp_path):
     assert _tree_equal(state2.g_params, restored.g_params)
 
 
+def test_async_save_roundtrips_after_barrier(tiny_config, tmp_path):
+    """save(services=...) moves the commit barrier + sidecar off the
+    caller thread; after barrier() the slot must be complete and the
+    epoch counter correct — the async-checkpoint completion contract."""
+    from cyclegan_tpu.utils.services import EpochServices
+
+    state = create_state(tiny_config, jax.random.PRNGKey(0))
+    ckpt = Checkpointer(str(tmp_path))
+    svc = EpochServices(echo=lambda *_: None)
+    ckpt.save(state, epoch=4, meta={"tag": "async"}, services=svc)
+    assert svc.barrier(timeout=120)
+    assert not svc.errors
+    restored, next_epoch = ckpt.restore(jax.eval_shape(lambda: state))
+    assert next_epoch == 5
+    assert ckpt.read_meta()["tag"] == "async"
+    assert _tree_equal(state.g_params, restored.g_params)
+    svc.close(timeout=10)
+
+
+class _GatedCkptr:
+    """Stand-in Orbax checkpointer whose commit barrier blocks until the
+    test releases it — makes the sidecar ordering observable."""
+
+    def __init__(self):
+        self.gate = __import__("threading").Event()
+        self.wait_calls = 0
+
+    def save(self, path, state, force=True):
+        pass
+
+    def wait_until_finished(self):
+        self.wait_calls += 1
+        assert self.gate.wait(10)
+
+    def close(self):
+        pass
+
+
+def test_async_sidecar_written_only_after_commit_barrier(tmp_path):
+    """meta.json pairs an epoch with a COMMITTED slot. If it were
+    written before wait_until_finished, a crash mid-commit could leave
+    a fresh sidecar pointing at a torn/previous slot and auto-resume
+    would skip re-running the lost epoch."""
+    import os
+    import time
+
+    from cyclegan_tpu.utils.services import EpochServices
+
+    ckpt = Checkpointer(str(tmp_path))
+    gated = _GatedCkptr()
+    ckpt._ckptr = gated
+    svc = EpochServices(echo=lambda *_: None)
+    ckpt.save({"w": 1}, epoch=9, services=svc)
+    # save() returned, but the commit is gated: no sidecar may exist yet.
+    deadline = time.monotonic() + 1.0
+    while time.monotonic() < deadline and gated.wait_calls == 0:
+        time.sleep(0.01)  # let the service thread reach the barrier
+    assert not os.path.exists(ckpt.meta_path)
+    gated.gate.set()
+    assert svc.barrier(timeout=10)
+    assert ckpt.read_meta()["epoch"] == 9
+    assert gated.wait_calls == 1
+    svc.close(timeout=10)
+
+
+def test_restore_if_exists_ignores_partial_orbax_tmp(tiny_config, tmp_path):
+    """A crash mid-save leaves only Orbax's tmp dir (the rename into the
+    slot path is the commit point). Auto-resume must see 'no checkpoint',
+    never a torn slot."""
+    import os
+
+    state = create_state(tiny_config, jax.random.PRNGKey(0))
+    ckpt = Checkpointer(str(tmp_path))
+    os.makedirs(
+        os.path.join(ckpt.dir, "checkpoint.orbax-checkpoint-tmp-1234567890")
+    )
+    out, epoch, resumed = ckpt.restore_if_exists(state)
+    assert not resumed and epoch == 0 and out is state
+
+
 def test_partial_restore_grafts_matching_leaves(tiny_config, tmp_path):
     """partial=True (reference expect_partial, main.py:165-169): after an
     architecture tweak, matching leaves restore and mismatched ones keep

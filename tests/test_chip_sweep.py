@@ -140,6 +140,71 @@ def test_accum_spec_routes_to_bench_accum(tmp_path, monkeypatch):
     assert rows[0]["img_per_sec"] == 12.34
 
 
+def test_classify_error_oom_vs_infra_vs_other():
+    # The actual r5 failure string (docs/bench_sweeps.json) must classify
+    # as infra, a plain OOM as a result, and anything else as other.
+    assert chip_sweep.classify_error(
+        "JaxRuntimeError: INTERNAL: http://127.0.0.1:8083/remote_compile: "
+        "HTTP 500: tpu_compile_helper subprocess exit code 1") == "infra"
+    assert chip_sweep.classify_error(
+        "XlaRuntimeError: RESOURCE_EXHAUSTED: Attempting to allocate "
+        "12.5G") == "oom"
+    assert chip_sweep.classify_error("ValueError: bad shapes") == "other"
+    # An OOM whose message also mentions the relay is still an OOM: it
+    # IS the measurement the sweep exists to take.
+    assert chip_sweep.classify_error(
+        "remote_compile returned RESOURCE_EXHAUSTED: out of memory"
+    ) == "oom"
+
+
+def test_infra_error_not_recorded_and_flagged(tmp_path, monkeypatch):
+    """A compile-relay death must not enter the ground-truth record file
+    (it says nothing about the config), and run_spec must report it so
+    main() can exit nonzero for the autorun driver."""
+    import types
+
+    stub = types.ModuleType("bench")
+
+    def die(*a, **k):
+        raise RuntimeError(
+            "INTERNAL: http://127.0.0.1:8083/remote_compile: HTTP 500: "
+            "tpu_compile_helper subprocess exit code 1")
+
+    stub.bench_scan = die
+    monkeypatch.setitem(sys.modules, "bench", stub)
+    monkeypatch.setattr(chip_sweep, "RECORD_PATH", str(tmp_path / "rec.json"))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert chip_sweep.run_spec("scan:b2i64") is True
+    assert not (tmp_path / "rec.json").exists()
+
+
+def test_oom_recorded_as_result_row(tmp_path, monkeypatch):
+    import types
+
+    stub = types.ModuleType("bench")
+
+    def die(*a, **k):
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating 8G")
+
+    stub.bench_scan = die
+    monkeypatch.setitem(sys.modules, "bench", stub)
+    monkeypatch.setattr(chip_sweep, "RECORD_PATH", str(tmp_path / "rec.json"))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert chip_sweep.run_spec("scan:b2i64") is False
+    rows = json.loads((tmp_path / "rec.json").read_text())
+    assert rows[0]["key"] == "scan:b2i64"
+    assert "RESOURCE_EXHAUSTED" in rows[0]["error"]
+
+
+def test_main_exits_3_when_any_spec_dies_on_infra(tmp_path, monkeypatch):
+    monkeypatch.setattr(chip_sweep, "RECORD_PATH", str(tmp_path / "rec.json"))
+    monkeypatch.setattr(chip_sweep, "run_spec", lambda spec: True)
+    monkeypatch.setattr(sys, "argv", ["chip_sweep.py", "scan:b2i64"])
+    with pytest.raises(SystemExit) as exc:
+        chip_sweep.main()
+    assert exc.value.code == 3
+
+
 def test_corrupt_record_aborts_before_measuring(tmp_path):
     rec = tmp_path / "rec.json"
     rec.write_text("{corrupt")

@@ -240,6 +240,106 @@ def test_stepclock_depth_tracks_pinned_batches(tmp_path):
     assert clock.depth == 0
 
 
+def test_stepclock_loop_stall_event(tmp_path):
+    """One dispatch whose loop-iteration wall blows past the rolling
+    median must emit a loop_stall event carrying its attribution split —
+    even at log_every=0, where per-step events are suppressed."""
+    path = str(tmp_path / "t.jsonl")
+    log = MetricsLogger(path)
+    times = [0.0]
+    t = 0.0
+    for _ in range(6):  # six uniform 1.0s iterations arm + seed the median
+        times += [t, t + 0.2, t + 0.4]
+        t += 1.0
+    times += [t, t + 0.2, t + 0.4]  # outlier iteration ...
+    times += [t + 30.0]             # ... closed by finish() at wall 30.0
+    clock = StepClock(log, epoch=1, split="train", log_every=0,
+                      stall_multiple=10.0, clock=_scripted_clock(times))
+    for _ in range(7):
+        clock.stage_begin(); clock.staged(); clock.dispatched()
+    agg = clock.finish()
+    log.close()
+
+    evs = _events(path)
+    stalls = [e for e in evs if e["event"] == "loop_stall"]
+    assert len(stalls) == 1
+    s = stalls[0]
+    assert s["dispatch"] == 6 and s["split"] == "train" and s["epoch"] == 1
+    assert s["wall_s"] == pytest.approx(30.0)
+    assert s["median_s"] == pytest.approx(1.0)
+    for key in ("data_wait_s", "dispatch_s", "fetch_block_s", "host_work_s"):
+        assert key in s
+    assert agg["n_loop_stalls"] == 1
+    # log_every=0 still suppressed the per-step records themselves.
+    assert [e["event"] for e in evs if e["event"] == "step"] == []
+
+
+def test_stepclock_stall_detection_needs_min_samples(tmp_path):
+    """The first dispatch (compile) is routinely 100x the rest; with
+    fewer than STALL_MIN_SAMPLES prior walls nothing may fire."""
+    path = str(tmp_path / "t.jsonl")
+    log = MetricsLogger(path)
+    # iteration 0: 60s (compile); then three 1.0s iterations.
+    times = [0.0, 0.0, 0.1, 0.2, 60.0, 60.1, 60.2,
+             61.0, 61.1, 61.2, 62.0, 62.1, 62.2, 63.0]
+    clock = StepClock(log, epoch=0, split="train", log_every=0,
+                      stall_multiple=10.0, clock=_scripted_clock(times))
+    for _ in range(4):
+        clock.stage_begin(); clock.staged(); clock.dispatched()
+    agg = clock.finish()
+    log.close()
+    assert agg["n_loop_stalls"] == 0
+    assert all(e["event"] != "loop_stall" for e in _events(path))
+
+
+def test_stepclock_submit_ready_from_deferred_fetch(tmp_path):
+    """The loop's backpressure fetch proves the oldest dispatch finished;
+    its submit→ready latency must land in that dispatch's OWN record,
+    even though the record's wall closed earlier."""
+    path = str(tmp_path / "t.jsonl")
+    log = MetricsLogger(path)
+    times = [0.0,
+             0.0, 0.1, 0.2,   # d0 submitted at 0.2
+             1.0, 1.1, 1.2,   # closes d0 at wall 1.0; d1 submitted at 1.2
+             2.0]             # finish closes d1
+    clock = StepClock(log, epoch=0, split="train", log_every=1,
+                      clock=_scripted_clock(times))
+    clock.stage_begin(); clock.staged(); clock.dispatched()
+    clock.stage_begin(); clock.staged(); clock.dispatched()
+    clock.fetched(0.05, at=1.7)  # d0 proven ready at 1.7 -> 1.5s latency
+    agg = clock.finish()
+    log.close()
+
+    steps = [e for e in _events(path) if e["event"] == "step"]
+    assert [e["dispatch"] for e in steps] == [0, 1]
+    assert steps[0]["submit_ready_s"] == pytest.approx(1.5)
+    assert steps[0]["host_work_s"] >= 0.0
+    assert "submit_ready_s" not in steps[1]  # never proven ready
+    assert agg["submit_ready_p50_s"] == pytest.approx(1.5)
+    assert agg["submit_ready_max_s"] == pytest.approx(1.5)
+
+
+def test_stepclock_drain_resolves_all_pending_submits(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    log = MetricsLogger(path)
+    times = [0.0,
+             0.0, 0.1, 0.2,   # d0 submitted at 0.2
+             1.0, 1.1, 1.2,   # d1 submitted at 1.2
+             5.0]             # finish
+    clock = StepClock(log, epoch=0, split="test", log_every=1,
+                      clock=_scripted_clock(times))
+    clock.stage_begin(); clock.staged(); clock.dispatched()
+    clock.stage_begin(); clock.staged(); clock.dispatched()
+    clock.drained(0.3, n_entries=2, at=3.2)  # both proven ready at 3.2
+    agg = clock.finish()
+    log.close()
+
+    steps = [e for e in _events(path) if e["event"] == "step"]
+    assert steps[0]["submit_ready_s"] == pytest.approx(3.0)
+    assert steps[1]["submit_ready_s"] == pytest.approx(2.0)
+    assert agg["submit_ready_max_s"] == pytest.approx(3.0)
+
+
 def test_stepclock_log_every_zero_keeps_only_aggregate(tmp_path):
     path = str(tmp_path / "t.jsonl")
     log = MetricsLogger(path)

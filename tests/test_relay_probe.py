@@ -44,6 +44,10 @@ def test_status_sees_real_listener(monkeypatch):
     _clear_env(monkeypatch)
     monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
     srv = socket.socket()
+    # Before the try: the finally below iterates it, and pytest.skip on a
+    # failed bind() would otherwise reach it unbound (UnboundLocalError
+    # masking the skip).
+    accepted = []
     try:
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         try:
@@ -53,7 +57,6 @@ def test_status_sees_real_listener(monkeypatch):
 
             pytest.skip("port 8093 unavailable in this environment")
         srv.listen(4)
-        accepted = []
 
         def accept_loop():
             try:

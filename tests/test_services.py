@@ -1,0 +1,71 @@
+"""EpochServices semantics: FIFO single-worker ordering, the barrier
+completion contract, error containment, and inline execution after
+close — the invariants the async epoch boundary (checkpoint commit,
+plot rendering, FID) is built on."""
+
+import threading
+
+from cyclegan_tpu.utils.services import EpochServices
+
+
+class _FakeTele:
+    def __init__(self):
+        self.events = []
+
+    def event(self, kind, **kw):
+        self.events.append((kind, kw))
+
+
+def test_jobs_run_in_submission_order_and_barrier_waits():
+    tele = _FakeTele()
+    svc = EpochServices(telemetry=tele, echo=lambda *_: None)
+    out = []
+    gate = threading.Event()
+    svc.submit("slow", lambda: (gate.wait(5), out.append("slow")))
+    svc.submit("fast", out.append, "fast")
+    assert svc.barrier(timeout=0.05) is False  # slow job still gated
+    gate.set()
+    assert svc.barrier(timeout=10) is True
+    # Single worker: strict submission order, never interleaved.
+    assert out == ["slow", "fast"]
+    assert [k for k, _ in tele.events] == ["service_job", "service_job"]
+    assert tele.events[0][1]["job"] == "slow"
+    assert tele.events[0][1]["seconds"] >= 0
+    assert svc.close(timeout=10) is True
+
+
+def test_job_error_recorded_and_worker_survives():
+    tele = _FakeTele()
+    echoed = []
+    svc = EpochServices(telemetry=tele, echo=echoed.append)
+    svc.submit("boom", lambda: 1 / 0)
+    out = []
+    svc.submit("after", out.append, 1)
+    assert svc.barrier(timeout=10)
+    assert out == [1]  # the worker outlived the failing job
+    assert len(svc.errors) == 1 and "ZeroDivisionError" in svc.errors[0]
+    assert echoed and "boom" in echoed[0]
+    kinds = [k for k, _ in tele.events]
+    assert "service_error" in kinds and "service_job" in kinds
+    svc.close(timeout=10)
+
+
+def test_submit_after_close_runs_inline():
+    svc = EpochServices(echo=lambda *_: None)
+    assert svc.close(timeout=10)
+    out = []
+    svc.submit("late", out.append, "x")
+    assert out == ["x"]  # ran synchronously; late exit work is not dropped
+    assert svc.close(timeout=10)  # idempotent
+
+
+def test_pending_counter_tracks_queue():
+    svc = EpochServices(echo=lambda *_: None)
+    gate = threading.Event()
+    svc.submit("hold", gate.wait, 5)
+    svc.submit("next", lambda: None)
+    assert svc.pending >= 1
+    gate.set()
+    assert svc.barrier(timeout=10)
+    assert svc.pending == 0
+    svc.close(timeout=10)

@@ -53,6 +53,10 @@ HOT_PATH_FILES: List[Tuple[str, bool]] = [
     ("cyclegan_tpu/obs/stepclock.py", False),
     ("cyclegan_tpu/obs/telemetry.py", False),
     ("cyclegan_tpu/obs/watchdog.py", False),
+    # The epoch-services worker exists to take host I/O OFF the dispatch
+    # path; a device fetch on it would re-serialize the boundary it
+    # overlaps (callers hand it already-fetched host copies).
+    ("cyclegan_tpu/utils/services.py", False),
 ]
 
 # Directories whose EVERY .py file is hot-path. Scanned as a directory

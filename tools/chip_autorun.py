@@ -271,7 +271,17 @@ def commit_paths(repo: str, paths: list, message: str) -> bool:
             with open(manifest, "w") as f:
                 f.write(_manifest_for(abs_p))
             to_add.append(os.path.relpath(manifest, repo))
-            _say(f"{rel}: {_dir_bytes(abs_p)} bytes — committing MANIFEST only")
+            # Fence the raw dir off from any future `git add -A` too: the
+            # r5 window's 146MB xplane blob got committed exactly that way
+            # after only the MANIFEST was staged here. The data stays on
+            # disk for tools/trace_report.py; it just can't enter history.
+            try:
+                with open(os.path.join(abs_p, ".gitignore"), "w") as f:
+                    f.write("*\n")
+            except OSError:
+                pass  # unwritable dir: the MANIFEST guard still holds
+            _say(f"{rel}: {_dir_bytes(abs_p)} bytes — committing MANIFEST "
+                 "only (dir self-gitignored)")
         elif os.path.exists(abs_p):
             to_add.append(rel)
     if not to_add:
@@ -552,6 +562,15 @@ def run_queue(repo: str, queue: list, resume_from: set = frozenset(),
             except (OSError, ValueError) as e:
                 _say(f"step {step.name}: stdout record not committed "
                      f"(unparseable: {e})")
+                # Rename the torn file aside so record-globbing consumers
+                # never pick it up, while keeping the bytes for forensics.
+                bad = os.path.join(repo, step.stdout_to)
+                try:
+                    os.replace(bad, bad + ".partial")
+                    _say(f"step {step.name}: moved aside as "
+                         f"{step.stdout_to}.partial")
+                except OSError:
+                    pass
         arts.append(os.path.relpath(
             os.path.join(log_dir, f"{step.name}.log"), repo))
         arts.append(STATUS_REL)

@@ -33,6 +33,15 @@ spec refused off-CPU — is recorded as an error row and the sweep
 continues; only a malformed spec or a corrupt record file aborts (both
 before any compile).
 
+Infrastructure failures are NOT measurements: a remote-compile HTTP
+500, a tpu_compile_helper crash, or a dropped tunnel connection says
+nothing about the config under test, so those are printed but NOT
+appended to the record file (a transient infra row would sit in the
+ground-truth record masquerading as a property of the config — the r5
+512² scan rows died exactly this way). The sweep still tries its
+remaining specs, then exits 3 so an unattended driver (chip_autorun)
+knows the window needs a retry rather than counting the step done.
+
 `pallas` and `epi` specs carry Mosaic programs and are REFUSED off the
 CPU backend unless compiles are LOCAL (CYCLEGAN_AXON_LOCAL_COMPILE=1 —
 Mosaic compiles against the in-image libtpu and never touches the
@@ -141,7 +150,42 @@ def _pallas_blocked() -> str | None:
             "CYCLEGAN_ALLOW_PALLAS_REMOTE=1 to override.")
 
 
-def run_spec(spec: str) -> None:
+# Substrings that mark a failure of the measurement INFRASTRUCTURE (the
+# remote-compile relay, its helper subprocess, or the tunnel transport)
+# rather than of the config under test. Matched case-insensitively
+# against the stringified exception. "http 50" covers 500/502/503/504
+# from the compile relay.
+INFRA_ERROR_MARKERS = (
+    "remote_compile",
+    "tpu_compile_helper",
+    "http 50",
+    "connection refused",
+    "connection reset",
+    "connection aborted",
+    "failed to connect",
+    "broken pipe",
+    "socket closed",
+)
+
+# An OOM is a RESULT: it is exactly what a batch/image sweep exists to
+# find the boundary of. Checked before the infra markers so an OOM whose
+# traceback happens to mention the relay still records as a row.
+_OOM_MARKERS = ("resource_exhausted", "out of memory", " oom")
+
+
+def classify_error(msg: str) -> str:
+    """'oom' | 'infra' | 'other' for a stringified measurement error."""
+    low = msg.lower()
+    if any(m in low for m in _OOM_MARKERS):
+        return "oom"
+    if any(m in low for m in INFRA_ERROR_MARKERS):
+        return "infra"
+    return "other"
+
+
+def run_spec(spec: str) -> bool:
+    """Measure one spec; returns True when the attempt died on
+    infrastructure (nothing recorded, caller should exit nonzero)."""
     # abort BEFORE compile
     mode, batch, k, pallas, pad_mode, pad_impl, prefetch, image = (
         parse_spec(spec))
@@ -165,7 +209,7 @@ def run_spec(spec: str) -> None:
         print(f"[sweep] {spec}: {rec['error']}", flush=True)
         rec["wall_s"] = 0.0
         _append_record(rec)
-        return
+        return False
     import bench
 
     norm = "pallas" if pallas else "auto"
@@ -187,11 +231,17 @@ def run_spec(spec: str) -> None:
         rec["img_per_sec"] = round(ips, 2)
         print(f"[sweep] {spec}: {ips:.2f} img/s "
               f"({time.perf_counter() - t0:.0f}s incl. compile)", flush=True)
-    except Exception as e:  # OOM is a RESULT here, not a failure
-        rec["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    except Exception as e:  # OOM is a RESULT here; infra death is not
+        msg = f"{type(e).__name__}: {str(e)[:300]}"
+        if classify_error(msg) == "infra":
+            print(f"[sweep] {spec}: INFRA FAILURE (not recorded): {msg}",
+                  flush=True)
+            return True
+        rec["error"] = msg
         print(f"[sweep] {spec}: {rec['error']}", flush=True)
     rec["wall_s"] = round(time.perf_counter() - t0, 1)
     _append_record(rec)
+    return False
 
 
 def main() -> None:
@@ -201,8 +251,13 @@ def main() -> None:
     _load_records()  # fail fast on a corrupt record file, BEFORE any compile
     for spec in specs:
         parse_spec(spec)  # validate the WHOLE list before the first compile
-    for spec in specs:
-        run_spec(spec)
+    infra_failures = [spec for spec in specs if run_spec(spec)]
+    if infra_failures:
+        print(f"[sweep] {len(infra_failures)} spec(s) died on "
+              f"infrastructure: {' '.join(infra_failures)} — no rows "
+              "recorded for them; rerun when the relay is healthy",
+              flush=True)
+        raise SystemExit(3)
 
 
 if __name__ == "__main__":

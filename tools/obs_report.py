@@ -61,8 +61,13 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
         "epoch_steps": [],   # per-(epoch, split) loop aggregates
         "steps": {},         # split -> list of per-dispatch wall_s
         "stage": {},         # split -> list of per-dispatch stage_s
+        "submit_ready": {},  # split -> per-dispatch submit->ready latency
+        "host_work": {},     # split -> per-dispatch host-side loop work
         "memory": [],        # memory events
         "stalls": [],
+        "loop_stalls": [],   # per-dispatch outliers (StepClock attribution)
+        "services": [],      # epoch-services jobs (async ckpt/plots/FID)
+        "service_errors": [],
         "bench": [],
         "bench_summary": None,
         "serve_compiles": [],   # serve engine AOT program compiles
@@ -84,10 +89,22 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
                 report["steps"].setdefault(split, []).append(float(ev["wall_s"]))
             if "stage_s" in ev:
                 report["stage"].setdefault(split, []).append(float(ev["stage_s"]))
+            if "submit_ready_s" in ev:
+                report["submit_ready"].setdefault(split, []).append(
+                    float(ev["submit_ready_s"]))
+            if "host_work_s" in ev:
+                report["host_work"].setdefault(split, []).append(
+                    float(ev["host_work_s"]))
         elif kind == "memory":
             report["memory"].append(ev)
         elif kind == "stall":
             report["stalls"].append(ev)
+        elif kind == "loop_stall":
+            report["loop_stalls"].append(ev)
+        elif kind == "service_job":
+            report["services"].append(ev)
+        elif kind == "service_error":
+            report["service_errors"].append(ev)
         elif kind == "bench":
             report["bench"].append(ev)
         elif kind == "bench_summary":
@@ -209,10 +226,14 @@ def render(report: dict) -> str:
 
     if report["epochs"]:
         w("-- epochs --")
-        w(f"{'epoch':>5}  {'elapse_s':>9}  {'img/s':>8}  {'TFLOP/s':>8}  {'MFU':>7}")
+        w(f"{'epoch':>5}  {'elapse_s':>9}  {'train img/s':>11}  {'TFLOP/s':>8}  "
+          f"{'MFU':>7}")
         for ev in report["epochs"]:
+            # train_images_per_sec excludes the test pass + epoch-boundary
+            # services; older streams only carry the whole-epoch rate.
+            ips = ev.get("train_images_per_sec", ev.get("images_per_sec"))
             w(f"{ev.get('epoch', '?'):>5}  {_fmt(ev.get('elapse_s'), '.2f'):>9}  "
-              f"{_fmt(ev.get('images_per_sec'), '.2f'):>8}  "
+              f"{_fmt(ips, '.2f'):>11}  "
               f"{_fmt(ev.get('tflops_per_sec'), '.3f'):>8}  "
               f"{_fmt(ev.get('mfu'), '.4f'):>7}")
 
@@ -229,6 +250,15 @@ def render(report: dict) -> str:
           "  (loop wall spent waiting on input)")
         w(f"dispatch interval: p50 {_fmt(agg.get('wall_p50_s'))}s, "
           f"p90 {_fmt(agg.get('wall_p90_s'))}s, max {_fmt(agg.get('wall_max_s'))}s")
+        if agg.get("host_work_s") is not None:
+            w(f"host work (loop-side, unattributed to stage/dispatch/fetch): "
+              f"{_fmt(agg.get('host_work_s'), '.3f')}s")
+        if agg.get("submit_ready_p50_s") is not None:
+            w(f"submit->ready: p50 {_fmt(agg.get('submit_ready_p50_s'))}s, "
+              f"p90 {_fmt(agg.get('submit_ready_p90_s'))}s, "
+              f"max {_fmt(agg.get('submit_ready_max_s'))}s"
+              + (f"  loop stalls: {agg['n_loop_stalls']}"
+                 if agg.get("n_loop_stalls") else ""))
 
     # Raw per-dispatch percentiles across the whole run (when step
     # events were kept — obs_step_log_every > 0).
@@ -238,6 +268,15 @@ def render(report: dict) -> str:
           f"p90 {_fmt(_percentile(walls, .9))}s, "
           f"p99 {_fmt(_percentile(walls, .99))}s, "
           f"max {_fmt(max(walls))}s")
+        sr = report["submit_ready"].get(split)
+        if sr:
+            w(f"submit->ready: p50 {_fmt(_percentile(sr, .5))}s, "
+              f"p90 {_fmt(_percentile(sr, .9))}s, max {_fmt(max(sr))}s  "
+              f"({len(sr)} attributed)")
+        hw = report["host_work"].get(split)
+        if hw:
+            w(f"host work: p50 {_fmt(_percentile(hw, .5))}s, "
+              f"max {_fmt(max(hw))}s")
 
     if "train_starvation_fraction" in report:
         w(f"run starvation fraction (train): "
@@ -266,6 +305,41 @@ def render(report: dict) -> str:
               f"pending depth {ev.get('pending_depth')})")
     else:
         w("stalls: none")
+
+    # Per-dispatch outliers: each event carries the full attribution
+    # split, so the report can say WHAT a slow iteration spent its time
+    # on, not only that it was slow.
+    if report["loop_stalls"]:
+        w(f"-- loop stalls (dispatch wall > multiple of rolling median): "
+          f"{len(report['loop_stalls'])} --")
+        for ev in report["loop_stalls"][:20]:
+            parts = []
+            for key, label in (("data_wait_s", "data"),
+                               ("dispatch_s", "dispatch"),
+                               ("fetch_block_s", "fetch"),
+                               ("host_work_s", "host")):
+                if ev.get(key) is not None:
+                    parts.append(f"{label} {_fmt(ev[key], '.3f')}s")
+            w(f"{ev.get('split', '?')} e{ev.get('epoch', '?')} "
+              f"d{ev.get('dispatch', '?')}: wall {_fmt(ev.get('wall_s'), '.3f')}s "
+              f"vs median {_fmt(ev.get('median_s'), '.3f')}s"
+              + ("  [" + ", ".join(parts) + "]" if parts else ""))
+        if len(report["loop_stalls"]) > 20:
+            w(f"... {len(report['loop_stalls']) - 20} more")
+
+    if report["services"]:
+        agg: Dict[str, List[float]] = {}
+        for ev in report["services"]:
+            # job names are "<kind>:e<epoch>" — fold across epochs by kind
+            kind = str(ev.get("job", "?")).split(":", 1)[0]
+            agg.setdefault(kind, []).append(float(ev.get("seconds", 0.0)))
+        w(f"-- epoch services (off the dispatch path): "
+          f"{len(report['services'])} jobs --")
+        for kind, secs in sorted(agg.items()):
+            w(f"{kind}: {len(secs)} jobs, total {sum(secs):.2f}s, "
+              f"max {max(secs):.2f}s")
+    for ev in report["service_errors"]:
+        w(f"SERVICE ERROR in {ev.get('job', '?')}: {ev.get('error', '?')}")
 
     if report["bench"]:
         w("-- bench configs --")

@@ -1,0 +1,592 @@
+#!/usr/bin/env python
+"""Mine committed profiler artifacts into op-level attribution tables.
+
+The chip runs commit their raw profiler output (docs/chip_logs/r*/
+trace_run/traces/.../vm.xplane.pb and vm.trace.json.gz) but nothing in
+the repo ever reads them — the 13x bench-vs-training gap analysis needs
+to know WHERE device time goes, not just that an epoch is slow. This
+tool parses the XPlane protobuf with a self-contained wire-format
+reader (the image's TF build lacks a working `xspace_to_tools_data`,
+and installing one is off the table), so it needs no dependencies
+beyond the stdlib.
+
+What it reports, from the `/device:TPU:*` plane:
+
+- per-op table: HLO program symbols aggregated over all occurrences,
+  with device time, occurrence count, bytes accessed (HBM traffic as
+  XLA's cost model recorded it), and achieved bytes/s;
+- bucket rollup: conv-transpose vs plain conv vs layout-copy vs
+  instance-norm stats vs fusion/other — the axes the optimisation
+  roadmap (ROADMAP.md) argues about;
+- device idle fraction: 1 - (merged busy intervals / plane span), the
+  direct measurement of "the loop starves the chip";
+- step timings from the profiler's Steps line.
+
+For the Perfetto-style vm.trace.json.gz (host-side only — it carries
+no device op detail) a smaller host-function table is printed instead.
+
+Usage:
+    python tools/trace_report.py [PATH] [--top N] [--markdown] [--json]
+
+PATH may be an .xplane.pb file, a .trace.json.gz file, or a directory
+to search (default: newest profile dir under docs/chip_logs/*/).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import struct
+import sys
+from collections import defaultdict
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# --------------------------------------------------------------------------
+# Protobuf wire-format primitives. The XPlane schema (tensorflow/profiler/
+# protobuf/xplane.proto) is stable; we read only the fields we need and skip
+# everything else by wire type, so unknown fields cost nothing.
+# --------------------------------------------------------------------------
+
+
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift = 0
+    value = 0
+    while True:
+        b = buf[i]
+        i += 1
+        value |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return value, i
+        shift += 7
+
+
+def _fields(buf: bytes, off: int, end: int) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) for one message's span.
+
+    Length-delimited values come back as an (offset, length) span into
+    `buf` — callers slice lazily, so scanning a 146 MB file never copies
+    payloads it does not read.
+    """
+    i = off
+    while i < end:
+        tag, i = _read_varint(buf, i)
+        field, wt = tag >> 3, tag & 7
+        if wt == 2:  # length-delimited
+            length, i = _read_varint(buf, i)
+            yield field, wt, (i, length)
+            i += length
+        elif wt == 0:  # varint
+            value, i = _read_varint(buf, i)
+            yield field, wt, value
+        elif wt == 1:  # 64-bit
+            yield field, wt, buf[i : i + 8]
+            i += 8
+        elif wt == 5:  # 32-bit
+            yield field, wt, buf[i : i + 4]
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt} at offset {i}")
+
+
+def _text(buf: bytes, span: Tuple[int, int]) -> str:
+    off, length = span
+    return buf[off : off + length].decode("utf-8", errors="replace")
+
+
+# XPlane field numbers (xplane.proto).
+_XSPACE_PLANES = 1
+_XPLANE_NAME = 2
+_XPLANE_LINES = 3
+_XPLANE_EVENT_METADATA = 4  # map<int64, XEventMetadata>
+_XPLANE_STAT_METADATA = 5  # map<int64, XStatMetadata>
+_XLINE_NAME = 2
+_XLINE_TIMESTAMP_NS = 3
+_XLINE_EVENTS = 4
+_XLINE_DISPLAY_NAME = 11
+_XEVENT_METADATA_ID = 1
+_XEVENT_OFFSET_PS = 2
+_XEVENT_DURATION_PS = 3
+_XEVENTMETA_NAME = 2
+_XEVENTMETA_STATS = 5
+_XSTAT_METADATA_ID = 1
+_XSTAT_DOUBLE = 2
+_XSTAT_UINT64 = 3
+_XSTAT_INT64 = 4
+_XSTAT_STR = 5
+_XSTAT_BYTES = 6
+_XSTAT_REF = 7
+
+
+def iter_plane_spans(buf: bytes) -> Iterator[Tuple[str, Tuple[int, int]]]:
+    """(plane_name, span) for every XPlane in an XSpace, peeking only the
+    name field so non-matching planes are skipped without a full parse."""
+    for field, wt, value in _fields(buf, 0, len(buf)):
+        if field != _XSPACE_PLANES or wt != 2:
+            continue
+        off, length = value
+        name = ""
+        for f2, wt2, v2 in _fields(buf, off, off + length):
+            if f2 == _XPLANE_NAME and wt2 == 2:
+                name = _text(buf, v2)
+                break
+        yield name, (off, off + length)
+
+
+def _parse_stat(buf: bytes, span: Tuple[int, int], stat_names: Dict[int, str]):
+    """One XStat -> (stat_name, python value)."""
+    off, length = span
+    meta_id = None
+    value = None
+    for field, wt, v in _fields(buf, off, off + length):
+        if field == _XSTAT_METADATA_ID and wt == 0:
+            meta_id = v
+        elif field == _XSTAT_DOUBLE and wt == 1:
+            value = struct.unpack("<d", v)[0]
+        elif field in (_XSTAT_UINT64, _XSTAT_INT64) and wt == 0:
+            value = v
+        elif field in (_XSTAT_STR, _XSTAT_BYTES) and wt == 2:
+            value = _text(buf, v)
+        elif field == _XSTAT_REF and wt == 0:
+            value = stat_names.get(v, v)
+    return stat_names.get(meta_id, meta_id), value
+
+
+def parse_plane(buf: bytes, span: Tuple[int, int]) -> dict:
+    """Fully parse one XPlane into plain python structures."""
+    off, end = span
+    stat_names: Dict[int, str] = {}
+    meta_spans: List[Tuple[int, int]] = []
+    line_spans: List[Tuple[int, int]] = []
+    name = ""
+    # Pass 1: stat_metadata first — event metadata stats reference it by id,
+    # and map entries may appear in any order in the stream.
+    for field, wt, value in _fields(buf, off, end):
+        if field == _XPLANE_NAME and wt == 2:
+            name = _text(buf, value)
+        elif field == _XPLANE_STAT_METADATA and wt == 2:
+            o, length = value
+            key = None
+            stat_name = None
+            for f2, wt2, v2 in _fields(buf, o, o + length):
+                if f2 == 1 and wt2 == 0:
+                    key = v2
+                elif f2 == 2 and wt2 == 2:
+                    o3, l3 = v2
+                    for f3, wt3, v3 in _fields(buf, o3, o3 + l3):
+                        if f3 == 2 and wt3 == 2:  # XStatMetadata.name
+                            stat_name = _text(buf, v3)
+            if key is not None and stat_name is not None:
+                stat_names[key] = stat_name
+        elif field == _XPLANE_EVENT_METADATA and wt == 2:
+            meta_spans.append(value)
+        elif field == _XPLANE_LINES and wt == 2:
+            line_spans.append(value)
+
+    event_meta: Dict[int, dict] = {}
+    for o, length in meta_spans:
+        key = None
+        body = None
+        for f2, wt2, v2 in _fields(buf, o, o + length):
+            if f2 == 1 and wt2 == 0:
+                key = v2
+            elif f2 == 2 and wt2 == 2:
+                body = v2
+        if body is None:
+            continue
+        bo, bl = body
+        rec = {"name": "", "stats": {}}
+        for f3, wt3, v3 in _fields(buf, bo, bo + bl):
+            if f3 == _XEVENTMETA_NAME and wt3 == 2:
+                rec["name"] = _text(buf, v3)
+            elif f3 == _XEVENTMETA_STATS and wt3 == 2:
+                sname, sval = _parse_stat(buf, v3, stat_names)
+                if sname is not None:
+                    rec["stats"][sname] = sval
+        event_meta[key if key is not None else 0] = rec
+
+    lines = []
+    for o, length in line_spans:
+        line = {"name": "", "timestamp_ns": 0, "events": []}
+        for f2, wt2, v2 in _fields(buf, o, o + length):
+            if f2 in (_XLINE_NAME, _XLINE_DISPLAY_NAME) and wt2 == 2:
+                line["name"] = _text(buf, v2) or line["name"]
+            elif f2 == _XLINE_TIMESTAMP_NS and wt2 == 0:
+                line["timestamp_ns"] = v2
+            elif f2 == _XLINE_EVENTS and wt2 == 2:
+                eo, el = v2
+                mid = 0
+                offset_ps = 0
+                duration_ps = 0
+                for f3, wt3, v3 in _fields(buf, eo, eo + el):
+                    if wt3 != 0:
+                        continue
+                    if f3 == _XEVENT_METADATA_ID:
+                        mid = v3
+                    elif f3 == _XEVENT_OFFSET_PS:
+                        offset_ps = v3
+                    elif f3 == _XEVENT_DURATION_PS:
+                        duration_ps = v3
+                line["events"].append((mid, offset_ps, duration_ps))
+        lines.append(line)
+
+    return {"name": name, "stat_names": stat_names, "event_meta": event_meta, "lines": lines}
+
+
+# --------------------------------------------------------------------------
+# Mining: op aggregation, bucket rollup, idle fraction.
+# --------------------------------------------------------------------------
+
+# Bucket identifiers, in report order. These are the axes the repo's perf
+# work argues about: the generator's upsampling ConvTranspose path vs its
+# plain convs, layout copies (the historical NCHW/NHWC tax), the
+# instance-norm statistics reductions (Pallas epilogue target), and
+# everything else.
+BUCKETS = (
+    "conv-transpose",
+    "conv",
+    "layout-copy",
+    "in-stats",
+    "fusion-other",
+    "data-movement",
+    "other",
+)
+
+
+def _short_name(meta: dict) -> str:
+    """Stable short symbol for an HLO op: deduplicated name when XLA
+    recorded one, else the lhs of the HLO text with the .NNN instance
+    suffix kept (it distinguishes distinct program points)."""
+    dedup = meta["stats"].get("deduplicated_name")
+    if dedup:
+        return str(dedup)
+    name = meta["name"]
+    head = name.split(" = ", 1)[0].strip()
+    return head.lstrip("%") or name[:40]
+
+
+def classify(meta: dict) -> str:
+    cat = str(meta["stats"].get("hlo_category", "")).lower()
+    prov = str(meta["stats"].get("tf_op", "")).lower()
+    name = _short_name(meta).lower()
+    squashed_prov = prov.replace("_", "").replace("-", "")
+    if "conv" in cat or name.startswith("convolution") or "%convolution" in meta["name"].lower():
+        if "convtranspose" in squashed_prov:
+            return "conv-transpose"
+        return "conv"
+    if "copy" in cat or cat in ("transpose", "bitcast", "reshape") or name.startswith(
+        ("copy", "transpose", "bitcast")
+    ):
+        return "layout-copy"
+    if "instancenorm" in squashed_prov or (
+        ("reduce" in cat or name.startswith(("reduce", "variance", "mean"))) and "norm" in prov
+    ):
+        return "in-stats"
+    if "fusion" in cat:
+        # Fusions rooted in a ConvTranspose scope are part of the
+        # transposed-conv cost even though XLA labels them fusion.
+        if "convtranspose" in squashed_prov:
+            return "conv-transpose"
+        return "fusion-other"
+    if "async" in cat or cat.startswith("all-") or "infeed" in cat or "outfeed" in cat:
+        return "data-movement"
+    return "other"
+
+
+def _find_line(plane: dict, wanted: str) -> Optional[dict]:
+    for line in plane["lines"]:
+        if line["name"] == wanted:
+            return line
+    return None
+
+
+def _merged_busy_ps(events: List[Tuple[int, int, int]]) -> Tuple[int, int]:
+    """(busy_ps, span_ps) from possibly-overlapping event intervals."""
+    if not events:
+        return 0, 0
+    ivs = sorted((off, off + dur) for _, off, dur in events)
+    busy = 0
+    cur_start, cur_end = ivs[0]
+    lo = ivs[0][0]
+    hi = ivs[0][1]
+    for start, end in ivs[1:]:
+        hi = max(hi, end)
+        if start <= cur_end:
+            cur_end = max(cur_end, end)
+        else:
+            busy += cur_end - cur_start
+            cur_start, cur_end = start, end
+    busy += cur_end - cur_start
+    return busy, hi - lo
+
+
+def mine_xplane(path: str, plane_prefix: str = "/device:") -> dict:
+    """Parse PATH and aggregate the first matching device plane."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    plane = None
+    available = []
+    for name, span in iter_plane_spans(buf):
+        available.append(name)
+        if plane is None and name.startswith(plane_prefix):
+            plane = parse_plane(buf, span)
+    if plane is None:
+        raise SystemExit(
+            f"no plane matching {plane_prefix!r} in {path}; planes present: {available}"
+        )
+
+    ops_line = _find_line(plane, "XLA Ops")
+    if ops_line is None or not ops_line["events"]:
+        raise SystemExit(f"device plane {plane['name']} has no 'XLA Ops' line to mine")
+
+    # Key by (symbol, category): XLA records distinct metadata ids for
+    # deduplicated instances of the same program point, and listing six
+    # identical `fusion.219` rows helps no one.
+    per_op: Dict[Tuple[str, str], dict] = {}
+    meta_cache: Dict[int, Tuple[Tuple[str, str], dict]] = {}
+    for mid, _off, dur in ops_line["events"]:
+        cached = meta_cache.get(mid)
+        if cached is None:
+            meta = plane["event_meta"].get(mid, {"name": f"<metadata {mid}>", "stats": {}})
+            key = (_short_name(meta), str(meta["stats"].get("hlo_category", "")))
+            cached = meta_cache[mid] = (key, meta)
+        key, meta = cached
+        rec = per_op.get(key)
+        if rec is None:
+            rec = per_op[key] = {
+                "name": key[0],
+                "category": key[1],
+                "bucket": classify(meta),
+                "provenance": str(meta["stats"].get("tf_op", ""))[:160],
+                "count": 0,
+                "total_ps": 0,
+                "bytes_total": 0,
+                "flops_total": 0,
+            }
+        rec["count"] += 1
+        rec["total_ps"] += dur
+        rec["bytes_total"] += int(meta["stats"].get("bytes_accessed", 0) or 0)
+        rec["flops_total"] += int(meta["stats"].get("flops", 0) or 0)
+
+    busy_ps, span_ps = _merged_busy_ps(ops_line["events"])
+    total_op_ps = sum(r["total_ps"] for r in per_op.values())
+
+    ops = []
+    for rec in per_op.values():
+        total_s = rec["total_ps"] / 1e12
+        total_bytes = rec["bytes_total"]
+        ops.append(
+            {
+                "name": rec["name"],
+                "category": rec["category"],
+                "bucket": rec["bucket"],
+                "provenance": rec["provenance"],
+                "count": rec["count"],
+                "total_ms": rec["total_ps"] / 1e9,
+                "avg_us": rec["total_ps"] / rec["count"] / 1e6,
+                "pct_of_op_time": 100.0 * rec["total_ps"] / total_op_ps if total_op_ps else 0.0,
+                "bytes_total": total_bytes,
+                "gbytes_per_s": (total_bytes / total_s / 1e9) if total_s > 0 else 0.0,
+                "flops_total": rec["flops_total"],
+            }
+        )
+    ops.sort(key=lambda r: r["total_ms"], reverse=True)
+
+    buckets = {b: {"total_ms": 0.0, "count": 0, "bytes_total": 0} for b in BUCKETS}
+    for op in ops:
+        b = buckets[op["bucket"]]
+        b["total_ms"] += op["total_ms"]
+        b["count"] += op["count"]
+        b["bytes_total"] += op["bytes_total"]
+    for b in buckets.values():
+        b["pct_of_op_time"] = 100.0 * b["total_ms"] * 1e9 / total_op_ps if total_op_ps else 0.0
+
+    steps_line = _find_line(plane, "Steps")
+    step_ms = [dur / 1e9 for _, _, dur in steps_line["events"]] if steps_line else []
+
+    modules_line = _find_line(plane, "XLA Modules")
+    modules = []
+    if modules_line:
+        agg = defaultdict(lambda: [0, 0])
+        for mid, _off, dur in modules_line["events"]:
+            meta = plane["event_meta"].get(mid, {"name": f"<metadata {mid}>", "stats": {}})
+            entry = agg[meta["name"].split("(")[0]]
+            entry[0] += 1
+            entry[1] += dur
+        modules = [
+            {"name": n, "count": c, "total_ms": ps / 1e9} for n, (c, ps) in sorted(agg.items())
+        ]
+
+    return {
+        "path": path,
+        "plane": plane["name"],
+        "n_ops_distinct": len(ops),
+        "n_op_events": len(ops_line["events"]),
+        "span_ms": span_ps / 1e9,
+        "busy_ms": busy_ps / 1e9,
+        "idle_fraction": (1.0 - busy_ps / span_ps) if span_ps else 0.0,
+        "steps_ms": step_ms,
+        "modules": modules,
+        "buckets": buckets,
+        "ops": ops,
+    }
+
+
+# --------------------------------------------------------------------------
+# Host-trace fallback (vm.trace.json.gz has host threads only — no device
+# op detail — but its top functions still show where the HOST went).
+# --------------------------------------------------------------------------
+
+
+def mine_host_json(path: str, top: int = 15) -> dict:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8", errors="replace") as f:
+        doc = json.load(f)
+    agg = defaultdict(lambda: [0, 0.0])
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") == "X" and "dur" in ev:
+            entry = agg[ev.get("name", "?")]
+            entry[0] += 1
+            entry[1] += float(ev["dur"])  # microseconds
+    rows = [
+        {"name": n, "count": c, "total_ms": us / 1e3}
+        for n, (c, us) in sorted(agg.items(), key=lambda kv: kv[1][1], reverse=True)
+    ]
+    return {"path": path, "kind": "host-trace", "functions": rows[:top]}
+
+
+# --------------------------------------------------------------------------
+# Rendering.
+# --------------------------------------------------------------------------
+
+
+def _fmt_bytes(n: int) -> str:
+    if n >= 1e9:
+        return f"{n / 1e9:.2f} GB"
+    if n >= 1e6:
+        return f"{n / 1e6:.2f} MB"
+    return f"{n / 1e3:.1f} KB"
+
+
+def render(report: dict, top: int, markdown: bool) -> str:
+    out: List[str] = []
+    if report.get("kind") == "host-trace":
+        out.append(f"host trace: {report['path']} (no device ops in this artifact)")
+        for row in report["functions"]:
+            out.append(f"  {row['total_ms']:10.2f} ms  x{row['count']:<6d} {row['name']}")
+        return "\n".join(out)
+
+    steps = report["steps_ms"]
+    step_note = (
+        f"{len(steps)} steps, mean {sum(steps) / len(steps):.2f} ms" if steps else "no Steps line"
+    )
+    head = [
+        f"device plane {report['plane']} from {report['path']}",
+        f"  {report['n_op_events']} op events over {report['n_ops_distinct']} distinct ops; "
+        f"span {report['span_ms']:.2f} ms, busy {report['busy_ms']:.2f} ms, "
+        f"idle {100 * report['idle_fraction']:.2f}%",
+        f"  {step_note}"
+        + (
+            "; modules: "
+            + ", ".join(f"{m['name']} x{m['count']} {m['total_ms']:.1f} ms" for m in report["modules"])
+            if report["modules"]
+            else ""
+        ),
+    ]
+
+    bucket_rows = sorted(
+        report["buckets"].items(), key=lambda kv: kv[1]["total_ms"], reverse=True
+    )
+    op_rows = report["ops"][:top]
+
+    if markdown:
+        out.extend(head)
+        out.append("")
+        out.append("| bucket | device time (ms) | % of op time | events | bytes accessed |")
+        out.append("|---|---:|---:|---:|---:|")
+        for name, b in bucket_rows:
+            out.append(
+                f"| {name} | {b['total_ms']:.2f} | {b['pct_of_op_time']:.1f}% "
+                f"| {b['count']} | {_fmt_bytes(b['bytes_total'])} |"
+            )
+        out.append("")
+        out.append("| op | category | bucket | n | total ms | avg us | % | bytes | GB/s |")
+        out.append("|---|---|---|---:|---:|---:|---:|---:|---:|")
+        for op in op_rows:
+            out.append(
+                f"| `{op['name'][:48]}` | {op['category']} | {op['bucket']} | {op['count']} "
+                f"| {op['total_ms']:.2f} | {op['avg_us']:.1f} | {op['pct_of_op_time']:.1f}% "
+                f"| {_fmt_bytes(op['bytes_total'])} | {op['gbytes_per_s']:.0f} |"
+            )
+    else:
+        out.extend(head)
+        out.append("")
+        out.append(f"{'bucket':<16} {'ms':>10} {'%':>7} {'events':>8}  bytes")
+        for name, b in bucket_rows:
+            out.append(
+                f"{name:<16} {b['total_ms']:>10.2f} {b['pct_of_op_time']:>6.1f}% "
+                f"{b['count']:>8d}  {_fmt_bytes(b['bytes_total'])}"
+            )
+        out.append("")
+        out.append(f"top {len(op_rows)} ops by device time:")
+        out.append(f"{'ms':>10} {'avg us':>9} {'n':>6} {'%':>6}  {'bucket':<14} op")
+        for op in op_rows:
+            out.append(
+                f"{op['total_ms']:>10.2f} {op['avg_us']:>9.1f} {op['count']:>6d} "
+                f"{op['pct_of_op_time']:>5.1f}%  {op['bucket']:<14} {op['name'][:60]}"
+            )
+    return "\n".join(out)
+
+
+def _default_search() -> Optional[str]:
+    hits = sorted(glob.glob("docs/chip_logs/*/trace_run/traces/plugins/profile/*/*.xplane.pb"))
+    return hits[-1] if hits else None
+
+
+def _resolve(path: Optional[str]) -> str:
+    if path is None:
+        found = _default_search()
+        if not found:
+            raise SystemExit(
+                "no xplane artifact found under docs/chip_logs/*/trace_run; pass a path"
+            )
+        return found
+    if os.path.isdir(path):
+        for pattern in ("**/*.xplane.pb", "**/*.trace.json.gz"):
+            hits = sorted(glob.glob(os.path.join(path, pattern), recursive=True))
+            if hits:
+                return hits[-1]
+        raise SystemExit(f"no profiler artifacts under {path}")
+    return path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", nargs="?", default=None, help="xplane.pb / trace.json.gz / directory")
+    ap.add_argument("--top", type=int, default=20, help="ops to list (default 20)")
+    ap.add_argument("--markdown", action="store_true", help="emit markdown tables")
+    ap.add_argument("--json", action="store_true", dest="as_json", help="emit full JSON report")
+    ap.add_argument(
+        "--plane", default="/device:", help="plane name prefix to mine (default /device:)"
+    )
+    args = ap.parse_args(argv)
+
+    path = _resolve(args.path)
+    if path.endswith((".json.gz", ".json")):
+        report = mine_host_json(path, top=args.top)
+    else:
+        report = mine_xplane(path, plane_prefix=args.plane)
+    if args.as_json:
+        slim = dict(report)
+        if "ops" in slim:
+            slim["ops"] = slim["ops"][: args.top]
+        print(json.dumps(slim, indent=2))
+    else:
+        print(render(report, top=args.top, markdown=args.markdown))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
