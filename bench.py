@@ -709,7 +709,14 @@ def _flops_accounting(best_ips: float, platform: str,
     return out
 
 
+# Last summary line _emit produced, kept for the end-of-run regression
+# gate (_compare_with_previous_round): the comparison must see exactly
+# what was emitted, not a re-derivation that could drift from it.
+_EMITTED_LINE = None
+
+
 def _emit(results, done: bool) -> None:
+    global _EMITTED_LINE
     results = dict(results)  # snapshot: emitters race the config loop
     worker = _read_worker_results(_WORKER_RESULTS_PATH)
     worker.pop(_WORKER_DONE_KEY, None)
@@ -743,6 +750,7 @@ def _emit(results, done: bool) -> None:
             line["note"] = note
         if _PROBE_LOG:
             line["probes"] = list(_PROBE_LOG)
+        _EMITTED_LINE = line
         _obs_event("bench_summary", **line)
         print(json.dumps(line), flush=True)
         return
@@ -772,8 +780,46 @@ def _emit(results, done: bool) -> None:
         line["probes"] = list(_PROBE_LOG)
     if not done:
         line["partial"] = True
+    _EMITTED_LINE = line
     _obs_event("bench_summary", **line)
     print(json.dumps(line), flush=True)
+
+
+def _compare_with_previous_round() -> None:
+    """Regression gate against the newest committed BENCH_r*.json
+    (tools/run_compare.py): every bench run is compared to the previous
+    round by default. Strictly best-effort and stderr-only — stdout
+    carries EXACTLY one JSON line (the emit contract) and the exit code
+    stays the bench's own; a regression here is a report for the
+    operator/driver, not a new failure mode. BENCH_COMPARE=0 disables.
+    """
+    if os.environ.get("BENCH_COMPARE", "1") == "0" or _EMITTED_LINE is None:
+        return
+    try:
+        import glob as _glob
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        rounds = sorted(_glob.glob(os.path.join(repo, "BENCH_r*.json")))
+        if not rounds:
+            return
+        sys.path.insert(0, os.path.join(repo, "tools"))
+        import run_compare
+
+        base = run_compare.load_profile(rounds[-1])
+        cand = run_compare.bench_profile(_EMITTED_LINE, name="this-run")
+        checks = run_compare.compare_profiles(
+            base, cand, run_compare.make_thresholds()
+        )
+        report = run_compare.render_pair(base, cand, checks)
+        print("[bench-compare] vs previous round "
+              f"{os.path.basename(rounds[-1])}:", file=sys.stderr, flush=True)
+        for row in report.splitlines():
+            print(f"[bench-compare] {row}", file=sys.stderr, flush=True)
+    except Exception as e:  # noqa: BLE001 — the gate must never kill a bench
+        try:
+            print(f"[bench-compare] skipped: {e}", file=sys.stderr, flush=True)
+        except Exception:
+            pass
 
 
 def _config_key(c: dict) -> str:
@@ -1135,6 +1181,7 @@ def main():
     signal.signal(signal.SIGTERM, signal.SIG_IGN)
     signal.signal(signal.SIGALRM, signal.SIG_IGN)
     emit_once(done=done)
+    _compare_with_previous_round()
     if _WORKER_RESULTS_PATH:
         try:
             os.unlink(_WORKER_RESULTS_PATH)

@@ -241,6 +241,37 @@ class ObsConfig:
     # samples so the compile dispatch can't seed false positives).
     # 0 disables detection.
     stall_multiple: float = 10.0
+    # Model-health flight recorder (cyclegan_tpu/obs/health.py): grad
+    # norms, update ratios, non-finite counts, and D-saturation stats
+    # computed INSIDE the fused train step (they ride the existing
+    # metrics dict through the deferred-fetch path — no extra dispatch,
+    # no host sync), plus host-side anomaly detectors on the fetched
+    # values. Independent of `enabled`: the detectors run even when the
+    # JSONL stream is off (events just go nowhere).
+    health: bool = True
+    # Non-finite gradient policy: "warn" records a health_fault event
+    # and keeps training; "halt" flushes telemetry, leaves the last-good
+    # checkpoint slot untouched, and exits nonzero.
+    on_nan: str = "warn"
+    # EMA divergence detector: warn when loss_G/total or loss_F/total
+    # exceeds this multiple of its own EMA (armed after a warmup window;
+    # 0 disables the detector).
+    divergence_multiple: float = 4.0
+    # D-collapse detector: a discriminator whose outputs sit within
+    # `collapse_eps` of the LSGAN targets (mean AND std, real and fake)
+    # for `collapse_patience` consecutive fetched rows is no longer
+    # providing adversarial signal. eps <= 0 disables.
+    collapse_eps: float = 0.05
+    collapse_patience: int = 50
+
+    def __post_init__(self):
+        # A typo like "Halt" would silently select the warn path on the
+        # one run where halting mattered (argparse choices only guard
+        # the CLI; programmatic construction lands here).
+        if self.on_nan not in ("warn", "halt"):
+            raise ValueError(
+                f"obs.on_nan must be 'warn' or 'halt', got {self.on_nan!r}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

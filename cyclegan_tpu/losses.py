@@ -22,6 +22,8 @@ GAN objective is LSGAN (least-squares), lambda_cycle=10, lambda_identity=5
 
 from __future__ import annotations
 
+from typing import Tuple
+
 import jax.numpy as jnp
 
 
@@ -60,6 +62,31 @@ def scaled_mean(
 ) -> jnp.ndarray:
     """sum(weights * per_sample) / global_batch_size (main.py:172-174)."""
     return jnp.sum(weights * per_sample) / global_batch_size
+
+
+def disc_raw_moments(
+    disc_out: jnp.ndarray, weights: jnp.ndarray, global_batch_size: float
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Weighted first/second moments of raw PatchGAN outputs -> (m1, m2).
+
+    The model-health layer (obs/health.py) derives D-saturation stats
+    (mean, σ of D(real)/D(fake) per side) from these. Both moments are
+    in the same `sum(w * per_sample) / global_batch_size` form as every
+    loss scalar — LINEAR in the batch — so they sum exactly across
+    grad-accumulation microbatches and `psum` exactly across shards;
+    mean/σ are finalized only after aggregation
+    (health.finalize_health_metrics). Padded samples (w=0) contribute
+    zero, matching the loss semantics; on a padded final batch the
+    /global_batch_size scaling under-weights the moments the same way
+    it under-weights the losses.
+    """
+    m1 = scaled_mean(_per_sample_mean(disc_out), weights, global_batch_size)
+    m2 = scaled_mean(
+        _per_sample_mean(jnp.square(disc_out.astype(jnp.float32))),
+        weights,
+        global_batch_size,
+    )
+    return m1, m2
 
 
 def generator_loss(

@@ -21,6 +21,12 @@ on the existing backpressure path — never `block_until_ready`);
 `tools/check_no_sync.py` enforces this statically and runs in tier-1.
 """
 
+from cyclegan_tpu.obs.health import (
+    HealthFault,
+    HealthMonitor,
+    finalize_health_metrics,
+    make_health_monitor,
+)
 from cyclegan_tpu.obs.jsonl import EVENT_SCHEMA_VERSION, MetricsLogger, NullMetricsLogger
 from cyclegan_tpu.obs.manifest import build_manifest
 from cyclegan_tpu.obs.memory import memory_watermarks
@@ -35,6 +41,10 @@ from cyclegan_tpu.obs.watchdog import StallWatchdog
 
 __all__ = [
     "EVENT_SCHEMA_VERSION",
+    "HealthFault",
+    "HealthMonitor",
+    "finalize_health_metrics",
+    "make_health_monitor",
     "MetricsLogger",
     "NullMetricsLogger",
     "build_manifest",

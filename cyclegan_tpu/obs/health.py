@@ -1,0 +1,450 @@
+"""Model-health flight recorder: in-program numerics telemetry plus
+host-side GAN-balance anomaly detection.
+
+The rest of `obs` answers "how fast is the run"; this module answers
+"is the model still healthy". A NaN'd generator, a collapsed
+discriminator, or a silently diverging cycle loss all look identical to
+a perfect run in the throughput stream — GAN loss curves are
+adversarial, so failures are silent (ParaGAN makes the same case for
+TPU-scale GAN training: continuous training-dynamics telemetry or you
+learn about the collapse from the checkpoint three days later).
+
+Two halves, split by where they run:
+
+Device side (called from train/steps.py INSIDE the jitted step):
+`make_grad_fn` already pulls all four per-network gradients from one
+fused backward pass, so every statistic here rides that pass for free —
+per-network global gradient norms, update-to-param-norm ratios, one
+fused `isfinite` reduction over all four gradient trees, and
+discriminator-saturation stats from the raw PatchGAN outputs
+(losses.disc_raw_moments). They are ADDED TO THE METRICS DICT, so they
+flow through the existing deferred-fetch path (train/loop.py bounded
+backpressure window): zero extra dispatches, zero added host syncs —
+`tools/check_no_sync.py` scans this file with no sanctioned sites.
+
+Moment keys are kept LINEAR inside the gradient function (`_health/`
+prefix, same `sum(w·x)/global_batch` scaling as the losses) so they sum
+exactly across grad-accumulation microbatches and psum exactly across
+shards; `finalize_health_metrics` converts them to mean/σ and computes
+the norm-based stats AFTER aggregation — the same numbers whether the
+step ran as one big batch, K accumulated microbatches, or an explicit
+shard_map psum (tests/test_accum.py, tests/test_dp.py).
+
+Host side (train/loop.py feeds fetched rows; no device access at all):
+`HealthMonitor` runs three detectors over the already-fetched values —
+a non-finite tripwire with an `--on_nan {warn,halt}` policy (halt =
+flush telemetry, keep the last-good checkpoint slot, exit nonzero), an
+EMA divergence detector on the generator totals, and a D-collapse
+detector (D outputs saturating toward the LSGAN targets ⇒ dead
+adversarial signal). Detections become structured `health_fault`
+events; `epoch_rollup` emits one `health` event per epoch with
+grad-norm envelopes, D-balance means, and anomaly counts —
+`tools/obs_report.py` renders them and `tools/run_compare.py` diffs
+them across runs. Every host runs the same detectors on the same
+replicated scalars, so a halt is deterministic across processes even
+though only host 0 writes the stream.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple
+
+NETWORKS = ("G", "F", "dX", "dY")
+
+# (side, which) pairs for the discriminator raw-output moments; the
+# internal `_health/` keys exist only between make_grad_fn and
+# finalize_health_metrics (they never reach the summary or the stream).
+DISC_STATS = (("dX", "real"), ("dX", "fake"), ("dY", "real"), ("dY", "fake"))
+
+INTERNAL_PREFIX = "_health/"
+
+# Loss scalars the host-side detectors read (all emitted by
+# make_grad_fn under reference keys).
+GEN_TOTAL_KEYS = ("loss_G/total", "loss_F/total")
+LOSS_KEYS = GEN_TOTAL_KEYS + ("loss_X/loss", "loss_Y/loss")
+
+
+def moment_keys(side: str, which: str) -> Tuple[str, str]:
+    """Internal (m1, m2) metric keys for one D output tensor."""
+    return (
+        f"{INTERNAL_PREFIX}{side}_{which}_m1",
+        f"{INTERNAL_PREFIX}{side}_{which}_m2",
+    )
+
+
+# ---------------------------------------------------------------------------
+# Device side: called inside the jitted train step (train/steps.py,
+# parallel/collective.py). Imports of jax live inside the functions so
+# the host-side consumers (tools/run_compare.py reads this module's key
+# names via obs_report conventions) never pull jax in.
+# ---------------------------------------------------------------------------
+
+
+def nonfinite_count(grads) -> "jax.Array":  # noqa: F821 (doc type)
+    """ONE fused count of non-finite elements over all four gradient
+    trees: a single scalar reduction XLA fuses into the backward pass —
+    the tripwire input. float32 so it aggregates like every metric
+    (sums across microbatches/psum: counts are linear too)."""
+    import jax
+    import jax.numpy as jnp
+
+    total = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree.leaves(grads):
+        total = total + jnp.sum(~jnp.isfinite(leaf)).astype(jnp.float32)
+    return total
+
+
+def finalize_health_metrics(metrics, grads, old_params, new_params):
+    """Fold aggregated internal moments into final stats and add the
+    norm-based signals. Call AFTER microbatch/shard aggregation (the
+    norms are nonlinear: summing per-microbatch norms would be wrong),
+    still inside the jitted step.
+
+    `grads`/`old_params`/`new_params` are the (G, F, dX, dY) tuples;
+    update-to-param ratio is ||Δθ|| / (||θ|| + eps) — the step size the
+    optimizer ACTUALLY took (post-Adam), the classic divergence /
+    dead-net signal (≫1e-2: blowing up; ~0: frozen).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    metrics = dict(metrics)
+    for side, which in DISC_STATS:
+        k1, k2 = moment_keys(side, which)
+        if k1 not in metrics:
+            continue
+        m1 = metrics.pop(k1)
+        m2 = metrics.pop(k2)
+        metrics[f"health/{side}_{which}_mean"] = m1
+        metrics[f"health/{side}_{which}_std"] = jnp.sqrt(
+            jnp.maximum(m2 - jnp.square(m1), 0.0)
+        )
+    for name, g, p_old, p_new in zip(NETWORKS, grads, old_params, new_params):
+        metrics[f"health/gnorm_{name}"] = optax.global_norm(g)
+        delta = jax.tree.map(jnp.subtract, p_new, p_old)
+        metrics[f"health/upd_ratio_{name}"] = optax.global_norm(delta) / (
+            optax.global_norm(p_old) + 1e-12
+        )
+    metrics["health/nonfinite"] = nonfinite_count(grads)
+    return metrics
+
+
+# ---------------------------------------------------------------------------
+# Host side: detectors over fetched metric rows. Pure stdlib — values
+# arrive as numpy scalars on the deferred-fetch path the loop already
+# runs; this half never touches a device array.
+# ---------------------------------------------------------------------------
+
+
+class HealthFault(RuntimeError):
+    """Raised by the monitor when a halting anomaly fires (only the
+    non-finite tripwire under on_nan='halt'). main.py turns it into a
+    nonzero exit with the last-good checkpoint slot untouched."""
+
+    def __init__(self, kind: str, message: str, details: Optional[dict] = None):
+        super().__init__(message)
+        self.kind = kind
+        self.details = details or {}
+
+
+class HealthMonitor:
+    """Feeds on fetched metric rows (loop.train_epoch calls `observe` at
+    the two sanctioned-fetch sites), detects anomalies, and rolls each
+    epoch up into one `health` event.
+
+    Detector latency is one deferred-fetch horizon: a poisoned gradient
+    surfaces when its row leaves the bounded backpressure window (≤
+    MAX_IN_FLIGHT batches later), not at end of run.
+    """
+
+    def __init__(
+        self,
+        telemetry=None,
+        on_nan: str = "warn",
+        divergence_multiple: float = 4.0,
+        divergence_beta: float = 0.98,
+        divergence_warmup: int = 20,
+        collapse_eps: float = 0.05,
+        collapse_patience: int = 50,
+        echo=None,
+    ):
+        if on_nan not in ("warn", "halt"):
+            raise ValueError(f"on_nan must be 'warn' or 'halt', got {on_nan!r}")
+        self.telemetry = telemetry
+        self.on_nan = on_nan
+        self.divergence_multiple = float(divergence_multiple)
+        self.divergence_beta = float(divergence_beta)
+        self.divergence_warmup = int(divergence_warmup)
+        self.collapse_eps = float(collapse_eps)
+        self.collapse_patience = int(collapse_patience)
+        self.echo = echo
+        self.fault_counts: Dict[str, int] = {}
+        self._epoch = 0
+        self._row = 0  # row index within the current epoch
+        self._ema: Dict[str, float] = {}
+        self._ema_n: Dict[str, int] = {}
+        self._collapse_streak: Dict[str, int] = {"dX": 0, "dY": 0}
+        self._collapse_fired: Dict[str, bool] = {"dX": False, "dY": False}
+        self._reset_epoch_accumulators()
+
+    # -- epoch lifecycle ---------------------------------------------------
+
+    def begin_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+        self._row = 0
+        self._reset_epoch_accumulators()
+
+    def _reset_epoch_accumulators(self) -> None:
+        self._acc: Dict[str, list] = {}  # key -> [n, sum, min, max]
+        self._epoch_faults: Dict[str, int] = {}
+        self._nonfinite_rows = 0
+        self._diverged_keys: set = set()
+
+    # -- observation -------------------------------------------------------
+
+    def observe(self, metrics: dict, steps: int = 1) -> None:
+        """Consume one fetched metrics entry (a dict of scalars, or of
+        [steps]-stacked arrays from a fused K-step dispatch)."""
+        if steps == 1:
+            self._observe_row(metrics)
+            return
+        for i in range(steps):
+            self._observe_row({k: v[i] for k, v in metrics.items()})
+
+    def _observe_row(self, row: dict) -> None:
+        vals: Dict[str, float] = {}
+        for key, v in row.items():
+            if key.startswith("health/") or key in LOSS_KEYS:
+                try:
+                    vals[key] = float(v)
+                except (TypeError, ValueError):
+                    continue
+        if not vals:
+            return
+        self._row += 1
+        for key, v in vals.items():
+            acc = self._acc.get(key)
+            if acc is None:
+                self._acc[key] = [1, v, v, v]
+            else:
+                acc[0] += 1
+                acc[1] += v
+                acc[2] = min(acc[2], v)
+                acc[3] = max(acc[3], v)
+        self._detect_nonfinite(vals)
+        self._detect_divergence(vals)
+        self._detect_collapse(vals)
+
+    # -- detectors ---------------------------------------------------------
+
+    def _detect_nonfinite(self, vals: Dict[str, float]) -> None:
+        count = vals.get("health/nonfinite", 0.0)
+        bad_losses = [
+            k for k in LOSS_KEYS if k in vals and not math.isfinite(vals[k])
+        ]
+        bad_count = not math.isfinite(count) or count > 0
+        if not bad_count and not bad_losses:
+            return
+        self._nonfinite_rows += 1
+        self._fault(
+            "nonfinite",
+            halt=self.on_nan == "halt",
+            count=None if not math.isfinite(count) else int(count),
+            bad_losses=bad_losses,
+            message=(
+                f"non-finite gradients at epoch {self._epoch} row {self._row}"
+                f" (count={count!r}, bad_losses={bad_losses})"
+            ),
+        )
+
+    def _detect_divergence(self, vals: Dict[str, float]) -> None:
+        if self.divergence_multiple <= 0:
+            return
+        for key in GEN_TOTAL_KEYS:
+            v = vals.get(key)
+            if v is None or not math.isfinite(v):
+                continue  # the non-finite tripwire owns that case
+            n = self._ema_n.get(key, 0)
+            ema = self._ema.get(key)
+            if (
+                ema is not None
+                and n >= self.divergence_warmup
+                and v > self.divergence_multiple * max(ema, 1e-3)
+                and key not in self._diverged_keys
+            ):
+                self._diverged_keys.add(key)  # once per epoch per key
+                self._fault(
+                    "divergence",
+                    halt=False,
+                    key=key,
+                    value=round(v, 6),
+                    ema=round(ema, 6),
+                    multiple=self.divergence_multiple,
+                    message=(
+                        f"{key}={v:.4g} exceeds {self.divergence_multiple}x "
+                        f"its EMA ({ema:.4g}) at epoch {self._epoch} "
+                        f"row {self._row}"
+                    ),
+                )
+            b = self.divergence_beta
+            self._ema[key] = v if ema is None else b * ema + (1.0 - b) * v
+            self._ema_n[key] = n + 1
+
+    def _detect_collapse(self, vals: Dict[str, float]) -> None:
+        eps = self.collapse_eps
+        if eps <= 0:
+            return
+        for side in ("dX", "dY"):
+            stats = [
+                vals.get(f"health/{side}_real_mean"),
+                vals.get(f"health/{side}_fake_mean"),
+                vals.get(f"health/{side}_real_std"),
+                vals.get(f"health/{side}_fake_std"),
+            ]
+            if any(s is None or not math.isfinite(s) for s in stats):
+                continue
+            real_mean, fake_mean, real_std, fake_std = stats
+            # Saturation toward the LSGAN targets: D(real)→1, D(fake)→0
+            # with vanishing spread — D has stopped discriminating
+            # ANYTHING about the generator's output; its gradient to the
+            # generator is dead.
+            saturated = (
+                abs(real_mean - 1.0) < eps
+                and abs(fake_mean) < eps
+                and real_std < eps
+                and fake_std < eps
+            )
+            if not saturated:
+                self._collapse_streak[side] = 0
+                self._collapse_fired[side] = False
+                continue
+            self._collapse_streak[side] += 1
+            if (
+                self._collapse_streak[side] >= self.collapse_patience
+                and not self._collapse_fired[side]
+            ):
+                self._collapse_fired[side] = True  # once per episode
+                self._fault(
+                    "d_collapse",
+                    halt=False,
+                    side=side,
+                    streak=self._collapse_streak[side],
+                    real_mean=round(real_mean, 6),
+                    fake_mean=round(fake_mean, 6),
+                    message=(
+                        f"{side} saturated at LSGAN targets for "
+                        f"{self._collapse_streak[side]} consecutive rows "
+                        f"(D(real)={real_mean:.3f}, D(fake)={fake_mean:.3f}) "
+                        f"at epoch {self._epoch}"
+                    ),
+                )
+
+    def _fault(self, kind: str, halt: bool, message: str, **details) -> None:
+        self.fault_counts[kind] = self.fault_counts.get(kind, 0) + 1
+        self._epoch_faults[kind] = self._epoch_faults.get(kind, 0) + 1
+        tele = self.telemetry
+        if tele is not None:
+            tele.event(
+                "health_fault",
+                kind=kind,
+                epoch=self._epoch,
+                row=self._row,
+                policy="halt" if halt else "warn",
+                **{k: v for k, v in details.items() if v is not None},
+            )
+        if self.echo is not None and self._epoch_faults[kind] == 1:
+            # once per epoch per kind on the console; the stream has all
+            self.echo(f"health: {message}")
+        if halt:
+            if tele is not None:
+                tele.flush()
+            raise HealthFault(kind, message, details)
+
+    # -- rollup ------------------------------------------------------------
+
+    def epoch_rollup(self, epoch: Optional[int] = None) -> dict:
+        """Emit one `health` event summarizing the epoch's rows; returns
+        a flat dict for print_epoch_summary. Resets epoch accumulators."""
+        epoch = self._epoch if epoch is None else epoch
+
+        def _mean(key):
+            acc = self._acc.get(key)
+            return acc[1] / acc[0] if acc else None
+
+        def _env(key):
+            acc = self._acc.get(key)
+            if not acc:
+                return None
+            return {
+                "min": round(acc[2], 6),
+                "mean": round(acc[1] / acc[0], 6),
+                "max": round(acc[3], 6),
+            }
+
+        event = {
+            "epoch": epoch,
+            "rows": self._row,
+            "gnorm": {
+                net: env
+                for net in NETWORKS
+                if (env := _env(f"health/gnorm_{net}")) is not None
+            },
+            "upd_ratio": {
+                net: env
+                for net in NETWORKS
+                if (env := _env(f"health/upd_ratio_{net}")) is not None
+            },
+            "disc": {
+                side: {
+                    stat: round(m, 6)
+                    for stat in ("real_mean", "fake_mean", "real_std", "fake_std")
+                    if (m := _mean(f"health/{side}_{stat}")) is not None
+                }
+                for side in ("dX", "dY")
+            },
+            "loss": {
+                key: round(m, 6)
+                for key in LOSS_KEYS
+                if (m := _mean(key)) is not None
+            },
+            "ema": {k: round(v, 6) for k, v in self._ema.items()},
+            "nonfinite_rows": self._nonfinite_rows,
+            "anomalies": dict(self._epoch_faults),
+        }
+        if self.telemetry is not None:
+            self.telemetry.event("health", **event)
+
+        flat: Dict[str, float] = {}
+        for net in NETWORKS:
+            m = _mean(f"health/gnorm_{net}")
+            if m is not None:
+                flat[f"gnorm_{net}"] = m
+        for side, stat in DISC_STATS:
+            m = _mean(f"health/{side}_{stat}_mean")
+            if m is not None:
+                flat[f"{side}_{stat}_mean"] = m
+        self._reset_epoch_accumulators()
+        return flat
+
+
+def make_health_monitor(
+    obs_config, telemetry=None, primary: bool = True
+) -> Optional[HealthMonitor]:
+    """Build the monitor from the config's `obs` section; None when the
+    health layer is disabled. Non-primary hosts keep a full monitor over
+    a null telemetry (replicated scalars ⇒ identical detections ⇒ a
+    halt is process-synchronous), they just echo nothing."""
+    if not getattr(obs_config, "health", True):
+        return None
+    return HealthMonitor(
+        telemetry=telemetry,
+        on_nan=getattr(obs_config, "on_nan", "warn"),
+        divergence_multiple=float(
+            getattr(obs_config, "divergence_multiple", 4.0)
+        ),
+        collapse_eps=float(getattr(obs_config, "collapse_eps", 0.05)),
+        collapse_patience=int(getattr(obs_config, "collapse_patience", 50)),
+        echo=print if primary else None,
+    )

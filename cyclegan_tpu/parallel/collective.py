@@ -21,6 +21,7 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 from cyclegan_tpu.config import Config
+from cyclegan_tpu.obs import health
 from cyclegan_tpu.parallel.mesh import MeshPlan
 from cyclegan_tpu.train.steps import make_grad_fn, make_update_fn
 
@@ -53,9 +54,24 @@ def shard_map_train_step(
         check_vma=False,
     )
 
+    with_health = config.obs.health
+
     @jax.jit
     def train_step(state, x, y, weights):
         grads, metrics = sharded_grads(state, x, y, weights)
-        return update(state, grads), metrics
+        new_state = update(state, grads)
+        if with_health:
+            # Same finalization as make_train_step, applied to the
+            # POST-psum grads/moments — grads here are already global,
+            # so the health stats equal the auto-sharded path's
+            # bit-for-tolerance (tests/test_dp.py compares every key).
+            params = (state.g_params, state.f_params,
+                      state.dx_params, state.dy_params)
+            new_params = (new_state.g_params, new_state.f_params,
+                          new_state.dx_params, new_state.dy_params)
+            metrics = health.finalize_health_metrics(
+                metrics, grads, params, new_params
+            )
+        return new_state, metrics
 
     return train_step

@@ -108,12 +108,18 @@ def train_epoch(
     tracer=None,
     multi_step_fn: Callable = None,
     obs=None,
+    health=None,
 ) -> CycleGANState:
     """One training pass (reference main.py:332-341). `tracer` is an
     optional utils.profiler.TraceCapture stepped once per train step.
     `obs` is an optional obs.Telemetry; its StepClock timestamps the
     staging/dispatch/deferred-fetch path WITHOUT adding any host-device
     sync (obs/stepclock.py — enforced by tools/check_no_sync.py).
+    `health` is an optional obs.HealthMonitor fed each fetched metrics
+    row at the two sanctioned-fetch sites — values are already on the
+    host there, so anomaly detection adds no sync either; its halting
+    tripwire (on_nan='halt') raises obs.HealthFault out of this loop
+    within one deferred-fetch horizon of the poisoned step.
 
     With steps_per_dispatch K > 1 (`multi_step_fn` from
     shard_multi_train_step), K full batches at a time run as one fused
@@ -133,6 +139,8 @@ def train_epoch(
     k = config.train.steps_per_dispatch
     accum = config.train.grad_accum
     clock = (obs or NULL_TELEMETRY).step_clock(epoch, split="train")
+    if health is not None:
+        health.begin_epoch(epoch)
     # Deferred metric fetch: device_get per step would SYNC the host to
     # every step, serializing dispatch. Holding the (tiny scalar) device
     # arrays and fetching later keeps the dispatch pipeline async — the
@@ -157,13 +165,18 @@ def train_epoch(
             # data-depend on their step), no sync is added.
             oldest = pending.pop(0)
             t_fetch = perf_counter()
-            fetched.append(jax.device_get(oldest))  # sanctioned-fetch: bounded backpressure window
+            got = jax.device_get(oldest)  # sanctioned-fetch: bounded backpressure window
             t_ready = perf_counter()
+            fetched.append(got)
             # The completion timestamp doubles as the submit→ready proof
             # for the fetched dispatch (stepclock attribution) — same
             # perf_counter read, no extra sync.
             clock.fetched(t_ready - t_fetch,
                           steps=oldest[1], pinned=oldest[2], at=t_ready)
+            if health is not None:
+                # Detection on host copies the loop just fetched anyway
+                # — this is where a poisoned step first becomes visible.
+                health.observe(got[0], steps=got[1])
 
     multi = multi_step_fn is not None and k > 1
     staged = _staged_batches(config, data, plan, epoch, multi)
@@ -221,6 +234,9 @@ def train_epoch(
     tail = jax.device_get(pending)  # sanctioned-fetch: end-of-epoch drain
     t_ready = perf_counter()
     clock.drained(t_ready - t_drain, n_entries=len(pending), at=t_ready)
+    if health is not None:
+        for metrics, steps, _ in tail:
+            health.observe(metrics, steps=steps)
     results: Dict[str, list] = {}
     for metrics, steps, _ in fetched + tail:
         if steps == 1:
@@ -281,21 +297,36 @@ def test_epoch(
     return means
 
 
-def print_epoch_summary(results: Dict[str, float], elapse: float) -> None:
+def print_epoch_summary(results: Dict[str, float], elapse: float,
+                        health: Dict[str, float] = None) -> None:
     """Console summary of the four error metrics (main.py:394-398,
     with the swapped-label bug fixed). Missing keys print as nan
     instead of raising — a test epoch can produce no results (empty
-    test split, preempted pass)."""
+    test split, preempted pass). `health` is the flat epoch rollup from
+    obs.HealthMonitor.epoch_rollup (per-network grad-norm means and
+    D-balance means); same nan tolerance, and None (health layer off)
+    reproduces the historical output exactly."""
     def _get(key: str) -> float:
         return results.get(key, float("nan"))
 
-    print(
+    msg = (
         f'MAE(X, F(G(X))): {_get("error/MAE(X, F(G(X)))"):.04f}\t\t'
         f'MAE(X, F(X)): {_get("error/MAE(X, F(X))"):.04f}\n'
         f'MAE(Y, G(F(Y))): {_get("error/MAE(Y, G(F(Y)))"):.04f}\t\t'
         f'MAE(Y, G(Y)): {_get("error/MAE(Y, G(Y))"):.04f}\n'
-        f'Elapse: {elapse:.02f}s\n'
     )
+    if health is not None:
+        def _h(key: str) -> float:
+            return health.get(key, float("nan"))
+
+        msg += (
+            f'grad-norm G/F/dX/dY: {_h("gnorm_G"):.03g}/{_h("gnorm_F"):.03g}/'
+            f'{_h("gnorm_dX"):.03g}/{_h("gnorm_dY"):.03g}\t'
+            f'D(real)/D(fake) X: {_h("dX_real_mean"):.02f}/'
+            f'{_h("dX_fake_mean"):.02f}  '
+            f'Y: {_h("dY_real_mean"):.02f}/{_h("dY_fake_mean"):.02f}\n'
+        )
+    print(msg + f'Elapse: {elapse:.02f}s\n')
 
 
 def images_per_sec(n_images: int, elapse: float) -> float:

@@ -48,11 +48,16 @@ import optax
 
 from cyclegan_tpu import losses
 from cyclegan_tpu.config import Config
+from cyclegan_tpu.obs import health
 from cyclegan_tpu.train.state import CycleGANState, build_models, make_optimizer
 
 Metrics = Dict[str, jnp.ndarray]
 
 stop = jax.lax.stop_gradient
+
+
+def _param_tuple(state: CycleGANState):
+    return (state.g_params, state.f_params, state.dx_params, state.dy_params)
 
 
 def make_grad_fn(config: Config, global_batch_size: int) -> Callable:
@@ -62,10 +67,19 @@ def make_grad_fn(config: Config, global_batch_size: int) -> Callable:
     -> ((g_g, g_f, g_dx, g_dy), metrics): the four per-network gradients
     from ONE backward pass, plus the ten training scalars of
     main.py:228-237, 247 under identical keys.
+
+    With `config.obs.health` the metrics also carry the internal
+    `_health/` D raw-output moments (obs/health.py): LINEAR scalars
+    (same sum(w·x)/global_batch form as the losses) that aggregate
+    exactly across accumulation microbatches and psum shards, finalized
+    to mean/σ by `health.finalize_health_metrics` after aggregation.
+    They live in the aux output, so they cost a few reductions on
+    activations the forward already produced — no extra backward work.
     """
     gen, disc = build_models(config)
     lam_c = config.loss.lambda_cycle
     lam_i = config.loss.lambda_identity
+    with_health = config.obs.health
     gbs = float(global_batch_size)
 
     def combined_loss(g_params, f_params, dx_params, dy_params, x, y, w):
@@ -92,12 +106,12 @@ def make_grad_fn(config: Config, global_batch_size: int) -> Callable:
         f_total = f_adv + f_cycle + f_id
 
         # Discriminator terms (main.py:239-247): stopped fakes
-        x_loss = losses.discriminator_loss(
-            disc.apply(dx_params, x), disc.apply(dx_params, stop(fake_x)), w, gbs
-        )
-        y_loss = losses.discriminator_loss(
-            disc.apply(dy_params, y), disc.apply(dy_params, stop(fake_y)), w, gbs
-        )
+        disc_real_x = disc.apply(dx_params, x)
+        disc_fake_x_d = disc.apply(dx_params, stop(fake_x))
+        disc_real_y = disc.apply(dy_params, y)
+        disc_fake_y_d = disc.apply(dy_params, stop(fake_y))
+        x_loss = losses.discriminator_loss(disc_real_x, disc_fake_x_d, w, gbs)
+        y_loss = losses.discriminator_loss(disc_real_y, disc_fake_y_d, w, gbs)
 
         combined = g_total + f_total + x_loss + y_loss
         metrics = {
@@ -112,6 +126,19 @@ def make_grad_fn(config: Config, global_batch_size: int) -> Callable:
             "loss_X/loss": x_loss,
             "loss_Y/loss": y_loss,
         }
+        if with_health:
+            # D-saturation moments over outputs the forward already has;
+            # stopped (aux is never differentiated, but keep the graph's
+            # intent explicit).
+            for side, d_out_real, d_out_fake in (
+                ("dX", disc_real_x, disc_fake_x_d),
+                ("dY", disc_real_y, disc_fake_y_d),
+            ):
+                for which, d_out in (("real", d_out_real), ("fake", d_out_fake)):
+                    k1, k2 = health.moment_keys(side, which)
+                    metrics[k1], metrics[k2] = losses.disc_raw_moments(
+                        stop(d_out), w, gbs
+                    )
         return combined, metrics
 
     return jax.grad(combined_loss, argnums=(0, 1, 2, 3), has_aux=True)
@@ -156,6 +183,7 @@ def make_train_step(
     """
     grad_fn = make_grad_fn(config, global_batch_size)
     update = make_update_fn(config)
+    with_health = config.obs.health
 
     def train_step(
         state: CycleGANState, x: jnp.ndarray, y: jnp.ndarray, weights: jnp.ndarray
@@ -163,7 +191,15 @@ def make_train_step(
         grads, metrics = grad_fn(
             state.g_params, state.f_params, state.dx_params, state.dy_params, x, y, weights
         )
-        return update(state, grads), metrics
+        new_state = update(state, grads)
+        if with_health:
+            # Health stats ride THIS dispatch (the metrics dict goes
+            # through the same deferred fetch) — no extra program, no
+            # host sync (obs/health.py, tools/check_no_sync.py).
+            metrics = health.finalize_health_metrics(
+                metrics, grads, _param_tuple(state), _param_tuple(new_state)
+            )
+        return new_state, metrics
 
     return train_step
 
@@ -193,6 +229,7 @@ def make_accum_train_step(
     """
     grad_fn = make_grad_fn(config, global_batch_size)
     update = make_update_fn(config)
+    with_health = config.obs.health
 
     def accum_step(
         state: CycleGANState, xs: jnp.ndarray, ys: jnp.ndarray, ws: jnp.ndarray
@@ -218,7 +255,16 @@ def make_accum_train_step(
             body, (zeros(g_shape), zeros(m_shape)), (xs, ys, ws),
             length=accum_steps,
         )
-        return update(state, grads), metrics
+        new_state = update(state, grads)
+        if with_health:
+            # After the scan: the summed grads ARE the big-batch grads
+            # and the summed `_health/` moments the big-batch moments
+            # (linearity), so norms/σ finalized here equal the
+            # single-big-batch step's exactly (tests/test_accum.py).
+            metrics = health.finalize_health_metrics(
+                metrics, grads, _param_tuple(state), _param_tuple(new_state)
+            )
+        return new_state, metrics
 
     return accum_step
 

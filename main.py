@@ -112,6 +112,11 @@ def main(args: argparse.Namespace) -> None:
             step_log_every=args.obs_step_log_every,
             memory_sample_every=args.obs_memory_every,
             stall_multiple=args.obs_stall_multiple,
+            health=not args.no_health,
+            on_nan=args.on_nan,
+            divergence_multiple=args.health_divergence_multiple,
+            collapse_eps=args.health_collapse_eps,
+            collapse_patience=args.health_collapse_patience,
         ),
     )
     if config.train.grad_accum < 1 or config.train.steps_per_dispatch < 1:
@@ -159,9 +164,15 @@ def main(args: argparse.Namespace) -> None:
     # timing from inside the loop, per-epoch throughput/MFU, HBM
     # watermarks, stall watchdog. Host-local only, so the non-primary
     # Null variant cannot skew collectives.
-    from cyclegan_tpu.obs import make_telemetry
+    from cyclegan_tpu.obs import HealthFault, make_health_monitor, make_telemetry
 
     tele = make_telemetry(config.obs, config.train.output_dir, primary)
+    # Model-health flight recorder (obs/health.py): in-step numerics
+    # stats ride the train-step metrics dict; this monitor runs the
+    # host-side detectors on the fetched rows. Every host gets one
+    # (detections are deterministic on replicated scalars, so an
+    # on_nan=halt exit is process-synchronous); only the primary echoes.
+    health = make_health_monitor(config.obs, tele, primary)
     # Test/FID forwards have no microbatching, so they run at the real
     # per-dispatch batch (the training microbatch) — under --grad_accum
     # the effective train batch would OOM exactly the configs
@@ -275,11 +286,18 @@ def main(args: argparse.Namespace) -> None:
             state = loop.train_epoch(
                 config, data, plan, train_step, state, summary, epoch,
                 tracer=tracer, multi_step_fn=multi_step, obs=tele,
+                health=health,
             )
             train_elapse = time() - start
             results = loop.test_epoch(
                 config, data, plan, test_step, state, summary, epoch,
                 obs=tele,
+            )
+            # One `health` event per epoch (grad-norm envelopes,
+            # D-balance, anomaly counts); the flat dict feeds the
+            # console line below.
+            health_rollup = (
+                health.epoch_rollup(epoch) if health is not None else None
             )
             elapse = time() - start
             summary.scalar("elapse", elapse, step=epoch)
@@ -316,7 +334,8 @@ def main(args: argparse.Namespace) -> None:
                     and epoch % config.obs.memory_sample_every == 0):
                 tele.memory(epoch)
             if primary:
-                loop.print_epoch_summary(results, elapse)
+                loop.print_epoch_summary(results, elapse,
+                                         health=health_rollup)
 
             preempted = guard.should_stop()
             last = epoch == config.train.epochs - 1
@@ -370,6 +389,18 @@ def main(args: argparse.Namespace) -> None:
                 break
         else:
             run_status = "completed"
+    except HealthFault as fault:
+        # The non-finite tripwire under --on_nan halt: the monitor
+        # already wrote the health_fault event and flushed the stream.
+        # No checkpoint save happens on this path, so the last-good slot
+        # survives for a resume from pre-NaN weights; exit nonzero so
+        # sweep drivers see the run died of numerics, not preemption.
+        run_status = "health_fault"
+        services.barrier()
+        if primary:
+            print(f"HEALTH FAULT ({fault.kind}): {fault}")
+            print(f"halting with last-good checkpoint intact at {ckpt.slot}")
+        raise SystemExit(3)
     finally:
         # Flush the in-flight trace even when an epoch raises — profiling
         # data from a crashed run is the data you want most. Same for the
@@ -534,6 +565,35 @@ if __name__ == "__main__":
                              "dispatch's loop-iteration wall exceeds X times "
                              "the rolling median (32-dispatch window, armed "
                              "after 5 dispatches); 0 disables")
+    # Model-health flight recorder (cyclegan_tpu/obs/health.py)
+    parser.add_argument("--no_health", action="store_true",
+                        help="disable the model-health layer: in-step grad "
+                             "norms / update ratios / non-finite counts / "
+                             "D-saturation stats (they ride the train-step "
+                             "metrics dict — no extra dispatches) and the "
+                             "host-side anomaly detectors")
+    parser.add_argument("--on_nan", default="warn",
+                        choices=["warn", "halt"],
+                        help="non-finite gradient policy: 'warn' records a "
+                             "health_fault event and keeps training; 'halt' "
+                             "flushes telemetry, keeps the last-good "
+                             "checkpoint, and exits nonzero — detection "
+                             "lands within one deferred-fetch horizon of "
+                             "the poisoned step")
+    parser.add_argument("--health_divergence_multiple", default=4.0,
+                        type=float, metavar="X",
+                        help="warn when loss_G/total or loss_F/total "
+                             "exceeds X times its own EMA (armed after a "
+                             "warmup window); 0 disables")
+    parser.add_argument("--health_collapse_eps", default=0.05, type=float,
+                        metavar="EPS",
+                        help="D-collapse detector: D outputs within EPS of "
+                             "the LSGAN targets (mean and std, real and "
+                             "fake) count as saturated; <=0 disables")
+    parser.add_argument("--health_collapse_patience", default=50, type=int,
+                        metavar="N",
+                        help="consecutive saturated rows before a "
+                             "d_collapse health_fault fires")
     parser.add_argument("--expect_partial", action="store_true",
                         help="tolerate checkpoint/model mismatches on resume: "
                              "restore matching leaves, keep fresh init for the "

@@ -1,0 +1,439 @@
+"""Model-health flight recorder (obs/health.py): in-step numerics
+metrics, host-side anomaly detectors, and the halt policy.
+
+The device half must add its statistics INSIDE the existing jitted
+dispatch (same metrics dict, same deferred-fetch path — dispatch count
+per step pinned unchanged here via the StepClock aggregate), and the
+host half must catch a poisoned step within one deferred-fetch horizon
+of the loop, halting with the checkpoint slot untouched when asked to.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cyclegan_tpu.config import ObsConfig
+from cyclegan_tpu.obs import (
+    HealthFault,
+    HealthMonitor,
+    make_health_monitor,
+    make_telemetry,
+)
+from cyclegan_tpu.obs.health import (
+    DISC_STATS,
+    INTERNAL_PREFIX,
+    NETWORKS,
+)
+from cyclegan_tpu.train import create_state, make_train_step
+
+REFERENCE_KEYS = {
+    "loss_G/loss", "loss_G/cycle", "loss_G/identity", "loss_G/total",
+    "loss_F/loss", "loss_F/cycle", "loss_F/identity", "loss_F/total",
+    "loss_X/loss", "loss_Y/loss",
+}
+
+HEALTH_KEYS = (
+    {f"health/{s}_{w}_{stat}" for s, w in DISC_STATS
+     for stat in ("mean", "std")}
+    | {f"health/gnorm_{n}" for n in NETWORKS}
+    | {f"health/upd_ratio_{n}" for n in NETWORKS}
+    | {"health/nonfinite"}
+)
+
+
+@pytest.fixture(scope="module")
+def setup(tiny_config):
+    cfg = tiny_config
+    state = create_state(cfg, jax.random.PRNGKey(0))
+    kx, ky = jax.random.split(jax.random.PRNGKey(7))
+    n = 2
+    shape = (n, cfg.model.image_size, cfg.model.image_size, 3)
+    x = jax.random.uniform(kx, shape, minval=-1, maxval=1)
+    y = jax.random.uniform(ky, shape, minval=-1, maxval=1)
+    w = jnp.ones((n,), jnp.float32)
+    return cfg, state, x, y, w
+
+
+# ------------------------------------------------- device-side metrics
+
+
+def test_train_step_emits_health_metrics(setup):
+    """The health stats ride the train step's metrics dict: reference
+    keys plus the full health/* set, all finite on a healthy step, and
+    no internal `_health/` moment keys leaking past finalization."""
+    cfg, state, x, y, w = setup
+    train_step = jax.jit(make_train_step(cfg, x.shape[0]))
+    _, metrics = train_step(state, x, y, w)
+    assert REFERENCE_KEYS <= set(metrics)
+    assert set(metrics) == REFERENCE_KEYS | HEALTH_KEYS
+    assert not any(k.startswith(INTERNAL_PREFIX) for k in metrics)
+    for k in HEALTH_KEYS:
+        assert np.isfinite(float(metrics[k])), f"{k} not finite"
+    assert float(metrics["health/nonfinite"]) == 0.0
+    for net in NETWORKS:
+        assert float(metrics[f"health/gnorm_{net}"]) > 0.0
+        assert float(metrics[f"health/upd_ratio_{net}"]) > 0.0
+
+
+def test_health_disabled_restores_reference_metrics(setup):
+    """obs.health=False must reproduce the historical metrics dict
+    exactly — the layer is strictly additive."""
+    cfg, state, x, y, w = setup
+    cfg_off = dataclasses.replace(
+        cfg, obs=dataclasses.replace(cfg.obs, health=False)
+    )
+    train_step = jax.jit(make_train_step(cfg_off, x.shape[0]))
+    _, metrics = train_step(state, x, y, w)
+    assert set(metrics) == REFERENCE_KEYS
+
+
+def test_nonfinite_counter_trips_on_poisoned_params(setup):
+    """NaN parameters poison the backward pass; the fused isfinite
+    reduction must report a nonzero count in the same step's metrics."""
+    cfg, state, x, y, w = setup
+    poisoned = state.replace(
+        g_params=jax.tree.map(
+            lambda a: jnp.full_like(a, jnp.nan), state.g_params
+        )
+    )
+    train_step = jax.jit(make_train_step(cfg, x.shape[0]))
+    _, metrics = train_step(poisoned, x, y, w)
+    assert float(metrics["health/nonfinite"]) > 0
+
+
+# ------------------------------------------------- host-side detectors
+
+
+class FakeTelemetry:
+    def __init__(self):
+        self.events = []
+        self.flushed = 0
+
+    def event(self, kind, /, **fields):
+        # Positional-only `kind`, like obs.Telemetry.event: fault events
+        # carry a "kind" FIELD too (the detector name).
+        self.events.append(dict(fields, event=kind))
+
+    def flush(self):
+        self.flushed += 1
+
+
+def _healthy_row(**over):
+    row = {
+        "loss_G/total": 3.0, "loss_F/total": 3.1,
+        "loss_X/loss": 0.5, "loss_Y/loss": 0.5,
+        "health/nonfinite": 0.0,
+    }
+    for net in NETWORKS:
+        row[f"health/gnorm_{net}"] = 1.0
+        row[f"health/upd_ratio_{net}"] = 1e-4
+    for side in ("dX", "dY"):
+        row[f"health/{side}_real_mean"] = 0.6
+        row[f"health/{side}_fake_mean"] = 0.4
+        row[f"health/{side}_real_std"] = 0.2
+        row[f"health/{side}_fake_std"] = 0.2
+    row.update(over)
+    return row
+
+
+def test_nonfinite_tripwire_warn_vs_halt():
+    tele = FakeTelemetry()
+    mon = HealthMonitor(telemetry=tele, on_nan="warn")
+    mon.observe(_healthy_row())
+    mon.observe(_healthy_row(**{"health/nonfinite": 12.0}))
+    assert mon.fault_counts == {"nonfinite": 1}
+    faults = [e for e in tele.events if e["event"] == "health_fault"]
+    assert len(faults) == 1
+    assert faults[0]["kind"] == "nonfinite"
+    assert faults[0]["policy"] == "warn"
+    assert faults[0]["count"] == 12
+
+    tele = FakeTelemetry()
+    mon = HealthMonitor(telemetry=tele, on_nan="halt")
+    with pytest.raises(HealthFault) as e:
+        mon.observe(_healthy_row(**{"loss_G/total": float("nan")}))
+    assert e.value.kind == "nonfinite"
+    # The stream is flushed BEFORE the raise: the fault record must
+    # survive the process dying on the way out.
+    assert tele.flushed == 1
+    assert tele.events[-1]["event"] == "health_fault"
+    assert tele.events[-1]["policy"] == "halt"
+
+
+def test_nonfinite_tripwire_rejects_bad_policy():
+    with pytest.raises(ValueError):
+        HealthMonitor(on_nan="explode")
+
+
+def test_divergence_detector_fires_after_warmup_once_per_epoch():
+    # A spike INSIDE warmup never fires: the detector arms only after
+    # divergence_warmup rows of EMA history.
+    cold = HealthMonitor(divergence_multiple=4.0)
+    for _ in range(cold.divergence_warmup - 1):
+        cold.observe(_healthy_row())
+    cold.observe(_healthy_row(**{"loss_G/total": 100.0}))
+    assert cold.fault_counts.get("divergence", 0) == 0
+
+    tele = FakeTelemetry()
+    mon = HealthMonitor(telemetry=tele, divergence_multiple=4.0)
+    for _ in range(mon.divergence_warmup + 5):
+        mon.observe(_healthy_row())
+    # The EMA sits at 3.0; a 4x excursion fires exactly once per epoch
+    # per key even if it persists.
+    mon.observe(_healthy_row(**{"loss_G/total": 50.0}))
+    mon.observe(_healthy_row(**{"loss_G/total": 50.0}))
+    assert mon.fault_counts == {"divergence": 1}
+    fault = [e for e in tele.events if e["event"] == "health_fault"][0]
+    assert fault["kind"] == "divergence" and fault["key"] == "loss_G/total"
+    # Next epoch re-arms the once-per-epoch latch.
+    mon.epoch_rollup()
+    mon.begin_epoch(1)
+    mon.observe(_healthy_row(**{"loss_G/total": 80.0}))
+    assert mon.fault_counts == {"divergence": 2}
+
+
+def test_collapse_detector_needs_patience_and_fires_once():
+    tele = FakeTelemetry()
+    mon = HealthMonitor(telemetry=tele, collapse_eps=0.05,
+                        collapse_patience=5)
+    saturated = {
+        "health/dX_real_mean": 0.99, "health/dX_fake_mean": 0.01,
+        "health/dX_real_std": 0.01, "health/dX_fake_std": 0.01,
+    }
+    for _ in range(4):
+        mon.observe(_healthy_row(**saturated))
+    assert mon.fault_counts.get("d_collapse", 0) == 0
+    mon.observe(_healthy_row(**saturated))  # 5th consecutive: fires
+    mon.observe(_healthy_row(**saturated))  # latched: no refire
+    assert mon.fault_counts == {"d_collapse": 1}
+    fault = [e for e in tele.events if e["event"] == "health_fault"][0]
+    assert fault["side"] == "dX"
+    # A healthy row breaks the streak and resets the latch.
+    mon.observe(_healthy_row())
+    for _ in range(5):
+        mon.observe(_healthy_row(**saturated))
+    assert mon.fault_counts == {"d_collapse": 2}
+
+
+def test_epoch_rollup_event_and_flat_summary():
+    tele = FakeTelemetry()
+    mon = HealthMonitor(telemetry=tele)
+    mon.begin_epoch(3)
+    mon.observe(_healthy_row(**{"health/gnorm_G": 0.5}))
+    mon.observe(_healthy_row(**{"health/gnorm_G": 1.5}))
+    flat = mon.epoch_rollup()
+    ev = [e for e in tele.events if e["event"] == "health"][0]
+    assert ev["epoch"] == 3 and ev["rows"] == 2
+    assert ev["gnorm"]["G"] == {"min": 0.5, "mean": 1.0, "max": 1.5}
+    assert ev["loss"]["loss_G/total"] == pytest.approx(3.0)
+    assert ev["disc"]["dX"]["real_mean"] == pytest.approx(0.6)
+    assert ev["anomalies"] == {} and ev["nonfinite_rows"] == 0
+    assert flat["gnorm_G"] == pytest.approx(1.0)
+    assert flat["dY_fake_mean"] == pytest.approx(0.4)
+    # Rollup resets the epoch accumulators.
+    mon.begin_epoch(4)
+    assert mon.epoch_rollup() == {}
+
+
+def test_observe_unstacks_fused_multi_step_rows():
+    """A fused K-step dispatch fetches [K]-stacked metric arrays; the
+    monitor must see K individual rows."""
+    mon = HealthMonitor()
+    stacked = {k: np.array([v, v, v]) for k, v in _healthy_row().items()}
+    stacked["health/nonfinite"] = np.array([0.0, 3.0, 0.0])
+    mon.observe(stacked, steps=3)
+    assert mon._row == 3
+    assert mon.fault_counts == {"nonfinite": 1}
+
+
+def test_make_health_monitor_respects_config():
+    assert make_health_monitor(ObsConfig(health=False)) is None
+    mon = make_health_monitor(
+        ObsConfig(on_nan="halt", divergence_multiple=6.0,
+                  collapse_eps=0.1, collapse_patience=9),
+        primary=False,
+    )
+    assert mon.on_nan == "halt"
+    assert mon.divergence_multiple == 6.0
+    assert mon.collapse_eps == 0.1 and mon.collapse_patience == 9
+    assert mon.echo is None  # non-primary hosts detect silently
+
+
+# ------------------------------------------------- loop integration
+
+
+def _loop_setup(config, devices, gb=4):
+    from cyclegan_tpu.data import build_data
+    from cyclegan_tpu.parallel import make_mesh_plan, shard_train_step
+    from cyclegan_tpu.parallel.mesh import replicated
+    from cyclegan_tpu.train import loop
+
+    plan = make_mesh_plan(config.parallel, devices[:4])
+    data = build_data(config, gb)
+    state = jax.device_put(create_state(config, jax.random.PRNGKey(0)),
+                           replicated(plan))
+    step = shard_train_step(plan, make_train_step(config, gb))
+    return loop, plan, data, state, step
+
+
+def test_loop_feeds_monitor_without_extra_dispatches(tiny_config, devices,
+                                                     tmp_path):
+    """The monitor sees every train step through the loop's existing
+    fetch sites, and the dispatch count is EXACTLY the step count — the
+    health layer adds no dispatches and no fetches (the no-sync check
+    pins the no-added-sync half: tools/check_no_sync.py scans obs/)."""
+    from cyclegan_tpu.utils.summary import NullSummary
+
+    loop, plan, data, state, step = _loop_setup(tiny_config, devices)
+    path = str(tmp_path / "t.jsonl")
+    tele = make_telemetry(ObsConfig(jsonl_path=path), str(tmp_path))
+    mon = HealthMonitor(telemetry=tele)
+    mon.begin_epoch(0)
+    loop.train_epoch(tiny_config, data, plan, step, state, NullSummary(),
+                     epoch=0, obs=tele, health=mon)
+    assert mon._row == data.train_steps
+    mon.epoch_rollup(0)
+    tele.close()
+
+    evs = [json.loads(l) for l in open(path) if l.strip()]
+    agg = [e for e in evs if e["event"] == "epoch_steps"][0]
+    assert agg["n_dispatches"] == data.train_steps
+    health = [e for e in evs if e["event"] == "health"]
+    assert len(health) == 1 and health[0]["rows"] == data.train_steps
+    assert set(health[0]["gnorm"]) == set(NETWORKS)
+    assert not [e for e in evs if e["event"] == "health_fault"]
+
+
+def test_loop_nan_injection_halts_within_fetch_horizon(tiny_config, devices,
+                                                       tmp_path):
+    """Poisoned params under on_nan='halt': train_epoch raises
+    HealthFault (within the deferred-fetch horizon — i.e. during the
+    epoch, not after it), and the flushed stream carries the
+    health_fault record."""
+    from cyclegan_tpu.utils.summary import NullSummary
+
+    loop, plan, data, state, step = _loop_setup(tiny_config, devices)
+    poisoned = state.replace(
+        g_params=jax.tree.map(
+            lambda a: jnp.full_like(a, jnp.nan), state.g_params
+        )
+    )
+    path = str(tmp_path / "t.jsonl")
+    tele = make_telemetry(ObsConfig(jsonl_path=path), str(tmp_path))
+    mon = HealthMonitor(telemetry=tele, on_nan="halt")
+    mon.begin_epoch(0)
+    with pytest.raises(HealthFault) as e:
+        loop.train_epoch(tiny_config, data, plan, step, poisoned,
+                         NullSummary(), epoch=0, obs=tele, health=mon)
+    assert e.value.kind == "nonfinite"
+    # The fault record is on disk BEFORE close (the halt path flushes).
+    evs = [json.loads(l) for l in open(path) if l.strip()]
+    faults = [e for e in evs if e["event"] == "health_fault"]
+    assert faults and faults[0]["kind"] == "nonfinite"
+    assert faults[0]["policy"] == "halt"
+    tele.close("health_fault")
+
+
+def test_loop_nan_injection_warn_completes_epoch(tiny_config, devices,
+                                                 capsys):
+    """Same poison under the default warn policy: the epoch completes,
+    every row is flagged, and the console carries one echo line (not
+    one per step)."""
+    from cyclegan_tpu.utils.summary import NullSummary
+
+    loop, plan, data, state, step = _loop_setup(tiny_config, devices)
+    poisoned = state.replace(
+        g_params=jax.tree.map(
+            lambda a: jnp.full_like(a, jnp.nan), state.g_params
+        )
+    )
+    mon = HealthMonitor(on_nan="warn", echo=print)
+    mon.begin_epoch(0)
+    loop.train_epoch(tiny_config, data, plan, step, poisoned,
+                     NullSummary(), epoch=0, health=mon)
+    assert mon.fault_counts["nonfinite"] == data.train_steps
+    assert capsys.readouterr().out.count("health:") == 1
+    flat = mon.epoch_rollup(0)
+    assert "gnorm_G" in flat and math.isnan(flat["gnorm_G"])
+
+
+def test_main_on_nan_halt_exits_3_with_stream_record(tmp_path):
+    """The CLI-level halt contract: a NaN reaching the monitor under
+    --on_nan halt makes `python main.py` exit 3 (not 0, not a crash),
+    with the health_fault record flushed and the end event carrying
+    status=health_fault. train_epoch is stubbed to feed the monitor one
+    poisoned row, so the test exercises exactly main.py's wiring (flag
+    -> config -> monitor -> except HealthFault) without paying a train
+    compile."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "run"
+    driver = tmp_path / "driver.py"
+    driver.write_text(
+        "import runpy, sys\n"
+        "from cyclegan_tpu.train import loop\n"
+        "def poisoned(config, data, plan, step_fn, state, summary, epoch,"
+        " **kw):\n"
+        "    kw['health'].observe({'loss_G/total': float('nan')})\n"
+        "    return state\n"
+        "loop.train_epoch = poisoned\n"
+        f"sys.argv = ['main.py', '--output_dir', {str(out)!r},\n"
+        "            '--epochs', '1', '--batch_size', '2', '--verbose', '0',\n"
+        "            '--data_source', 'synthetic', '--image_size', '32',\n"
+        "            '--filters', '8', '--residual_blocks', '1',\n"
+        "            '--synthetic_train_size', '4',\n"
+        "            '--synthetic_test_size', '2', '--on_nan', 'halt']\n"
+        "runpy.run_path('main.py', run_name='__main__')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, str(driver)], cwd=repo, env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 3, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "HEALTH FAULT (nonfinite)" in r.stdout
+    assert "last-good checkpoint intact" in r.stdout
+    evs = [json.loads(l)
+           for l in open(out / "telemetry.jsonl") if l.strip()]
+    assert any(e["event"] == "health_fault" and e["policy"] == "halt"
+               for e in evs)
+    assert evs[-1]["event"] == "end"
+    assert evs[-1]["status"] == "health_fault"
+
+
+# ------------------------------------------------- console summary
+
+
+def test_print_epoch_summary_health_line(capsys):
+    from cyclegan_tpu.train import loop
+
+    results = {"error/MAE(X, F(G(X)))": 0.25}
+    # health=None reproduces the historical output exactly.
+    loop.print_epoch_summary(results, elapse=1.0)
+    base = capsys.readouterr().out
+    assert "grad-norm" not in base
+
+    loop.print_epoch_summary(
+        results, elapse=1.0,
+        health={"gnorm_G": 1.25, "gnorm_F": 0.5, "gnorm_dX": 0.25,
+                "gnorm_dY": 0.125, "dX_real_mean": 0.61,
+                "dX_fake_mean": 0.39, "dY_real_mean": 0.55,
+                "dY_fake_mean": 0.45},
+    )
+    out = capsys.readouterr().out
+    assert "grad-norm G/F/dX/dY: 1.25/0.5/0.25/0.125" in out
+    assert "D(real)/D(fake) X: 0.61/0.39" in out
+    assert "Y: 0.55/0.45" in out
+
+    # Missing keys print as nan instead of raising (empty epoch).
+    loop.print_epoch_summary(results, elapse=1.0, health={})
+    assert "nan/nan/nan/nan" in capsys.readouterr().out

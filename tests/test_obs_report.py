@@ -172,6 +172,45 @@ def test_bench_stream_sections(tmp_path):
     assert "mfu 0.4100" in text
 
 
+def test_health_sections_fold_and_render():
+    """The flight-recorder fixture (tests/data/run_fail.jsonl, also the
+    run_compare FAIL fixture) carries health + health_fault events: the
+    report folds them into grad-norm percentiles, D-balance, final
+    losses, and an anomaly census."""
+    path = os.path.join(REPO, "tests", "data", "run_fail.jsonl")
+    events, skipped = load_events(path)
+    assert skipped == 0
+    rep = fold(events, skipped)
+
+    assert len(rep["health"]) == 3
+    hr = rep["health_rollup"]
+    assert hr["n_epochs"] == 3
+    # max over the per-epoch max envelopes; p50 over the per-epoch means.
+    assert hr["gnorm_percentiles"]["G"]["max"] == pytest.approx(80.0)
+    assert hr["gnorm_percentiles"]["G"]["p50"] == pytest.approx(9.0)
+    assert hr["anomalies"] == {"d_collapse": 1, "divergence": 1}
+    assert hr["last_loss"]["loss_G/total"] == pytest.approx(12.4)
+
+    text = render(rep)
+    assert "model health (3 epoch rollups)" in text
+    assert "grad-norm G:" in text
+    assert "D-balance dX (last epoch): D(real) 0.990" in text
+    assert "anomalies: d_collapse=1, divergence=1" in text
+    assert "health faults: 2" in text
+    assert "divergence [warn]" in text
+
+
+def test_healthless_stream_renders_without_health_section(tmp_path):
+    """Streams that predate the health layer keep rendering unchanged
+    (consumers ignore unknown events, and absent ones too)."""
+    path = str(tmp_path / "t.jsonl")
+    _write_stream(path, _synthetic_events())
+    events, skipped = load_events(path)
+    text = render(fold(events, skipped))
+    assert "model health" not in text
+    assert "health faults" not in text
+
+
 def test_percentile_nearest_rank():
     assert _percentile([], 0.5) != _percentile([], 0.5)  # nan
     assert _percentile([3.0], 0.99) == 3.0

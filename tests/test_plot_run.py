@@ -9,7 +9,12 @@ sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
 ))
 
-from plot_run import plot, read_scalars  # noqa: E402
+from plot_run import (  # noqa: E402
+    plot,
+    plot_health,
+    read_health_events,
+    read_scalars,
+)
 
 
 @pytest.fixture
@@ -56,6 +61,31 @@ def test_plot_renders_matching_tags(run_dir, tmp_path):
 def test_plot_unmatched_tags_fail_loudly(run_dir, tmp_path):
     with pytest.raises(SystemExit):
         plot(read_scalars(run_dir), ["nope/.*"], str(tmp_path / "x.png"))
+
+
+def test_plot_health_renders_losses_envelopes_and_faults(tmp_path):
+    """--jsonl mode: the committed flight-recorder fixture renders loss
+    trajectories + per-network grad-norm envelopes, with its two
+    health_fault events as markers."""
+    fixture = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "data", "run_fail.jsonl")
+    health, faults = read_health_events(fixture)
+    assert len(health) == 3 and len(faults) == 2
+    assert {e["kind"] for e in faults} == {"divergence", "d_collapse"}
+    out = str(tmp_path / "health.png")
+    n = plot_health(health, faults, out, title="fixture")
+    # 4 loss terms + 4 network envelopes.
+    assert n == 8
+    assert os.path.getsize(out) > 1000
+
+
+def test_plot_health_empty_stream_fails_loudly(tmp_path):
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text('{"event": "manifest"}\nnot json\n')
+    health, faults = read_health_events(str(empty))
+    assert health == [] and faults == []
+    with pytest.raises(SystemExit):
+        plot_health(health, faults, str(tmp_path / "x.png"))
 
 
 def test_pad_ab_report_runs_and_compares(run_dir, tmp_path, capsys,

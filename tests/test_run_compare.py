@@ -1,0 +1,170 @@
+"""tools/run_compare.py: the cross-run regression gate.
+
+Two kinds of pins: (1) the committed fixture streams under tests/data/
+(run_base / run_pass / run_fail) gate deterministically — a healthy
+candidate exits 0, a regressed one trips every stream axis and exits
+1; (2) the repo's own committed BENCH_r*.json series must pass its own
+gate (including the legal cpu->tpu platform change, which SKIPs
+rather than fails).
+"""
+
+from __future__ import annotations
+
+import glob
+import io
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+))
+
+import run_compare  # noqa: E402
+from run_compare import (  # noqa: E402
+    FAIL,
+    PASS,
+    SKIP,
+    bench_profile,
+    compare_profiles,
+    load_profile,
+    make_thresholds,
+    stream_profile,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA = os.path.join(REPO, "tests", "data")
+BASE = os.path.join(DATA, "run_base.jsonl")
+GOOD = os.path.join(DATA, "run_pass.jsonl")
+BAD = os.path.join(DATA, "run_fail.jsonl")
+
+
+# ------------------------------------------------- profile extraction
+
+
+def test_stream_profile_from_fixture():
+    p = load_profile(BASE)
+    assert p["kind"] == "stream"
+    assert p["n_epochs"] == 3
+    assert p["throughput"] == pytest.approx(100.0)
+    assert p["final_losses"]["loss_G/total"] == pytest.approx(2.8)
+    assert p["gnorm_max"]["G"] == pytest.approx(2.0)  # max over epochs
+    assert p["n_faults"] == 0 and p["end_status"] == "completed"
+
+
+def test_stream_profile_counts_faults_and_skips_garbage():
+    events = [
+        {"event": "health_fault", "kind": "divergence"},
+        {"event": "health_fault", "kind": "divergence"},
+        {"event": "health_fault", "kind": "nonfinite"},
+        {"event": "stall"},
+        {"event": "loop_stall"},
+        {"event": "mystery_future_kind"},  # unknown events ignored
+    ]
+    p = stream_profile(events, skipped=2)
+    assert p["faults"] == {"divergence": 2, "nonfinite": 1}
+    assert p["n_faults"] == 3 and p["n_stalls"] == 2
+    assert p["skipped_lines"] == 2
+    assert p["throughput"] is None  # no epoch events
+
+
+def test_bench_profile_wrapped_and_bare():
+    parsed = {"metric": "images_per_sec", "value": 95.17, "platform": "tpu",
+              "config": "scan/bfloat16/b16", "unit": "images/sec",
+              "all": {"a": 1.0, "b": "garbage", "c": None}}
+    for record in (parsed, {"parsed": parsed, "rc": 0}):
+        p = bench_profile(record)
+        assert p["kind"] == "bench" and p["value"] == pytest.approx(95.17)
+        assert p["all"] == {"a": 1.0}  # non-floats profiled out
+
+
+def test_nan_profiles_as_missing():
+    assert run_compare._float(float("nan")) is None
+    assert run_compare._float("1.5") == 1.5
+    assert run_compare._float(None) is None
+
+
+# ------------------------------------------------- the gate
+
+
+def test_fixture_pair_passes():
+    assert run_compare.run([BASE, GOOD], make_thresholds(),
+                           out=io.StringIO()) == 0
+
+
+def test_fixture_pair_fails_on_every_stream_axis():
+    checks = compare_profiles(load_profile(BASE), load_profile(BAD),
+                              make_thresholds())
+    failed_axes = {axis for s, axis, _ in checks if s == FAIL}
+    assert "throughput" in failed_axes            # 100 -> ~59 img/s
+    assert "loss loss_G/total" in failed_axes     # 2.8 -> 12.4
+    assert "gnorm G" in failed_axes               # 2.0 -> 80 max envelope
+    assert "anomalies" in failed_axes             # 0 -> 2 faults
+    # The healthy networks still pass: the gate localizes the blowup.
+    assert (PASS, "gnorm F") in [(s, a) for s, a, _ in checks]
+    assert run_compare.run([BASE, BAD], make_thresholds(),
+                           out=io.StringIO()) == 1
+
+
+def test_thresholds_are_adjustable():
+    th = make_thresholds(max_throughput_drop=0.9, max_loss_increase=10.0,
+                         max_gnorm_ratio=100.0, max_new_faults=5)
+    assert run_compare.run([BASE, BAD], th, out=io.StringIO()) == 0
+
+
+def test_mixed_artifact_kinds_fail():
+    bench = os.path.join(REPO, "BENCH_r01.json")
+    checks = compare_profiles(load_profile(bench), load_profile(BASE),
+                              make_thresholds())
+    assert checks[0][0] == FAIL and checks[0][1] == "kind"
+
+
+# ------------------------------------------------- committed BENCH series
+
+
+def _bench_series():
+    return sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+
+
+def test_committed_bench_series_passes_gate():
+    """The repo's own committed rounds are the gate's first real user:
+    the full consecutive-pair series must exit 0 today, so a future
+    round that regresses >10% makes THIS test point at the pair."""
+    series = _bench_series()
+    assert len(series) >= 2
+    assert run_compare.run(series, make_thresholds(), out=io.StringIO()) == 0
+
+
+def test_cross_platform_bench_pair_skips():
+    """r01..r04 are cpu seed rounds, r05 the first tpu round: a platform
+    change is SKIP (perf not comparable), never FAIL."""
+    profiles = [load_profile(p) for p in _bench_series()]
+    platforms = [p["platform"] for p in profiles]
+    for base, cand in zip(profiles, profiles[1:]):
+        checks = compare_profiles(base, cand, make_thresholds())
+        if base["platform"] != cand["platform"]:
+            assert [s for s, _, _ in checks] == [SKIP]
+    # The committed series actually exercises the skip path.
+    assert len(set(platforms)) > 1
+
+
+def test_output_is_deterministic():
+    def render():
+        buf = io.StringIO()
+        run_compare.run([BASE, GOOD, BAD], make_thresholds(json=True),
+                        out=buf)
+        return buf.getvalue()
+
+    first = render()
+    assert first == render()
+    parsed = json.loads(first)
+    assert [p["cand"] for p in parsed] == ["run_pass.jsonl", "run_fail.jsonl"]
+
+
+def test_cli_exit_codes(capsys):
+    assert run_compare.main([BASE, GOOD]) == 0
+    assert run_compare.main([BASE, BAD]) == 1
+    assert run_compare.main(["/nonexistent.jsonl", BASE]) == 2
+    capsys.readouterr()

@@ -140,13 +140,18 @@ def test_train_step_updates_all_four_trees(setup):
 
 def test_train_step_metric_keys_match_reference(setup):
     cfg, state, x, y, w = setup
-    train_step = jax.jit(make_train_step(cfg, x.shape[0]))
-    _, metrics = train_step(state, x, y, w)
-    assert set(metrics) == {
+    reference = {
         "loss_G/loss", "loss_G/cycle", "loss_G/identity", "loss_G/total",
         "loss_F/loss", "loss_F/cycle", "loss_F/identity", "loss_F/total",
         "loss_X/loss", "loss_Y/loss",
     }
+    train_step = jax.jit(make_train_step(cfg, x.shape[0]))
+    _, metrics = train_step(state, x, y, w)
+    # The reference set survives verbatim; the health layer (on by
+    # default, obs/health.py) adds only namespaced health/* keys on top
+    # (exact-set pin: tests/test_health.py).
+    assert reference <= set(metrics)
+    assert all(k in reference or k.startswith("health/") for k in metrics)
 
 
 def test_test_step_metrics(setup):

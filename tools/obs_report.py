@@ -64,6 +64,8 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
         "submit_ready": {},  # split -> per-dispatch submit->ready latency
         "host_work": {},     # split -> per-dispatch host-side loop work
         "memory": [],        # memory events
+        "health": [],        # per-epoch model-health rollups
+        "health_faults": [], # anomaly detections (nonfinite/divergence/...)
         "stalls": [],
         "loop_stalls": [],   # per-dispatch outliers (StepClock attribution)
         "services": [],      # epoch-services jobs (async ckpt/plots/FID)
@@ -97,6 +99,10 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
                     float(ev["host_work_s"]))
         elif kind == "memory":
             report["memory"].append(ev)
+        elif kind == "health":
+            report["health"].append(ev)
+        elif kind == "health_fault":
+            report["health_faults"].append(ev)
         elif kind == "stall":
             report["stalls"].append(ev)
         elif kind == "loop_stall":
@@ -141,6 +147,43 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             if peak >= cur.get("peak_bytes_in_use", cur.get("bytes_in_use", 0)):
                 cur.update(row)
     report["memory_peaks"] = peaks
+
+    # Model-health rollup: per-network grad-norm percentiles over the
+    # per-epoch mean envelopes (plus the run max), latest D-balance, and
+    # the anomaly census — the "is the model still healthy" summary next
+    # to the throughput sections.
+    if report["health"]:
+        gnorm_pct: Dict[str, dict] = {}
+        nets = sorted({
+            net for ev in report["health"] for net in (ev.get("gnorm") or {})
+        })
+        for net in nets:
+            means = [float(ev["gnorm"][net]["mean"]) for ev in report["health"]
+                     if net in (ev.get("gnorm") or {})
+                     and "mean" in ev["gnorm"][net]]
+            maxes = [float(ev["gnorm"][net]["max"]) for ev in report["health"]
+                     if net in (ev.get("gnorm") or {})
+                     and "max" in ev["gnorm"][net]]
+            if means:
+                gnorm_pct[net] = {
+                    "p50": _percentile(means, .5),
+                    "p90": _percentile(means, .9),
+                    "max": max(maxes) if maxes else float("nan"),
+                }
+        anomalies: Dict[str, int] = {}
+        for ev in report["health_faults"]:
+            kind = str(ev.get("kind", "?"))
+            anomalies[kind] = anomalies.get(kind, 0) + 1
+        report["health_rollup"] = {
+            "n_epochs": len(report["health"]),
+            "gnorm_percentiles": gnorm_pct,
+            "last_disc": report["health"][-1].get("disc") or {},
+            "last_loss": report["health"][-1].get("loss") or {},
+            "nonfinite_rows": sum(
+                int(ev.get("nonfinite_rows", 0)) for ev in report["health"]
+            ),
+            "anomalies": anomalies,
+        }
 
     # Serving rollup: trigger mix + fill factor quantify whether the
     # micro-batcher is running throughput-bound (full flushes) or
@@ -295,6 +338,43 @@ def render(report: dict) -> str:
                     if limit and peak is not None else "")
             w(f"device {did} ({row.get('kind', '?')}): "
               f"peak {_fmt_bytes(peak)} of {_fmt_bytes(limit)}{head}")
+
+    hr = report.get("health_rollup")
+    if hr:
+        w(f"-- model health ({hr['n_epochs']} epoch rollups) --")
+        for net, pct in sorted(hr["gnorm_percentiles"].items()):
+            w(f"grad-norm {net}: p50 {_fmt(pct['p50'], '.4g')}, "
+              f"p90 {_fmt(pct['p90'], '.4g')}, max {_fmt(pct['max'], '.4g')}")
+        for side, stats in sorted(hr["last_disc"].items()):
+            w(f"D-balance {side} (last epoch): "
+              f"D(real) {_fmt(stats.get('real_mean'), '.3f')}"
+              f"±{_fmt(stats.get('real_std'), '.3f')}, "
+              f"D(fake) {_fmt(stats.get('fake_mean'), '.3f')}"
+              f"±{_fmt(stats.get('fake_std'), '.3f')}")
+        if hr["last_loss"]:
+            w("final losses: " + ", ".join(
+                f"{k}={_fmt(v, '.4f')}"
+                for k, v in sorted(hr["last_loss"].items())))
+        if hr["nonfinite_rows"]:
+            w(f"NON-FINITE rows: {hr['nonfinite_rows']}")
+        if hr["anomalies"]:
+            w("anomalies: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(hr["anomalies"].items())))
+        else:
+            w("anomalies: none")
+    if report["health_faults"]:
+        w(f"-- health faults: {len(report['health_faults'])} --")
+        for ev in report["health_faults"][:10]:
+            detail = {
+                k: v for k, v in ev.items()
+                if k not in ("event", "t", "kind", "epoch", "row", "policy",
+                             "schema")
+            }
+            w(f"e{ev.get('epoch', '?')} row {ev.get('row', '?')}: "
+              f"{ev.get('kind', '?')} [{ev.get('policy', '?')}]"
+              + (f" {detail}" if detail else ""))
+        if len(report["health_faults"]) > 10:
+            w(f"... {len(report['health_faults']) - 10} more")
 
     if report["stalls"]:
         w(f"-- stalls: {len(report['stalls'])} --")

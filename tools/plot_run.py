@@ -1,19 +1,31 @@
-"""Plot scalar curves from a training run's TensorBoard event files.
+"""Plot scalar curves from a training run's TensorBoard event files,
+or model-health curves from its telemetry JSONL stream.
 
-Offline matplotlib rendering of any logged scalar (loss_*, error/*,
-fid/*, perf/*) straight from `<output_dir>`'s event files — no
-TensorBoard server needed. Used to produce the committed FID-vs-epoch
-curves in docs/images/.
+Offline matplotlib rendering (Agg backend, same off-main-thread
+discipline as the epoch-services plot jobs) of any logged scalar
+(loss_*, error/*, fid/*, perf/*) straight from `<output_dir>`'s event
+files — no TensorBoard server needed. Used to produce the committed
+FID-vs-epoch curves in docs/images/.
+
+With `--jsonl` the input is the obs telemetry stream instead: the
+per-epoch `health` events (obs/health.py) become a two-panel figure —
+loss-term trajectories on top, per-network grad-norm envelopes
+(min..max band around the mean) below, with `health_fault` epochs
+marked as vertical lines. This is the flight-recorder view: a diverging
+loss, a grad-norm blowup, and the anomaly that flagged it on one page.
 
 Usage:
   python tools/plot_run.py --run /tmp/toyrun --tags "fid/.*" \
       --out docs/images/toy_fid_curve.png --title "FID vs epoch"
+  python tools/plot_run.py --jsonl /tmp/toyrun/telemetry.jsonl \
+      --out /tmp/health.png --title "model health"
 """
 
 from __future__ import annotations
 
 import argparse
 import glob
+import json
 import os
 import re
 import struct
@@ -88,13 +100,119 @@ def plot(series: dict, tags: list, out: str, title: str = "",
     return chosen
 
 
+def read_health_events(jsonl_path: str) -> tuple:
+    """(health_events, fault_events) from a telemetry stream, in order.
+    Malformed lines are skipped (truncated tails are legal)."""
+    health, faults = [], []
+    with open(jsonl_path, "r", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(ev, dict):
+                continue
+            if ev.get("event") == "health":
+                health.append(ev)
+            elif ev.get("event") == "health_fault":
+                faults.append(ev)
+    return health, faults
+
+
+def plot_health(health: list, faults: list, out: str, title: str = "",
+                logy: bool = False) -> int:
+    """Two-panel health figure: loss trajectories + grad-norm envelopes
+    with anomaly markers. Returns the number of series drawn."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    if not health:
+        raise SystemExit(
+            "no `health` events in the stream (run with the health layer "
+            "enabled — it is on by default; --no_health disables it)"
+        )
+    epochs = [ev.get("epoch", i) for i, ev in enumerate(health)]
+    fig, (ax_loss, ax_gnorm) = plt.subplots(
+        2, 1, figsize=(7, 6), sharex=True
+    )
+    n_series = 0
+
+    loss_keys = sorted({k for ev in health for k in (ev.get("loss") or {})})
+    for key in loss_keys:
+        ys = [(ev.get("loss") or {}).get(key) for ev in health]
+        ax_loss.plot(epochs, ys, label=key, linewidth=1.5)
+        n_series += 1
+    ax_loss.set_ylabel("loss (epoch mean)")
+    ax_loss.legend(fontsize=7)
+    ax_loss.grid(alpha=0.3)
+
+    nets = sorted({net for ev in health for net in (ev.get("gnorm") or {})})
+    for net in nets:
+        means = [(ev.get("gnorm") or {}).get(net, {}).get("mean")
+                 for ev in health]
+        lows = [(ev.get("gnorm") or {}).get(net, {}).get("min")
+                for ev in health]
+        highs = [(ev.get("gnorm") or {}).get(net, {}).get("max")
+                 for ev in health]
+        (line,) = ax_gnorm.plot(epochs, means, label=f"gnorm {net}",
+                                linewidth=1.5)
+        if all(v is not None for v in lows + highs):
+            ax_gnorm.fill_between(epochs, lows, highs, alpha=0.15,
+                                  color=line.get_color())
+        n_series += 1
+    ax_gnorm.set_ylabel("grad norm (min..max)")
+    ax_gnorm.set_xlabel("epoch")
+    if logy:
+        ax_loss.set_yscale("log")
+        ax_gnorm.set_yscale("log")
+    ax_gnorm.legend(fontsize=7)
+    ax_gnorm.grid(alpha=0.3)
+
+    # Anomaly markers: one vertical line per faulting epoch, labeled by
+    # kind once (legend dedup).
+    seen_kinds = set()
+    for ev in faults:
+        kind = str(ev.get("kind", "fault"))
+        label = kind if kind not in seen_kinds else None
+        seen_kinds.add(kind)
+        for ax in (ax_loss, ax_gnorm):
+            ax.axvline(ev.get("epoch", 0), color="red", alpha=0.5,
+                       linestyle="--", linewidth=1.0,
+                       label=label if ax is ax_loss else None)
+    if seen_kinds:
+        ax_loss.legend(fontsize=7)
+
+    if title:
+        ax_loss.set_title(title)
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    fig.savefig(out, dpi=120)
+    print(f"plotted {n_series} health series "
+          f"({len(faults)} fault markers) -> {out}")
+    return n_series
+
+
 if __name__ == "__main__":
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--run", required=True, help="training output dir")
-    p.add_argument("--tags", nargs="+", required=True,
-                   help="regex(es) matched against full scalar tags")
+    p.add_argument("--run", help="training output dir (TensorBoard mode)")
+    p.add_argument("--tags", nargs="+",
+                   help="regex(es) matched against full scalar tags "
+                        "(TensorBoard mode)")
+    p.add_argument("--jsonl", help="telemetry stream: plot `health` "
+                                   "events instead of TB scalars")
     p.add_argument("--out", required=True, help="destination PNG")
     p.add_argument("--title", default="")
     p.add_argument("--logy", action="store_true")
     a = p.parse_args()
-    plot(read_scalars(a.run), a.tags, a.out, a.title, a.logy)
+    if a.jsonl:
+        health, faults = read_health_events(a.jsonl)
+        plot_health(health, faults, a.out, a.title, a.logy)
+    elif a.run and a.tags:
+        plot(read_scalars(a.run), a.tags, a.out, a.title, a.logy)
+    else:
+        p.error("need either --jsonl or both --run and --tags")

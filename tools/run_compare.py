@@ -1,0 +1,358 @@
+"""Cross-run regression gate: diff two obs JSONL streams (or two
+committed BENCH_r*.json records) and exit nonzero past thresholds.
+
+    python tools/run_compare.py BASE.jsonl CAND.jsonl
+    python tools/run_compare.py BENCH_r04.json BENCH_r05.json
+    python tools/run_compare.py BENCH_r01.json ... BENCH_r05.json  # series
+
+The repo already commits the artifacts a regression check needs — every
+training/bench run can write a telemetry JSONL stream (cyclegan_tpu/obs)
+and each bench round lands a BENCH_r*.json — but until now nothing
+compared one run against another: a 20% throughput regression or a
+newly-NaN'ing config would ship silently. This tool is the missing
+guard, built to the same rules as tools/obs_report.py: pure stdlib (it
+must run on any box the artifacts land on), unknown events ignored,
+malformed lines skipped, deterministic output (sorted keys, fixed
+formatting) so two invocations on the same inputs byte-match.
+
+Axes for a stream pair (each gated by its own threshold flag):
+  throughput   mean train images/sec over `epoch` events
+  losses       final-epoch loss means from the last `health` event
+  grad norms   per-network max-envelope over `health` events
+  anomalies    `health_fault` count (plus watchdog/loop stalls, reported
+               but not gated — they attribute speed, not health)
+
+For bench records the axis is per-config images/sec from the `all`
+sweep dict (intersection of configs) plus the headline value.
+Cross-platform pairs (cpu seed rounds vs the first TPU round) are
+SKIPPED, not failed: the committed series legally changes platform.
+
+With 3+ files the tool runs the consecutive-pair gate over the whole
+series (this is how bench.py's end-of-run hook uses it: newest
+committed round vs the record just produced).
+
+Exit codes: 0 all gates pass, 1 any gate failed, 2 usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+PASS, FAIL, SKIP, INFO = "PASS", "FAIL", "SKIP", "INFO"
+
+
+# ---------------------------------------------------------------------------
+# Profile extraction
+# ---------------------------------------------------------------------------
+
+
+def load_profile(path: str) -> dict:
+    """Read one artifact into a comparable profile. Bench records are a
+    single JSON object (with `parsed`/`metric`); anything else is
+    treated as a telemetry JSONL stream."""
+    with open(path, "r", errors="replace") as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = None
+    if isinstance(obj, dict) and ("parsed" in obj or "metric" in obj):
+        return bench_profile(obj, name=os.path.basename(path))
+    events = []
+    skipped = 0
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            skipped += 1
+            continue
+        if isinstance(rec, dict) and "event" in rec:
+            events.append(rec)
+        else:
+            skipped += 1
+    return stream_profile(events, skipped, name=os.path.basename(path))
+
+
+def bench_profile(record: dict, name: str = "?") -> dict:
+    """Profile of one bench.py summary record (BENCH_r*.json wraps the
+    emitted line under `parsed`; a bare emitted line works too)."""
+    parsed = record.get("parsed") if isinstance(record.get("parsed"), dict) \
+        else record
+    return {
+        "kind": "bench",
+        "name": name,
+        "platform": parsed.get("platform"),
+        "value": _float(parsed.get("value")),
+        "config": parsed.get("config"),
+        "unit": parsed.get("unit"),
+        "all": {
+            str(k): fv
+            for k, v in (parsed.get("all") or {}).items()
+            if (fv := _float(v)) is not None
+        },
+    }
+
+
+def stream_profile(events: List[dict], skipped: int = 0, name: str = "?") -> dict:
+    """Profile of one telemetry JSONL stream."""
+    epochs = [e for e in events if e.get("event") == "epoch"]
+    healths = [e for e in events if e.get("event") == "health"]
+    faults = [e for e in events if e.get("event") == "health_fault"]
+    stalls = sum(1 for e in events
+                 if e.get("event") in ("stall", "loop_stall"))
+    ips = [
+        v for e in epochs
+        if (v := _float(e.get("train_images_per_sec",
+                              e.get("images_per_sec")))) is not None
+    ]
+    gnorm_max: Dict[str, float] = {}
+    for ev in healths:
+        for net, env in sorted((ev.get("gnorm") or {}).items()):
+            v = _float((env or {}).get("max"))
+            if v is not None:
+                gnorm_max[net] = max(gnorm_max.get(net, v), v)
+    final_losses: Dict[str, float] = {}
+    if healths:
+        final_losses = {
+            str(k): fv
+            for k, v in (healths[-1].get("loss") or {}).items()
+            if (fv := _float(v)) is not None
+        }
+    fault_kinds: Dict[str, int] = {}
+    for ev in faults:
+        kind = str(ev.get("kind", "?"))
+        fault_kinds[kind] = fault_kinds.get(kind, 0) + 1
+    end = next((e for e in events if e.get("event") == "end"), None)
+    return {
+        "kind": "stream",
+        "name": name,
+        "n_events": len(events),
+        "skipped_lines": skipped,
+        "n_epochs": len(epochs),
+        "throughput": (sum(ips) / len(ips)) if ips else None,
+        "final_losses": final_losses,
+        "gnorm_max": gnorm_max,
+        "faults": fault_kinds,
+        "n_faults": sum(fault_kinds.values()),
+        "n_stalls": stalls,
+        "end_status": end.get("status") if end else None,
+    }
+
+
+def _float(v) -> Optional[float]:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f if f == f else None  # NaN profiles as missing
+
+
+# ---------------------------------------------------------------------------
+# Comparison
+# ---------------------------------------------------------------------------
+
+Check = Tuple[str, str, str]  # (status, axis, detail)
+
+
+def compare_profiles(base: dict, cand: dict, th: argparse.Namespace) -> List[Check]:
+    if base["kind"] != cand["kind"]:
+        return [(FAIL, "kind",
+                 f"cannot compare a {base['kind']} artifact against a "
+                 f"{cand['kind']} artifact")]
+    if base["kind"] == "bench":
+        return _compare_bench(base, cand, th)
+    return _compare_streams(base, cand, th)
+
+
+def _rel_drop(base: float, cand: float) -> float:
+    return (base - cand) / base if base > 0 else 0.0
+
+
+def _compare_bench(base: dict, cand: dict, th) -> List[Check]:
+    checks: List[Check] = []
+    if base.get("platform") != cand.get("platform"):
+        return [(SKIP, "platform",
+                 f"platform changed {base.get('platform')} -> "
+                 f"{cand.get('platform')}: perf not comparable")]
+    bv, cv = base.get("value"), cand.get("value")
+    if bv is not None and cv is not None:
+        drop = _rel_drop(bv, cv)
+        status = FAIL if drop > th.max_bench_drop else PASS
+        checks.append((status, "bench headline",
+                       f"{bv:.2f} -> {cv:.2f} {base.get('unit') or ''}".rstrip()
+                       + f" (drop {100 * drop:.1f}% vs limit "
+                         f"{100 * th.max_bench_drop:.1f}%)"))
+    common = sorted(set(base["all"]) & set(cand["all"]))
+    for key in common:
+        bv, cv = base["all"][key], cand["all"][key]
+        drop = _rel_drop(bv, cv)
+        status = FAIL if drop > th.max_bench_drop else PASS
+        checks.append((status, f"bench {key}",
+                       f"{bv:.2f} -> {cv:.2f} (drop {100 * drop:.1f}%)"))
+    only_base = sorted(set(base["all"]) - set(cand["all"]))
+    if only_base:
+        checks.append((INFO, "bench configs",
+                       f"{len(only_base)} config(s) not re-measured: "
+                       + ", ".join(only_base)))
+    if not checks:
+        checks.append((SKIP, "bench", "no comparable values in either record"))
+    return checks
+
+
+def _compare_streams(base: dict, cand: dict, th) -> List[Check]:
+    checks: List[Check] = []
+
+    bt, ct = base.get("throughput"), cand.get("throughput")
+    if bt is not None and ct is not None:
+        drop = _rel_drop(bt, ct)
+        status = FAIL if drop > th.max_throughput_drop else PASS
+        checks.append((status, "throughput",
+                       f"{bt:.2f} -> {ct:.2f} img/s (drop {100 * drop:.1f}% "
+                       f"vs limit {100 * th.max_throughput_drop:.1f}%)"))
+    else:
+        checks.append((SKIP, "throughput",
+                       "missing epoch throughput in one stream"))
+
+    common_losses = sorted(set(base["final_losses"]) & set(cand["final_losses"]))
+    for key in common_losses:
+        bv, cv = base["final_losses"][key], cand["final_losses"][key]
+        # Relative-with-floor slack: GAN losses legally sit near their
+        # LSGAN fixed points, so a pure ratio would flag noise on
+        # near-zero values.
+        limit = bv + th.max_loss_increase * max(abs(bv), 0.1)
+        status = FAIL if cv > limit else PASS
+        checks.append((status, f"loss {key}",
+                       f"final {bv:.4f} -> {cv:.4f} (limit {limit:.4f})"))
+    if not common_losses:
+        checks.append((SKIP, "losses",
+                       "no common health loss trajectories "
+                       "(stream predates the health layer?)"))
+
+    common_nets = sorted(set(base["gnorm_max"]) & set(cand["gnorm_max"]))
+    for net in common_nets:
+        bv, cv = base["gnorm_max"][net], cand["gnorm_max"][net]
+        limit = th.max_gnorm_ratio * max(bv, 1e-6)
+        status = FAIL if cv > limit else PASS
+        checks.append((status, f"gnorm {net}",
+                       f"max envelope {bv:.4g} -> {cv:.4g} "
+                       f"(limit {limit:.4g})"))
+    if not common_nets:
+        checks.append((SKIP, "gnorm", "no common grad-norm envelopes"))
+
+    new_faults = cand["n_faults"] - base["n_faults"]
+    status = FAIL if new_faults > th.max_new_faults else PASS
+    checks.append((status, "anomalies",
+                   f"health faults {base['n_faults']} -> {cand['n_faults']} "
+                   f"({_fmt_kinds(cand['faults'])}) vs allowed "
+                   f"+{th.max_new_faults}"))
+    checks.append((INFO, "stalls",
+                   f"watchdog/loop stalls {base['n_stalls']} -> "
+                   f"{cand['n_stalls']} (reported, not gated)"))
+    return checks
+
+
+def _fmt_kinds(kinds: Dict[str, int]) -> str:
+    if not kinds:
+        return "none"
+    return ", ".join(f"{k}={v}" for k, v in sorted(kinds.items()))
+
+
+# ---------------------------------------------------------------------------
+# Report
+# ---------------------------------------------------------------------------
+
+
+def render_pair(base: dict, cand: dict, checks: List[Check]) -> str:
+    lines = [f"== run_compare: {base['name']} -> {cand['name']} "
+             f"[{base['kind']}] =="]
+    for status, axis, detail in checks:
+        lines.append(f"[{status}] {axis}: {detail}")
+    n_fail = sum(1 for s, _, _ in checks if s == FAIL)
+    lines.append(f"result: {'FAIL' if n_fail else 'PASS'} "
+                 f"({n_fail} failed / {len(checks)} checks)")
+    return "\n".join(lines)
+
+
+def run(paths: List[str], th: argparse.Namespace, out=None) -> int:
+    out = out or sys.stdout
+    try:
+        profiles = [load_profile(p) for p in paths]
+    except OSError as e:
+        print(f"run_compare: cannot read input: {e}", file=sys.stderr)
+        return 2
+    failed = False
+    reports = []
+    for base, cand in zip(profiles, profiles[1:]):
+        checks = compare_profiles(base, cand, th)
+        failed = failed or any(s == FAIL for s, _, _ in checks)
+        reports.append((base, cand, checks))
+    if th.json:
+        print(json.dumps(
+            [{
+                "base": b["name"], "cand": c["name"], "kind": b["kind"],
+                "checks": [
+                    {"status": s, "axis": a, "detail": d} for s, a, d in ch
+                ],
+            } for b, c, ch in reports],
+            indent=2, sort_keys=True), file=out)
+    else:
+        print("\n\n".join(render_pair(b, c, ch) for b, c, ch in reports),
+              file=out)
+    return 1 if failed else 0
+
+
+def make_thresholds(
+    max_throughput_drop: float = 0.15,
+    max_loss_increase: float = 0.25,
+    max_gnorm_ratio: float = 5.0,
+    max_new_faults: int = 0,
+    max_bench_drop: float = 0.10,
+    json: bool = False,
+) -> argparse.Namespace:
+    """Programmatic threshold bundle (bench.py's end-of-run hook)."""
+    return argparse.Namespace(
+        max_throughput_drop=max_throughput_drop,
+        max_loss_increase=max_loss_increase,
+        max_gnorm_ratio=max_gnorm_ratio,
+        max_new_faults=max_new_faults,
+        max_bench_drop=max_bench_drop,
+        json=json,
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("runs", nargs="+",
+                        help="2+ artifacts: telemetry JSONL streams or "
+                             "BENCH_r*.json records; 3+ gates every "
+                             "consecutive pair (series mode)")
+    parser.add_argument("--max_throughput_drop", default=0.15, type=float,
+                        help="max relative drop in mean train img/s")
+    parser.add_argument("--max_loss_increase", default=0.25, type=float,
+                        help="max relative increase of each final loss "
+                             "mean (with a 0.1 absolute floor on the base)")
+    parser.add_argument("--max_gnorm_ratio", default=5.0, type=float,
+                        help="max candidate/base ratio of each network's "
+                             "grad-norm max envelope")
+    parser.add_argument("--max_new_faults", default=0, type=int,
+                        help="max new health_fault events vs base")
+    parser.add_argument("--max_bench_drop", default=0.10, type=float,
+                        help="max relative drop of bench images/sec "
+                             "(headline and per-config)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report")
+    args = parser.parse_args(argv)
+    if len(args.runs) < 2:
+        parser.error("need at least two artifacts to compare")
+    return run(args.runs, args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
