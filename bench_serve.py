@@ -22,6 +22,13 @@ Methodology notes:
 - p95 at LOW offered load should sit near one bucket's compute time +
   the micro-batcher max-wait budget (acceptance bound; the low-load
   row's p95 is emitted as `latency_low_load_ms.p95`).
+- The fleet tier (serve/fleet/) is measured on top: saturated
+  throughput through 2 replicas + the admission-controlled EDF queue
+  (must hold the single-replica record), the int8 quantized-tier row
+  (throughput + max output delta vs the base tier), and one mixed-class
+  overload point at ~1.8x capacity against a tight admission queue —
+  the shed counts must land on `best_effort`/`batch` while
+  `interactive` p95 stays near its bound (class-ordered shedding).
 
 Prints ONE JSON line to stdout (the bench.py contract); per-config
 detail goes to stderr. Emits the same JSONL obs schema as training
@@ -219,6 +226,97 @@ def bench_engine_open_loop(executor, images, rate: float) -> dict:
     }
 
 
+def bench_fleet_saturated(fleet, images, klass: str = "batch",
+                          tier=None) -> dict:
+    """Closed-loop saturation through the fleet: same discipline as
+    bench_engine_saturated, but requests carry a deadline class and may
+    route to a program tier (tier="int8" measures the quantized tier)."""
+    lats = []
+    done = []
+    t0 = time.perf_counter()
+    for im in images:
+        fut = fleet.submit_raw(im, klass=klass, tier=tier)
+        done.append((fut, time.perf_counter()))
+    for fut, t_sub in done:
+        res = fut.result(timeout=600)
+        _encode(res["fake"])
+        lats.append(time.perf_counter() - t_sub)
+    wall = time.perf_counter() - t0
+    return {
+        "images_per_sec": len(images) / wall,
+        "p50_ms": _percentile(lats, 0.5) * 1e3,
+        "p95_ms": _percentile(lats, 0.95) * 1e3,
+        "p99_ms": _percentile(lats, 0.99) * 1e3,
+    }
+
+
+# Offered-load class mix for the overload sweep: mostly background work
+# with an interactive stream riding on top — the mix admission control
+# exists to protect.
+_MIX = ("interactive", "batch", "best_effort")
+
+
+def bench_fleet_overload(fleet, images, rate: float) -> dict:
+    """Open-loop mixed-class offered load through the fleet. Unlike the
+    single-replica sweep, overload here does NOT blow up latency — it
+    sheds: rejected submissions and evicted/expired futures are counted
+    per class, completed requests report per-class latency. The
+    acceptance shape: past saturation `best_effort` sheds (429s) while
+    `interactive` p95 holds near its compute + max-wait bound."""
+    lock = threading.Lock()
+    lat_by_class = {}
+    shed_by_class = {}
+    threads = []
+
+    def consume(fut, t_sub, klass):
+        from cyclegan_tpu.serve.fleet import DeadlineExceeded, ShedError
+
+        try:
+            res = fut.result(timeout=600)
+        except (ShedError, DeadlineExceeded):
+            with lock:
+                shed_by_class[klass] = shed_by_class.get(klass, 0) + 1
+            return
+        _encode(res["fake"])
+        with lock:
+            lat_by_class.setdefault(klass, []).append(
+                time.perf_counter() - t_sub)
+
+    from cyclegan_tpu.serve.fleet import ShedError
+
+    t0 = time.perf_counter()
+    for i, im in enumerate(images):
+        target = t0 + i / rate
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        klass = _MIX[i % len(_MIX)]
+        t_sub = time.perf_counter()
+        try:
+            fut = fleet.submit_raw(im, klass=klass)
+        except ShedError:
+            with lock:
+                shed_by_class[klass] = shed_by_class.get(klass, 0) + 1
+            continue
+        th = threading.Thread(target=consume, args=(fut, t_sub, klass),
+                              daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=600)
+    wall = time.perf_counter() - t0
+    n_done = sum(len(v) for v in lat_by_class.values())
+    row = {
+        "offered_rate": rate,
+        "achieved_images_per_sec": n_done / wall,
+        "shed_by_class": dict(sorted(shed_by_class.items())),
+    }
+    for klass, lats in sorted(lat_by_class.items()):
+        row[f"{klass}_p50_ms"] = _percentile(lats, 0.5) * 1e3
+        row[f"{klass}_p95_ms"] = _percentile(lats, 0.95) * 1e3
+    return row
+
+
 def _emit(line: dict) -> None:
     _obs_event("bench_serve_summary", **line)
     print(json.dumps(line), flush=True)
@@ -307,7 +405,7 @@ def main(argv=None) -> int:
         model_cfg, fwd_params, bwd_params=None,
         serve_cfg=ServeConfig(batch_buckets=tuple(sorted({1, args.batch})),
                               sizes=(args.image,), dtype=args.dtype,
-                              with_cycle=False))
+                              with_cycle=False, int8_tier=True))
     executor = PipelinedExecutor(engine, max_batch=args.batch,
                                  max_wait_ms=args.max_wait_ms,
                                  logger=_OBS_LOGGER)
@@ -351,6 +449,131 @@ def main(argv=None) -> int:
                        platform=platform)
 
     summary = executor.close()
+
+    # 4) fleet tier: 2 replicas behind the admission-controlled EDF
+    #    queue. Saturated throughput (must hold the single-replica
+    #    record — continuous batching should only add), the int8 tier
+    #    row, and one overload point demonstrating class-ordered
+    #    shedding.
+    fleet_line = None
+    int8_line = None
+    if time.perf_counter() - t_start <= TIME_BUDGET_S:
+        from cyclegan_tpu.serve.engine import preprocess_request
+        from cyclegan_tpu.serve.fleet import (
+            DeadlineClass,
+            FleetConfig,
+            FleetExecutor,
+        )
+
+        # Class budgets scale from the measured single-replica rate:
+        # production budgets assume chip compute, and a toy-CPU or
+        # full-geometry-CPU run would expire `batch` work while it is
+        # honestly queued. `interactive` stays tight (a few flushes of
+        # headroom — the class whose p95 the overload point judges);
+        # the measurement classes get enough budget to drain the whole
+        # closed-loop run.
+        per_img_s = 1.0 / max(sat["images_per_sec"], 1e-6)
+        bench_classes = (
+            DeadlineClass("interactive",
+                          deadline_ms=max(500.0,
+                                          per_img_s * args.batch * 8e3),
+                          shed_rank=0),
+            DeadlineClass("batch",
+                          deadline_ms=max(5e3, per_img_s * n * 40e3),
+                          shed_rank=1),
+            DeadlineClass("best_effort",
+                          deadline_ms=max(30e3, per_img_s * n * 80e3),
+                          shed_rank=2),
+        )
+        n_replicas = 2
+        # Ample capacity for the closed-loop measurements (admission
+        # control must not shed the measurement's own backlog); the
+        # overload point below gets its own deliberately tight queue.
+        fleet = FleetExecutor(
+            engine,
+            FleetConfig(n_replicas=n_replicas, capacity=max(4 * n, 64),
+                        max_batch=args.batch,
+                        max_wait_ms=args.max_wait_ms,
+                        classes=bench_classes),
+            logger=_OBS_LOGGER)
+        fsat = bench_fleet_saturated(fleet, images)
+        say(f"{key}: fleet x{n_replicas} saturated "
+            f"{fsat['images_per_sec']:.2f} images/sec "
+            f"(p95 {fsat['p95_ms']:.0f} ms)")
+        _obs_event("bench", key=key + "/fleet_saturated",
+                   images_per_sec=round(fsat["images_per_sec"], 4),
+                   platform=platform)
+
+        # int8 tier: throughput through the quantized programs + the
+        # output delta vs the base tier on one bucket (weight-only
+        # per-channel symmetric, f32 accumulate — the delta should be
+        # small but nonzero).
+        i8 = bench_fleet_saturated(fleet, images, tier="int8")
+        x_tol = np.stack([preprocess_request(im, args.image)
+                          for im in images[:args.batch]])
+        (base_out,), _ = engine.run(x_tol, size=args.image)
+        (q_out,), _ = engine.run(x_tol, size=args.image, tier="int8")
+        int8_diff = float(np.max(np.abs(
+            np.asarray(base_out, np.float32)
+            - np.asarray(q_out, np.float32))))
+        say(f"{key}: int8 tier {i8['images_per_sec']:.2f} images/sec, "
+            f"max |int8 - {args.dtype}| = {int8_diff:.4f}")
+        _obs_event("bench", key=key + "/fleet_int8",
+                   images_per_sec=round(i8["images_per_sec"], 4),
+                   platform=platform)
+        int8_line = {
+            "images_per_sec": round(i8["images_per_sec"], 2),
+            "p95_ms": round(i8["p95_ms"], 1),
+            # Unrounded on purpose: at bench's random-init weights the
+            # instance-norm trunk absorbs nearly all weight-rounding
+            # error, so the honest delta is ~1e-9 — tiny but NONZERO,
+            # which is itself the proof the quantized programs ran.
+            "max_abs_diff_vs_base": int8_diff,
+        }
+
+        fleet_summary = fleet.close()
+
+        # Overload: mixed classes offered at ~1.8x the fleet's measured
+        # capacity against a deliberately tight admission queue — the
+        # shed counts should land on best_effort (and batch), never
+        # interactive, while interactive p95 stays bounded.
+        overload = None
+        if not args.skip_sweep and \
+                time.perf_counter() - t_start <= TIME_BUDGET_S:
+            overload_fleet = FleetExecutor(
+                engine,
+                FleetConfig(n_replicas=n_replicas, capacity=8,
+                            max_batch=args.batch,
+                            max_wait_ms=args.max_wait_ms,
+                            classes=bench_classes),
+                logger=_OBS_LOGGER)
+            rate = max(fsat["images_per_sec"] * 1.8, 1.0)
+            overload = bench_fleet_overload(overload_fleet, images * 3,
+                                            rate)
+            overload_fleet.close()
+            say(f"{key}: overload {rate:.1f}/s -> shed "
+                f"{overload['shed_by_class']}, interactive p95 "
+                f"{overload.get('interactive_p95_ms', float('nan')):.0f} ms")
+        fleet_line = {
+            "n_replicas": n_replicas,
+            "images_per_sec": round(fsat["images_per_sec"], 2),
+            "latency_saturated_ms": {
+                k: round(fsat[k], 1)
+                for k in ("p50_ms", "p95_ms", "p99_ms")},
+            "speedup_vs_single_replica": round(
+                fsat["images_per_sec"]
+                / max(sat["images_per_sec"], 1e-9), 3),
+            "refill_flushes": fleet_summary.get("refill_flushes"),
+            "shed": fleet_summary.get("shed"),
+            "max_queue_depth": fleet_summary.get("max_queue_depth"),
+        }
+        if overload is not None:
+            fleet_line["overload"] = {
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in overload.items()}
+    else:
+        say(f"fleet tier skipped (budget {TIME_BUDGET_S:.0f}s)")
+
     line = {
         "metric": "cyclegan_serve_images_per_sec_1chip",
         "value": round(sat["images_per_sec"], 2),
@@ -366,6 +589,10 @@ def main(argv=None) -> int:
         "n_flushes": summary.get("n_flushes"),
         "max_queue_depth": summary.get("max_queue_depth"),
     }
+    if fleet_line is not None:
+        line["fleet"] = fleet_line
+    if int8_line is not None:
+        line["int8"] = int8_line
     if sweep:
         line["load_sweep"] = [
             {k: (round(v, 3) if isinstance(v, float) else v)

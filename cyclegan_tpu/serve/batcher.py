@@ -32,16 +32,19 @@ from typing import Callable, List, Optional
 class Request:
     """One queued inference request: the preprocessed image, its size
     bucket, the future the caller holds, and the enqueue timestamp the
-    latency accounting starts from."""
+    latency accounting starts from. ``tier`` tags the engine program
+    set the flush must run on ("base"/None or "int8") — flushes are
+    homogeneous in (size, tier)."""
 
-    __slots__ = ("image", "size", "future", "t_submit", "meta")
+    __slots__ = ("image", "size", "future", "t_submit", "meta", "tier")
 
-    def __init__(self, image, size: int, meta=None):
+    def __init__(self, image, size: int, meta=None, tier=None):
         self.image = image
         self.size = size
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         self.meta = meta
+        self.tier = tier
 
 
 _STOP = object()
@@ -124,11 +127,11 @@ class MicroBatcher:
             if item is _STOP:
                 self._do_flush(batch, "drain")
                 return None
-            if item.size != batch[0].size:
-                # Size-bucket boundary inside the window: flush what we
-                # have, push the stranger back for the next cycle (the
-                # executor routes per-size, so this is a rare cross-
-                # bucket race, not the steady state).
+            if (item.size, item.tier) != (batch[0].size, batch[0].tier):
+                # Size/tier-bucket boundary inside the window: flush
+                # what we have, push the stranger back for the next
+                # cycle (the executor routes per-(size, tier), so this
+                # is a rare cross-bucket race, not the steady state).
                 self._q.put(item)
                 break
             batch.append(item)

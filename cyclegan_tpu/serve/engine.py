@@ -22,6 +22,16 @@ cast back to float32 inside the program so both paths hand the encoder
 identical dtypes. tests/test_serve.py pins bf16 against f32 output
 tolerance.
 
+The optional **int8 tier** (``ServeConfig(int8_tier=True)``) adds a
+second program set over the same bucket grammar: generator conv kernels
+are quantized ONCE at startup to per-output-channel symmetric int8
+(weight-only — the GANAX-motivated cheap path), dequantized inside the
+program, and the forward accumulates in float32. The quantized tree is
+what lives in HBM, so the tier trades a bounded output error
+(tests/test_serve.py pins it against f32) for ~4x less weight traffic
+per flush. ``run(..., tier="int8")`` selects it per flush; the fleet
+layer maps deadline classes onto tiers.
+
 No host-device synchronization lives here: ``run`` returns DEVICE
 arrays; the pipelined executor (serve/executor.py) owns the deferred
 D2H fetch. tools/check_no_sync.py scans this directory.
@@ -65,7 +75,63 @@ def build_generator(model_cfg):
     )
 
 
-def forward_fn(model_cfg, with_cycle: bool):
+# -- int8 weight-only quantization (the cheap serving tier) ---------------
+
+def _is_quantized_leaf(x) -> bool:
+    return isinstance(x, dict) and "int8_q" in x
+
+
+def quantize_params_int8(params):
+    """Per-output-channel symmetric int8 quantization of every float
+    leaf with ndim >= 2 (conv kernels; 1-D norm scales/biases stay
+    float32 — they are tiny and precision-critical). Pure jnp, so the
+    cache-warm path can trace it through ``jax.eval_shape`` with no
+    weights. Quantized leaves become ``{"int8_q": int8 array,
+    "int8_scale": f32 per-channel scale}`` sub-dicts — still one pytree,
+    directly passable to a jitted program."""
+    import jax
+    import jax.numpy as jnp
+
+    def quant(w):
+        if getattr(w, "ndim", 0) < 2 or not jnp.issubdtype(
+                jnp.asarray(w).dtype, jnp.floating):
+            return w
+        # channel axis = last (flax conv kernels are HWIO)
+        scale = jnp.max(jnp.abs(w), axis=tuple(range(w.ndim - 1)),
+                        keepdims=True) / 127.0
+        scale = jnp.where(scale > 0, scale, 1.0).astype(jnp.float32)
+        q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+        return {"int8_q": q, "int8_scale": scale}
+
+    return jax.tree_util.tree_map(quant, params)
+
+
+def dequantize_params(qparams):
+    """Inverse of quantize_params_int8, applied INSIDE the serve
+    program: int8 weights stream from HBM, widen to f32 on the way into
+    the conv — f32 accumulate everywhere (the tier quantizes weights,
+    never the math)."""
+    import jax
+    import jax.numpy as jnp
+
+    def dq(x):
+        if _is_quantized_leaf(x):
+            return x["int8_q"].astype(jnp.float32) * x["int8_scale"]
+        return x
+
+    return jax.tree_util.tree_map(dq, qparams, is_leaf=_is_quantized_leaf)
+
+
+def quantized_param_specs(model_cfg, sizes: Sequence[int]):
+    """ShapeDtypeStruct tree of the int8-quantized generator params —
+    the cache-warm stand-in for the int8 tier (no weights needed)."""
+    import jax
+
+    return jax.eval_shape(quantize_params_int8,
+                          param_specs(model_cfg, sizes))
+
+
+def forward_fn(model_cfg, with_cycle: bool, quantized: bool = False):
     """The python callable every serve program traces. Shared with
     tools/cache_warm.py so offline warming lowers the byte-for-byte
     identical HLO the engine requests at startup (the bench._config_for
@@ -75,33 +141,39 @@ def forward_fn(model_cfg, with_cycle: bool):
     (translate.py historically always ran the cycle generator too —
     pure waste without --panels, half the inference FLOPs). True fuses
     both passes into one program for panel requests.
+
+    quantized=True is the int8 tier's trace: params arrive as the
+    quantize_params_int8 tree and widen to f32 inside the program.
     """
     import jax.numpy as jnp
 
     gen = build_generator(model_cfg)
+    widen = dequantize_params if quantized else (lambda p: p)
 
     if with_cycle:
         def fwd(fwd_params, bwd_params, x):
-            fake = gen.apply(fwd_params, x)
-            cycled = gen.apply(bwd_params, fake)
+            fake = gen.apply(widen(fwd_params), x)
+            cycled = gen.apply(widen(bwd_params), fake)
             return fake.astype(jnp.float32), cycled.astype(jnp.float32)
     else:
         def fwd(fwd_params, x):
-            return gen.apply(fwd_params, x).astype(jnp.float32)
+            return gen.apply(widen(fwd_params), x).astype(jnp.float32)
 
     return fwd
 
 
 def lower_forward(model_cfg, fwd_params, bwd_params, batch: int, size: int,
-                  with_cycle: bool):
+                  with_cycle: bool, quantized: bool = False):
     """Lower the exact serve program for one (size, batch) bucket.
     Params may be concrete arrays (engine startup) or ShapeDtypeStruct
     trees (tools/cache_warm.py) — lowering only consumes avals, so both
-    produce the same program. The image buffer is donated (last arg)."""
+    produce the same program. The image buffer is donated (last arg).
+    quantized=True lowers the int8-tier trace (params are the quantized
+    tree)."""
     import jax
     import jax.numpy as jnp
 
-    fwd = forward_fn(model_cfg, with_cycle)
+    fwd = forward_fn(model_cfg, with_cycle, quantized=quantized)
     x = jax.ShapeDtypeStruct((batch, size, size, 3), jnp.float32)
     if with_cycle:
         return jax.jit(fwd, donate_argnums=(2,)).lower(
@@ -115,12 +187,16 @@ class ServeConfig:
 
     ``dtype`` overrides the checkpoint's compute dtype for serving
     (bf16 halves MXU time on chip; params stay float32 either way).
+    ``int8_tier`` compiles a SECOND program per bucket over int8
+    weight-only-quantized params (f32 accumulate) — selected per flush
+    via ``run(..., tier="int8")``.
     """
 
     batch_buckets: Tuple[int, ...] = DEFAULT_BATCH_BUCKETS
     sizes: Tuple[int, ...] = DEFAULT_SIZES
     dtype: str = "float32"  # "float32" | "bfloat16"
     with_cycle: bool = False
+    int8_tier: bool = False
 
     def __post_init__(self):
         if self.dtype not in ("float32", "bfloat16"):
@@ -131,6 +207,12 @@ class ServeConfig:
         if any(b <= 0 for b in self.batch_buckets) or any(
                 s <= 0 for s in self.sizes):
             raise ValueError("serve buckets must be positive")
+        if self.int8_tier and self.with_cycle:
+            # The fused two-pass program is batch-CLI panel traffic;
+            # the int8 tier exists for the server's cheap path — the
+            # combination has no caller and would double compile time.
+            raise ValueError("int8_tier with with_cycle is unsupported "
+                             "(panel traffic serves from the base tier)")
 
 
 class InferenceEngine:
@@ -172,6 +254,32 @@ class InferenceEngine:
                     dtype=serve_cfg.dtype, with_cycle=serve_cfg.with_cycle,
                     seconds=round(time.perf_counter() - t0, 3),
                 )
+        # The int8 tier: a parallel program set over the SAME grammar,
+        # fed by the startup-quantized param tree. Kept in its own dict
+        # so the base-tier contract (`self.programs`, one program per
+        # bucket) is unchanged for existing callers.
+        self.programs_int8: Dict[Tuple[int, int], Any] = {}
+        self._fwd_params_int8 = None
+        if serve_cfg.int8_tier:
+            # Startup-only quantization: one jnp pass over the weights;
+            # the int8 tree is what the tier's programs read from HBM.
+            # f32 accumulate wants f32 compute regardless of the base
+            # tier's dtype.
+            int8_cfg = dataclasses.replace(self.model_cfg,
+                                           compute_dtype="float32")
+            self._fwd_params_int8 = quantize_params_int8(fwd_params)
+            for size in self._sizes:
+                for batch in self._batch_buckets:
+                    t0 = time.perf_counter()
+                    self.programs_int8[(size, batch)] = lower_forward(
+                        int8_cfg, self._fwd_params_int8, None, batch,
+                        size, False, quantized=True,
+                    ).compile()
+                    self._event(
+                        "serve_compile", size=size, batch=batch,
+                        dtype="int8", tier="int8", with_cycle=False,
+                        seconds=round(time.perf_counter() - t0, 3),
+                    )
 
     def _event(self, kind: str, **fields) -> None:
         if self._logger is not None:
@@ -181,6 +289,27 @@ class InferenceEngine:
     @property
     def max_batch(self) -> int:
         return self._batch_buckets[-1]
+
+    @property
+    def tiers(self) -> Tuple[str, ...]:
+        """Program tiers this engine serves: "base" always, plus "int8"
+        when the quantized set was compiled."""
+        return ("base", "int8") if self.programs_int8 else ("base",)
+
+    def resolve_tier(self, tier: Optional[str]) -> str:
+        """Normalize a request's tier tag. None / "base" / the base
+        dtype name all mean the base tier; "int8" requires the tier to
+        have been compiled."""
+        if tier in (None, "base", self.serve_cfg.dtype):
+            return "base"
+        if tier == "int8":
+            if not self.programs_int8:
+                raise ValueError(
+                    "int8 tier requested but the engine was built "
+                    "without it (ServeConfig(int8_tier=True))")
+            return "int8"
+        raise ValueError(f"unknown serving tier {tier!r} "
+                         f"(have {self.tiers})")
 
     def batch_bucket(self, n: int) -> Optional[int]:
         """Smallest batch bucket holding n requests; None when n exceeds
@@ -202,13 +331,16 @@ class InferenceEngine:
         return self._sizes[-1]
 
     # -- the device call --------------------------------------------------
-    def run(self, batch_np: np.ndarray, size: Optional[int] = None):
+    def run(self, batch_np: np.ndarray, size: Optional[int] = None,
+            tier: Optional[str] = None):
         """Dispatch one flush. ``batch_np``: float32 [n, size, size, 3],
         n <= max_batch, already preprocessed to a size bucket. Returns
         (outputs, n_valid): outputs is a tuple of DEVICE arrays —
         (fake,) or (fake, cycled) — still padded to the bucket; the
         first n_valid rows are real. The deferred fetch is the
-        executor's job."""
+        executor's job. ``tier`` selects the program set ("base"
+        default; "int8" = the quantized tier)."""
+        tier = self.resolve_tier(tier)
         n = batch_np.shape[0]
         if size is None:
             size = batch_np.shape[1]
@@ -234,6 +366,9 @@ class InferenceEngine:
             batch_np = np.concatenate(
                 [batch_np,
                  np.zeros((pad,) + batch_np.shape[1:], np.float32)])
+        if tier == "int8":
+            program = self.programs_int8[(size, bucket)]
+            return (program(self._fwd_params_int8, batch_np),), n
         program = self.programs[(size, bucket)]
         if self.serve_cfg.with_cycle:
             outs = program(self._fwd_params, self._bwd_params, batch_np)

@@ -96,24 +96,29 @@ class PipelinedExecutor:
         self._t_last: Optional[float] = None
 
     # -- submission (decode stage runs on the caller's thread) ------------
-    def submit_raw(self, img: np.ndarray) -> Future:
+    def submit_raw(self, img: np.ndarray, tier: Optional[str] = None) \
+            -> Future:
         """Decode-side entry: uint8/float HWC image of any size ->
         preprocess into its resolution bucket, then queue."""
         size = self.engine.size_bucket(img.shape[0], img.shape[1])
-        return self.submit(preprocess_request(img, size))
+        return self.submit(preprocess_request(img, size), tier=tier)
 
-    def submit(self, image: np.ndarray) -> Future:
+    def submit(self, image: np.ndarray, tier: Optional[str] = None) \
+            -> Future:
         """Queue one preprocessed float32 [s, s, 3] image (s must be a
         resolution bucket). Returns a Future resolving to {"fake": ...}
-        (+ "cycled" when the engine fuses the cycle pass)."""
+        (+ "cycled" when the engine fuses the cycle pass). ``tier``
+        routes to an engine program set ("int8" = the quantized tier)."""
         if self._closed:
             raise RuntimeError("executor is closed")
         size = int(image.shape[0])
-        return self._batcher_for(size).submit(Request(image, size))
+        tier = self.engine.resolve_tier(tier)
+        return self._batcher_for(size, tier).submit(
+            Request(image, size, tier=tier))
 
-    def _batcher_for(self, size: int) -> MicroBatcher:
+    def _batcher_for(self, size: int, tier: str = "base") -> MicroBatcher:
         with self._batcher_lock:
-            b = self._batchers.get(size)
+            b = self._batchers.get((size, tier))
             if b is None:
                 if (size, self.engine.batch_bucket(1)) not in \
                         self.engine.programs:
@@ -123,8 +128,8 @@ class PipelinedExecutor:
                 b = MicroBatcher(
                     self._flush, self._max_batch, self._max_wait_s,
                     max_queue=self._max_queue,
-                    name=f"serve-batcher-{size}")
-                self._batchers[size] = b
+                    name=f"serve-batcher-{size}-{tier}")
+                self._batchers[(size, tier)] = b
             return b
 
     # -- dispatch stage (batcher worker thread) ---------------------------
@@ -136,7 +141,8 @@ class PipelinedExecutor:
         try:
             t0 = time.perf_counter()
             x = np.stack([r.image for r in batch])
-            outs, n = self.engine.run(x, size=batch[0].size)
+            outs, n = self.engine.run(x, size=batch[0].size,
+                                      tier=batch[0].tier)
             t_dispatched = time.perf_counter()
         except BaseException:
             self._inflight.release()
@@ -181,18 +187,42 @@ class PipelinedExecutor:
                 self._t_first = t0
             self._t_last = now
             if self._logger is not None:
-                depth = self._batchers[batch[0].size].depth \
-                    if batch[0].size in self._batchers else 0
+                bkey = (batch[0].size, batch[0].tier or "base")
+                depth = self._batchers[bkey].depth \
+                    if bkey in self._batchers else 0
                 self._logger.event(
                     "serve_flush",
                     n=n, bucket=self.engine.batch_bucket(n),
                     size=batch[0].size, trigger=trigger,
+                    tier=batch[0].tier or "base",
                     queue_depth=depth,
                     queue_wait_s=round(t0 - batch[0].t_submit, 6),
                     dispatch_s=round(t_dispatched - t0, 6),
                     fetch_block_s=round(t_done - t_fetch, 6),
                     e2e_p50_s=round(_percentile(sorted(lats), 0.5), 6),
                 )
+
+    # -- public snapshot ---------------------------------------------------
+    def stats(self) -> dict:
+        """Live telemetry snapshot for front-ends (/stats): per-bucket
+        queue depths, the queue high-water mark (tracked by the batcher
+        since PR 3 but never surfaced until now), and flush/request
+        counters. Pure host-side reads — no device interaction, safe
+        from any thread at any frequency."""
+        with self._batcher_lock:
+            batchers = dict(self._batchers)
+        depths = {f"{size}/{tier}": b.depth
+                  for (size, tier), b in sorted(batchers.items())}
+        return {
+            "queue_depths": depths,
+            "max_queue_depth": max(
+                (b.max_depth for b in batchers.values()), default=0),
+            "n_flushes": sum(b.n_flushes for b in batchers.values()),
+            "n_queued_requests": sum(
+                b.n_requests for b in batchers.values()),
+            "n_images_done": self._n_done,
+            "tiers": list(self.engine.tiers),
+        }
 
     # -- shutdown ---------------------------------------------------------
     def close(self) -> dict:

@@ -1,0 +1,53 @@
+"""Fleet serving: N engine replicas behind one admission-controlled
+queue — the layer that turns the PR-3 single-replica pipeline into
+something that can face overload without falling over.
+
+Four pieces, one per production failure mode:
+
+- classes.py    — priority/deadline classes (interactive / batch /
+                  best_effort): every request carries an absolute
+                  deadline and a shed rank, and may map onto a cheaper
+                  engine tier (int8).
+- admission.py  — the shared admission queue: bounded (backpressure is
+                  a 429 + Retry-After, never unbounded host memory),
+                  EDF-ordered, and class-aware — overload evicts the
+                  lowest class first so `interactive` p95 holds while
+                  saturated.
+- replica.py    — one engine-replica worker: stages a flush, dispatches
+                  to its engine, performs the pipeline's one deferred
+                  D2H (sanctioned-fetch), resolves futures. N of these
+                  run concurrently over shared AOT programs.
+- controller.py — the FleetExecutor facade + the EDF dispatcher with
+                  continuous batching: the moment any replica frees it
+                  refills a bucket from whatever is queued (partial
+                  buckets ride the max-wait bound), instead of
+                  flush-and-wait.
+
+tools/check_no_sync.py scans this package as hot-path: the replica's
+one deferred fetch per flush is the only sanctioned device_get.
+"""
+
+from cyclegan_tpu.serve.fleet.admission import (
+    AdmissionController,
+    DeadlineExceeded,
+    ShedError,
+)
+from cyclegan_tpu.serve.fleet.classes import (
+    DEFAULT_CLASSES,
+    DeadlineClass,
+    class_map,
+)
+from cyclegan_tpu.serve.fleet.controller import FleetConfig, FleetExecutor
+from cyclegan_tpu.serve.fleet.replica import ReplicaWorker
+
+__all__ = [
+    "AdmissionController",
+    "DEFAULT_CLASSES",
+    "DeadlineClass",
+    "DeadlineExceeded",
+    "FleetConfig",
+    "FleetExecutor",
+    "ReplicaWorker",
+    "ShedError",
+    "class_map",
+]

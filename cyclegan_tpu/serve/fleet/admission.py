@@ -1,0 +1,291 @@
+"""The shared admission queue: bounded, EDF-ordered, class-aware.
+
+One queue fronts every replica. Three disciplines, each matched to a
+production failure mode:
+
+- **Bounded admission (backpressure).** The queue holds at most
+  ``capacity`` requests. Past that, admission does NOT block the
+  caller's connection thread (a blocked accept loop is unbounded host
+  memory one layer up) — it sheds: the new request is rejected, or a
+  queued lower-class request is evicted to make room for a higher-class
+  arrival. Either way the victim's caller gets a ``ShedError`` carrying
+  a drain-rate-derived Retry-After, which the HTTP front-end maps to
+  429.
+- **Class-ordered shedding.** Victims are chosen by (shed_rank desc,
+  deadline desc): the laziest best_effort request goes first, batch
+  next, and `interactive` is only ever rejected when the queue is
+  entirely interactive — so interactive p95 holds while saturated,
+  which is the fleet's acceptance bound.
+- **EDF dispatch order.** The dispatcher drains in earliest-deadline
+  order (a heap keyed by absolute deadline, ties by arrival). Deadline
+  budgets are class properties, so EDF degrades to FIFO within a class
+  and strict priority across classes under mixed load. Requests of a
+  sheddable class (shed_rank > 0) whose deadline already passed while
+  queued are dropped at pop time (``DeadlineExceeded``) instead of
+  wasting a bucket slot; expired `interactive` requests still serve —
+  late is better than never for a user-facing reply.
+
+The pop side also owns the **continuous-batching window**: a batch is
+released the instant it can fill a bucket, or when the EDF head has
+waited the max-wait budget — so a freed replica refills immediately
+under load, and a lone request never waits for companions longer than
+the bound.
+
+No device interaction lives here; tools/check_no_sync.py scans this
+package as hot path (host-side queueing only).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+from cyclegan_tpu.serve.fleet.classes import DeadlineClass
+
+
+class ShedError(Exception):
+    """Raised into a shed request's future (evicted from the queue) or
+    at the submitting caller (rejected at admission). ``retry_after_s``
+    is the queue's drain-rate estimate of when capacity returns."""
+
+    def __init__(self, reason: str, retry_after_s: float,
+                 klass: str = "?"):
+        super().__init__(f"shed ({reason}, class={klass}): retry after "
+                         f"{retry_after_s:.1f}s")
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        self.klass = klass
+
+
+class DeadlineExceeded(Exception):
+    """A sheddable request's deadline passed while it was still queued;
+    it was dropped at dispatch time instead of wasting a bucket slot."""
+
+
+class FleetRequest:
+    """One admitted unit of work: the preprocessed image, its routing
+    key (size bucket, engine tier), its class, and the absolute deadline
+    EDF orders by."""
+
+    __slots__ = ("image", "size", "tier", "klass", "future", "t_submit",
+                 "deadline", "shed")
+
+    def __init__(self, image, size: int, tier: str,
+                 klass: DeadlineClass, now: Optional[float] = None):
+        self.image = image
+        self.size = size
+        self.tier = tier
+        self.klass = klass
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter() if now is None else now
+        self.deadline = self.t_submit + klass.deadline_ms / 1000.0
+        self.shed = False  # lazy deletion flag (evicted while heaped)
+
+
+class AdmissionController:
+    """Bounded class-aware EDF queue shared by every replica."""
+
+    def __init__(self, capacity: int = 256, logger=None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._logger = logger
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        # heap entries: (deadline, seq, req); seq breaks ties FIFO.
+        self._heap: List[Tuple[float, int, FleetRequest]] = []
+        self._seq = 0
+        self._live = 0  # heap entries not lazily-deleted
+        self._closed = False
+        # telemetry (all guarded by _lock; read via stats())
+        self.max_depth = 0
+        self.n_admitted: Dict[str, int] = {}
+        self.n_shed: Dict[str, int] = {}      # class -> evict+reject count
+        self.shed_reasons: Dict[str, int] = {}
+        # drain-rate EWMA (images/sec) feeding Retry-After estimates;
+        # primed pessimistically so a cold queue suggests a real backoff.
+        self._drain_rate = 1.0
+        self._t_last_drain: Optional[float] = None
+
+    # -- producer side ----------------------------------------------------
+    def offer(self, req: FleetRequest) -> Future:
+        """Admit one request, or shed. Returns the request's future;
+        raises ShedError when the REQUEST ITSELF is rejected (queue full
+        of equal-or-higher-class work). Never blocks on capacity."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("admission queue is closed")
+            if self._live >= self.capacity:
+                victim = self._pick_victim(req.klass)
+                if victim is None:
+                    retry = self._retry_after_locked()
+                    self._count_shed(req.klass.name, "rejected")
+                    self._event("fleet_shed", klass=req.klass.name,
+                                reason="rejected", depth=self._live,
+                                retry_after_s=round(retry, 3))
+                    raise ShedError("rejected", retry, req.klass.name)
+                victim.shed = True
+                self._live -= 1
+                retry = self._retry_after_locked()
+                self._count_shed(victim.klass.name, "evicted")
+                self._event("fleet_shed", klass=victim.klass.name,
+                            reason="evicted", depth=self._live,
+                            evicted_for=req.klass.name,
+                            retry_after_s=round(retry, 3))
+                victim.future.set_exception(
+                    ShedError("evicted", retry, victim.klass.name))
+            heapq.heappush(self._heap, (req.deadline, self._seq, req))
+            self._seq += 1
+            self._live += 1
+            if self._live > self.max_depth:
+                self.max_depth = self._live
+            self.n_admitted[req.klass.name] = \
+                self.n_admitted.get(req.klass.name, 0) + 1
+            self._nonempty.notify()
+            return req.future
+
+    def _pick_victim(self, arriving: DeadlineClass) \
+            -> Optional[FleetRequest]:
+        """Strictly-lower-class victim with the most slack: max
+        (shed_rank, deadline) among live entries whose shed_rank exceeds
+        the arrival's. O(n) scan — only runs under overload, and
+        capacity bounds n."""
+        best: Optional[FleetRequest] = None
+        for _, _, req in self._heap:
+            if req.shed or req.klass.shed_rank <= arriving.shed_rank:
+                continue
+            if best is None or (req.klass.shed_rank, req.deadline) > \
+                    (best.klass.shed_rank, best.deadline):
+                best = req
+        return best
+
+    # -- consumer side (the dispatcher) -----------------------------------
+    def next_batch(self, max_n: int, max_wait_s: float,
+                   poll_s: float = 0.05) -> Optional[List[FleetRequest]]:
+        """Block until a batch is releasable, then pop up to ``max_n``
+        requests in EDF order, all sharing the head's (size, tier)
+        routing key. Release happens when the matching run can fill
+        ``max_n`` slots, or when the EDF head has waited ``max_wait_s``
+        since submission. Returns None only after close() with the
+        queue fully drained."""
+        deadline_of_head = None
+        while True:
+            with self._lock:
+                self._compact_locked()
+                head = self._peek_locked()
+                if head is None:
+                    if self._closed:
+                        return None
+                    self._nonempty.wait(timeout=poll_s)
+                    continue
+                now = time.perf_counter()
+                matching = sum(
+                    1 for _, _, r in self._heap
+                    if not r.shed and (r.size, r.tier) ==
+                    (head.size, head.tier))
+                window_over = (now - head.t_submit) >= max_wait_s
+                if matching >= max_n or window_over or self._closed:
+                    return self._pop_batch_locked(head, max_n)
+                deadline_of_head = head.t_submit + max_wait_s
+            # Outside the lock: sleep toward the head's window edge so
+            # producers can keep admitting while we coalesce.
+            time.sleep(min(poll_s, max(0.0,
+                                       deadline_of_head - time.perf_counter())))
+
+    def _peek_locked(self) -> Optional[FleetRequest]:
+        for _, _, req in self._heap[:1]:
+            return None if req.shed else req
+        return None
+
+    def _compact_locked(self) -> None:
+        while self._heap and self._heap[0][2].shed:
+            heapq.heappop(self._heap)
+
+    def _pop_batch_locked(self, head: FleetRequest, max_n: int) \
+            -> List[FleetRequest]:
+        """EDF-ordered pop of up to max_n requests matching the head's
+        (size, tier); non-matching entries are re-heaped. Sheddable
+        requests whose deadline passed while queued are dropped here."""
+        out: List[FleetRequest] = []
+        putback: List[Tuple[float, int, FleetRequest]] = []
+        now = time.perf_counter()
+        while self._heap and len(out) < max_n:
+            entry = heapq.heappop(self._heap)
+            req = entry[2]
+            if req.shed:
+                continue
+            if now > req.deadline and req.klass.shed_rank > 0:
+                self._live -= 1
+                self._count_shed(req.klass.name, "expired")
+                self._event("fleet_shed", klass=req.klass.name,
+                            reason="expired", depth=self._live)
+                req.future.set_exception(DeadlineExceeded(
+                    f"class {req.klass.name} deadline passed while "
+                    f"queued ({now - req.deadline:.3f}s late)"))
+                continue
+            if (req.size, req.tier) != (head.size, head.tier):
+                putback.append(entry)
+                continue
+            out.append(req)
+            self._live -= 1
+        for entry in putback:
+            heapq.heappush(self._heap, entry)
+        return out
+
+    # -- completion feedback ----------------------------------------------
+    def on_complete(self, n: int) -> None:
+        """Replica callback after a flush resolves: feeds the drain-rate
+        EWMA the Retry-After estimate is derived from."""
+        now = time.perf_counter()
+        with self._lock:
+            if self._t_last_drain is not None:
+                dt = max(now - self._t_last_drain, 1e-6)
+                inst = n / dt
+                self._drain_rate += 0.3 * (inst - self._drain_rate)
+            self._t_last_drain = now
+
+    def _retry_after_locked(self) -> float:
+        # Time to drain the current backlog at the measured rate,
+        # clamped to a sane HTTP Retry-After range.
+        return min(max(self._live / max(self._drain_rate, 1e-3), 1.0),
+                   120.0)
+
+    def retry_after_s(self) -> float:
+        with self._lock:
+            return self._retry_after_locked()
+
+    # -- shutdown / snapshots ---------------------------------------------
+    def close(self) -> None:
+        """Stop admitting; queued requests drain normally (next_batch
+        keeps returning batches until empty, then None)."""
+        with self._lock:
+            self._closed = True
+            self._nonempty.notify_all()
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return self._live
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "depth": self._live,
+                "capacity": self.capacity,
+                "max_depth": self.max_depth,
+                "admitted": dict(self.n_admitted),
+                "shed": dict(self.n_shed),
+                "shed_reasons": dict(self.shed_reasons),
+                "retry_after_s": round(self._retry_after_locked(), 3),
+            }
+
+    def _count_shed(self, klass: str, reason: str) -> None:
+        self.n_shed[klass] = self.n_shed.get(klass, 0) + 1
+        self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+
+    def _event(self, kind: str, **fields) -> None:
+        if self._logger is not None:
+            self._logger.event(kind, **fields)

@@ -1,0 +1,62 @@
+"""Priority/deadline classes for fleet serving.
+
+Every request admitted to the fleet carries a class. The class fixes
+three things:
+
+- its **deadline budget**: admission time + budget = the absolute
+  deadline the EDF dispatcher orders by, and the bound the per-class
+  p95 is judged against;
+- its **shed rank**: under overload the admission queue evicts the
+  highest rank first (best_effort before batch before interactive), so
+  paying-traffic latency degrades last;
+- optionally a **serving tier**: a class may route to a cheaper engine
+  program set (the int8 weight-quantized tier) instead of the base
+  f32/bf16 programs.
+
+The default budgets follow the acceptance bound's shape: `interactive`
+gets roughly one bucket's compute + the micro-batch max-wait (tight —
+it is what the fleet protects), `batch` an order of magnitude more,
+`best_effort` is explicitly the shock absorber. Budgets are host-config
+knobs, not physics — `FleetConfig(classes=...)` overrides them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class DeadlineClass:
+    """One priority/deadline class of the fleet's admission contract."""
+
+    name: str
+    deadline_ms: float      # admission -> completion budget
+    shed_rank: int          # higher sheds first; 0 = protected longest
+    tier: Optional[str] = None  # engine tier override (None = base)
+
+    def __post_init__(self):
+        if self.deadline_ms <= 0:
+            raise ValueError(f"class {self.name!r}: deadline_ms must be "
+                             f"positive, got {self.deadline_ms}")
+        if self.shed_rank < 0:
+            raise ValueError(f"class {self.name!r}: shed_rank must be "
+                             f">= 0, got {self.shed_rank}")
+
+
+DEFAULT_CLASSES: Tuple[DeadlineClass, ...] = (
+    DeadlineClass("interactive", deadline_ms=500.0, shed_rank=0),
+    DeadlineClass("batch", deadline_ms=5000.0, shed_rank=1),
+    DeadlineClass("best_effort", deadline_ms=30000.0, shed_rank=2),
+)
+
+
+def class_map(classes=DEFAULT_CLASSES) -> Dict[str, DeadlineClass]:
+    """name -> class lookup, validating uniqueness once at config time
+    so the admission hot path is a plain dict hit."""
+    out: Dict[str, DeadlineClass] = {}
+    for c in classes:
+        if c.name in out:
+            raise ValueError(f"duplicate deadline class {c.name!r}")
+        out[c.name] = c
+    return out

@@ -1,0 +1,316 @@
+"""FleetExecutor: the admission queue, the replicas, and the EDF
+dispatcher with continuous batching, behind one executor-shaped facade.
+
+Dispatch discipline — the inversion that makes this a fleet rather than
+N independent pipelines: the dispatcher waits for a FREE REPLICA first,
+and only then asks the admission queue for a batch. Work is never popped
+before a replica can run it, so the queue stays globally EDF-ordered up
+to the instant of dispatch (a later-arriving `interactive` request
+overtakes every queued `batch` request, not just ones behind it in some
+per-replica lane), and shedding decisions always see the full backlog.
+
+Continuous batching falls out of the same loop: a replica frees itself
+the moment its D2H lands, re-enters the free queue, and the dispatcher
+immediately refills it from whatever is queued — partially-drained
+buckets go out bounded by the max-wait window instead of waiting for a
+full bucket or for the other replicas to finish (flush-and-wait).
+Flushes dispatched while other replicas are still busy are flagged
+``refill`` in telemetry, so the bench can verify overlap actually
+happens.
+
+Telemetry (PR-1 JSONL schema, folded by tools/obs_report.py):
+``fleet_flush`` per flush (replica, fill, trigger, class mix, latency
+splits), ``fleet_shed`` per shed decision (emitted by the admission
+queue), and a ``fleet_summary`` rollup at close with per-class latency
+percentiles, deadline-miss counts, shed counts, and the queue
+high-water mark.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from cyclegan_tpu.serve.engine import InferenceEngine, preprocess_request
+from cyclegan_tpu.serve.fleet.admission import (
+    AdmissionController,
+    FleetRequest,
+)
+from cyclegan_tpu.serve.fleet.classes import (
+    DEFAULT_CLASSES,
+    DeadlineClass,
+    class_map,
+)
+from cyclegan_tpu.serve.fleet.replica import ReplicaWorker
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Host-side fleet knobs (the engine's ServeConfig still owns the
+    compiled-program grammar: sizes, batch buckets, dtype, int8 tier)."""
+
+    n_replicas: int = 2
+    capacity: int = 256          # admission queue bound (requests)
+    max_batch: Optional[int] = None   # None = engine's largest bucket
+    max_wait_ms: float = 5.0     # partial-bucket coalescing window
+    classes: Tuple[DeadlineClass, ...] = DEFAULT_CLASSES
+    default_class: str = "batch"
+
+    def __post_init__(self):
+        if self.n_replicas < 1:
+            raise ValueError(
+                f"n_replicas must be >= 1, got {self.n_replicas}")
+        if self.max_wait_ms < 0:
+            raise ValueError(
+                f"max_wait_ms must be >= 0, got {self.max_wait_ms}")
+        names = {c.name for c in self.classes}
+        if self.default_class not in names:
+            raise ValueError(
+                f"default_class {self.default_class!r} not among "
+                f"classes {sorted(names)}")
+
+
+class FleetExecutor:
+    """N replicas behind one admission-controlled EDF queue.
+
+    Same submit/close surface as PipelinedExecutor, plus a ``klass``
+    routing argument — front-ends swap executors without changing the
+    handler. Shed requests surface as ShedError (submit-time rejection
+    raises; queue eviction fails the future), expired sheddable requests
+    as DeadlineExceeded on the future.
+    """
+
+    def __init__(self, engine: InferenceEngine,
+                 cfg: Optional[FleetConfig] = None, *, logger=None):
+        self.engine = engine
+        self.cfg = cfg or FleetConfig()
+        self._logger = logger
+        self._classes = class_map(self.cfg.classes)
+        max_batch = (engine.max_batch if self.cfg.max_batch is None
+                     else self.cfg.max_batch)
+        if engine.batch_bucket(max_batch) is None:
+            raise ValueError(
+                f"max_batch={max_batch} exceeds the engine's largest "
+                f"batch bucket {engine.max_batch}")
+        self._max_batch = max_batch
+        self._max_wait_s = self.cfg.max_wait_ms / 1000.0
+        # Every class must route to a tier the engine actually compiled,
+        # checked here once rather than per-request.
+        for c in self.cfg.classes:
+            engine.resolve_tier(c.tier)
+        self.admission = AdmissionController(self.cfg.capacity,
+                                             logger=logger)
+        self._free: "queue.Queue" = queue.Queue()
+        self.replicas = [
+            ReplicaWorker(i, engine, on_free=self._free.put,
+                          on_done=self._on_done)
+            for i in range(self.cfg.n_replicas)
+        ]
+        for r in self.replicas:
+            self._free.put(r)
+        self._busy = 0  # replicas holding a dispatched flush
+        self._closed = False
+        # Rollup state (guarded by _stats_lock; written by replica
+        # threads via _on_done, read by stats()/close()).
+        self._stats_lock = threading.Lock()
+        self._lat_by_class: Dict[str, List[float]] = {}
+        self._miss_by_class: Dict[str, int] = {}
+        self._n_done = 0
+        self._n_flushes = 0
+        self._n_refill = 0
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name="fleet-dispatcher")
+        self._dispatcher.start()
+
+    # -- submission --------------------------------------------------------
+    def submit_raw(self, img: np.ndarray, klass: Optional[str] = None,
+                   tier: Optional[str] = None) -> Future:
+        """Decode-side entry: raw HWC image of any size -> bucket
+        preprocess, class lookup, admission."""
+        size = self.engine.size_bucket(img.shape[0], img.shape[1])
+        return self.submit(preprocess_request(img, size), klass=klass,
+                           tier=tier)
+
+    def submit(self, image: np.ndarray, klass: Optional[str] = None,
+               tier: Optional[str] = None) -> Future:
+        """Admit one preprocessed [s, s, 3] image under a deadline
+        class. Raises ShedError when admission rejects it (HTTP 429 at
+        the front-end); raises KeyError for an unknown class. An
+        explicit ``tier`` overrides the class's tier routing."""
+        if self._closed:
+            raise RuntimeError("fleet executor is closed")
+        name = klass or self.cfg.default_class
+        try:
+            k = self._classes[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown deadline class {name!r}; have "
+                f"{sorted(self._classes)}") from None
+        resolved = self.engine.resolve_tier(
+            tier if tier is not None else k.tier)
+        size = int(image.shape[0])
+        if (size, self.engine.batch_bucket(1)) not in self.engine.programs:
+            raise ValueError(
+                f"size {size} is not a compiled resolution bucket "
+                f"{tuple(sorted({s for s, _ in self.engine.programs}))}")
+        return self.admission.offer(
+            FleetRequest(image, size, resolved, k))
+
+    # -- the dispatcher ----------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while True:
+            replica = self._free.get()
+            batch = self.admission.next_batch(self._max_batch,
+                                              self._max_wait_s)
+            if batch is None:  # closed and drained
+                self._free.put(replica)
+                return
+            if not batch:  # everything matching the head expired
+                self._free.put(replica)
+                continue
+            with self._stats_lock:
+                busy_others = self._busy
+                self._busy += 1
+            if len(batch) >= self._max_batch:
+                trigger = "full"
+            elif busy_others > 0:
+                # A partial bucket staged while other replicas still
+                # compute: continuous batching doing its job.
+                trigger = "refill"
+            else:
+                trigger = "window"
+            replica.dispatch(batch, trigger)
+
+    # -- completion callback (replica threads) -----------------------------
+    def _on_done(self, replica: ReplicaWorker,
+                 batch: List[FleetRequest], n: int, trigger: str,
+                 t0: float, t_dispatched: float, t_done: float) -> None:
+        self.admission.on_complete(n)
+        lats = [(r.klass.name, t_done - r.t_submit,
+                 t_done > r.deadline) for r in batch]
+        with self._stats_lock:
+            self._busy -= 1
+            self._n_done += n
+            self._n_flushes += 1
+            if trigger == "refill":
+                self._n_refill += 1
+            if self._t_first is None:
+                self._t_first = t0
+            self._t_last = t_done
+            for name, lat, missed in lats:
+                self._lat_by_class.setdefault(name, []).append(lat)
+                if missed:
+                    self._miss_by_class[name] = \
+                        self._miss_by_class.get(name, 0) + 1
+        if self._logger is not None:
+            mix: Dict[str, int] = {}
+            for name, _, _ in lats:
+                mix[name] = mix.get(name, 0) + 1
+            self._logger.event(
+                "fleet_flush",
+                replica=replica.replica_id, n=n,
+                bucket=self.engine.batch_bucket(n),
+                size=batch[0].size, tier=batch[0].tier,
+                trigger=trigger, classes=mix,
+                queue_depth=self.admission.depth,
+                queue_wait_s=round(t0 - batch[0].t_submit, 6),
+                dispatch_s=round(t_dispatched - t0, 6),
+                fetch_block_s=round(t_done - t_dispatched, 6),
+                e2e_p50_s=round(_percentile(
+                    sorted(l for _, l, _ in lats), 0.5), 6),
+            )
+
+    # -- public snapshot ---------------------------------------------------
+    def stats(self) -> dict:
+        """Live fleet snapshot for /stats: admission depth + shed
+        counters, replica occupancy, per-class latency so far. Pure
+        host-side reads."""
+        with self._stats_lock:
+            per_class = {
+                name: {
+                    "n": len(lats),
+                    "p50_s": round(_percentile(sorted(lats), 0.5), 6),
+                    "p95_s": round(_percentile(sorted(lats), 0.95), 6),
+                    "deadline_misses": self._miss_by_class.get(name, 0),
+                }
+                for name, lats in sorted(self._lat_by_class.items())
+            }
+            busy = self._busy
+            snap = {
+                "n_images_done": self._n_done,
+                "n_flushes": self._n_flushes,
+                "refill_flushes": self._n_refill,
+            }
+        snap.update({
+            "n_replicas": len(self.replicas),
+            "replicas_busy": busy,
+            "admission": self.admission.stats(),
+            "classes": per_class,
+            "tiers": list(self.engine.tiers),
+        })
+        return snap
+
+    # -- shutdown ----------------------------------------------------------
+    def close(self) -> dict:
+        """Stop admitting, drain the queue through the replicas, join
+        every thread, emit (and return) the ``fleet_summary`` rollup."""
+        if self._closed:
+            return {}
+        self._closed = True
+        self.admission.close()
+        self._dispatcher.join(timeout=60.0)
+        for r in self.replicas:
+            r.close()
+        with self._stats_lock:
+            wall = ((self._t_last - self._t_first)
+                    if self._t_first is not None and
+                    self._t_last is not None else 0.0)
+
+            def pcts(lats: List[float]) -> dict:
+                s = sorted(lats)
+                return {
+                    "n": len(s),
+                    "p50_s": round(_percentile(s, 0.5), 6) if s else None,
+                    "p95_s": round(_percentile(s, 0.95), 6) if s else None,
+                }
+
+            summary = {
+                "n_images": self._n_done,
+                "n_flushes": self._n_flushes,
+                "refill_flushes": self._n_refill,
+                "n_replicas": len(self.replicas),
+                "wall_s": round(wall, 6),
+                "images_per_sec": round(self._n_done / wall, 4)
+                if wall > 0 else 0.0,
+                "classes": {
+                    name: dict(
+                        pcts(lats),
+                        deadline_misses=self._miss_by_class.get(name, 0),
+                    )
+                    for name, lats in sorted(self._lat_by_class.items())
+                },
+            }
+        adm = self.admission.stats()
+        summary["shed"] = adm["shed"]
+        summary["shed_reasons"] = adm["shed_reasons"]
+        summary["max_queue_depth"] = adm["max_depth"]
+        if self._logger is not None:
+            self._logger.event("fleet_summary", **summary)
+        return summary

@@ -1,0 +1,103 @@
+"""One engine-replica worker of the fleet.
+
+A replica is a thread that owns the full life of a flush: stack the
+batch's images, dispatch to the engine (async — the AOT program call
+returns device futures), perform the pipeline's ONE deferred D2H, and
+resolve the request futures. N replicas run this loop concurrently over
+the SAME engine object — compiled XLA programs are thread-safe to
+execute, so replicas share the AOT program cache and the weights buffer
+instead of paying per-replica HBM. What replication buys on a single
+chip is overlap: while replica A blocks in its deferred fetch (D2H +
+host-side future resolution), replica B's flush is already staged and
+computing. On a multi-chip host, each replica can carry an engine bound
+to its own device; the fleet layer is agnostic.
+
+The worker frees itself back to the dispatcher the moment its fetch
+lands and BEFORE resolving futures — continuous batching wants the next
+flush staged while this one's callers are still being woken.
+
+The ``jax.device_get`` below is this package's single sanctioned sync
+point (one per flush); tools/check_no_sync.py enforces that it stays
+the only one.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from cyclegan_tpu.serve.fleet.admission import FleetRequest
+
+_STOP = object()
+
+
+class ReplicaWorker:
+    """Worker thread: inbox of (batch, trigger) -> engine -> fetch ->
+    resolve. The dispatcher only hands a batch to a replica it has seen
+    on the free queue, so the inbox never holds more than one entry."""
+
+    def __init__(self, replica_id: int, engine,
+                 on_free: Callable[["ReplicaWorker"], None],
+                 on_done: Optional[Callable] = None):
+        self.replica_id = replica_id
+        self.engine = engine
+        self._on_free = on_free
+        self._on_done = on_done
+        self._inbox: "queue.Queue" = queue.Queue()
+        self.n_flushes = 0
+        self.n_images = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"fleet-replica-{replica_id}")
+        self._thread.start()
+
+    def dispatch(self, batch: List[FleetRequest], trigger: str) -> None:
+        self._inbox.put((batch, trigger))
+
+    def close(self, timeout: Optional[float] = 30.0) -> None:
+        self._inbox.put(_STOP)
+        self._thread.join(timeout=timeout)
+
+    def _run(self) -> None:
+        import time
+
+        import jax
+
+        while True:
+            item = self._inbox.get()
+            if item is _STOP:
+                return
+            batch, trigger = item
+            t0 = time.perf_counter()
+            try:
+                x = np.stack([r.image for r in batch])
+                outs, n = self.engine.run(x, size=batch[0].size,
+                                          tier=batch[0].tier)
+                t_dispatched = time.perf_counter()
+                host = jax.device_get(outs)  # sanctioned-fetch: the replica's one deferred D2H per flush
+            except BaseException as e:  # noqa: BLE001 — fail the flush, keep the replica
+                self._on_free(self)
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                continue
+            t_done = time.perf_counter()
+            # Free FIRST: the dispatcher can stage the next flush while
+            # this thread is still waking callers below.
+            self._on_free(self)
+            fake = host[0]
+            cycled = host[1] if len(host) > 1 else None
+            for i, r in enumerate(batch):
+                result = {"fake": fake[i]}
+                if cycled is not None:
+                    result["cycled"] = cycled[i]
+                if not r.future.done():
+                    r.future.set_result(result)
+            self.n_flushes += 1
+            self.n_images += n
+            if self._on_done is not None:
+                self._on_done(self, batch, n, trigger,
+                              t0, t_dispatched, t_done)

@@ -13,13 +13,21 @@ Endpoints:
                     ?panels=1 additionally returns the
                     [input | translated | cycled] panel when the engine
                     was built with the fused cycle program.
+                    ?class=interactive|batch|best_effort picks the
+                    deadline class (fleet mode; default `batch`).
+                    ?tier=int8 routes to the quantized program tier
+                    when the engine compiled one.
+                    Overload answers 429 with a Retry-After header
+                    (fleet mode's admission control shedding).
   GET  /healthz     200 once the engine's programs are compiled —
                     readiness probe for a load balancer.
-  GET  /stats       JSON snapshot: requests served, queue depth.
+  GET  /stats       JSON snapshot: requests served, queue depths,
+                    shed/class telemetry in fleet mode.
 
 Run:
   python -m cyclegan_tpu.serve.server --output_dir runs --port 8080 \
-      [--dtype bfloat16] [--batch_bucket 8] [--max_wait_ms 5] [--panels]
+      [--dtype bfloat16] [--batch_bucket 8] [--max_wait_ms 5] [--panels] \
+      [--fleet 2 [--capacity 256]] [--int8]
 """
 
 from __future__ import annotations
@@ -28,6 +36,7 @@ import argparse
 import io
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -35,26 +44,35 @@ import numpy as np
 
 
 class ServeApp:
-    """The handler-visible application state: executor + counters."""
+    """The handler-visible application state: executor + counters.
 
-    def __init__(self, executor, with_cycle: bool):
+    Works over either executor: PipelinedExecutor (single-replica
+    pipeline) or FleetExecutor (admission-controlled replica fleet) —
+    both expose the same public ``stats()`` snapshot, so the handler
+    never reaches into executor internals."""
+
+    def __init__(self, executor, with_cycle: bool, fleet: bool = False):
         self.executor = executor
         self.with_cycle = with_cycle
+        self.fleet = fleet
         self.n_requests = 0
         self.n_errors = 0
+        self.n_shed = 0
         self._lock = threading.Lock()
 
-    def count(self, error: bool = False) -> None:
+    def count(self, error: bool = False, shed: bool = False) -> None:
         with self._lock:
             self.n_requests += 1
             if error:
                 self.n_errors += 1
+            if shed:
+                self.n_shed += 1
 
     def stats(self) -> dict:
-        depths = {str(s): b.depth
-                  for s, b in self.executor._batchers.items()}
-        return {"n_requests": self.n_requests, "n_errors": self.n_errors,
-                "queue_depths": depths}
+        out = {"n_requests": self.n_requests, "n_errors": self.n_errors,
+               "n_shed": self.n_shed, "fleet": self.fleet}
+        out.update(self.executor.stats())
+        return out
 
 
 def _decode_upload(body: bytes) -> np.ndarray:
@@ -101,11 +119,14 @@ def make_handler(app: ServeApp):
                 self._reply(404, b'{"error": "not found"}')
 
         def do_POST(self):
-            path = self.path.split("?", 1)[0]
-            if path != "/translate":
+            parsed = urllib.parse.urlparse(self.path)
+            if parsed.path != "/translate":
                 self._reply(404, b'{"error": "not found"}')
                 return
-            want_panel = "panels=1" in (self.path.split("?", 1) + [""])[1]
+            q = urllib.parse.parse_qs(parsed.query)
+            want_panel = q.get("panels", ["0"])[0] == "1"
+            tier = q.get("tier", [None])[0]
+            klass = q.get("class", [None])[0]
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 img = _decode_upload(self.rfile.read(length))
@@ -113,7 +134,12 @@ def make_handler(app: ServeApp):
                 # across connections by the executor, encode runs here
                 # again once the future resolves — the pipeline stages
                 # of serve/executor.py.
-                result = app.executor.submit_raw(img).result(timeout=120)
+                if app.fleet:
+                    fut = app.executor.submit_raw(img, klass=klass,
+                                                  tier=tier)
+                else:
+                    fut = app.executor.submit_raw(img, tier=tier)
+                result = fut.result(timeout=120)
                 if want_panel and "cycled" in result:
                     size = result["fake"].shape[0]
                     from cyclegan_tpu.serve.engine import preprocess_request
@@ -127,18 +153,50 @@ def make_handler(app: ServeApp):
                 app.count()
                 self._reply(200, body, ctype="image/png")
             except Exception as e:  # noqa: BLE001 — a request must not kill the server
-                app.count(error=True)
-                self._reply(500, json.dumps(
-                    {"error": f"{type(e).__name__}: {e}"}).encode())
+                # admission.py has no engine/jax dependency, so this
+                # import is cheap even on the error path.
+                from cyclegan_tpu.serve.fleet.admission import (
+                    DeadlineExceeded,
+                    ShedError,
+                )
+
+                if isinstance(e, ShedError):
+                    # Load shed: tell the client when to come back
+                    # instead of letting it pile onto the queue.
+                    app.count(shed=True)
+                    body = json.dumps({
+                        "error": "overloaded",
+                        "reason": e.reason,
+                        "class": e.klass,
+                        "retry_after_s": round(e.retry_after_s, 3),
+                    }).encode()
+                    self.send_response(429)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After",
+                                     str(max(1, int(e.retry_after_s))))
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif isinstance(e, DeadlineExceeded):
+                    app.count(shed=True)
+                    self._reply(503, json.dumps(
+                        {"error": "deadline exceeded in queue",
+                         "detail": str(e)}).encode())
+                else:
+                    app.count(error=True)
+                    self._reply(500, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode())
 
     return Handler
 
 
 def make_server(executor, host: str = "127.0.0.1", port: int = 0,
-                with_cycle: bool = False):
+                with_cycle: bool = False, fleet: bool = False):
     """Build (but do not start) the HTTP server; port 0 picks a free
-    one (server.server_address reports it). Returns (server, app)."""
-    app = ServeApp(executor, with_cycle)
+    one (server.server_address reports it). Returns (server, app).
+    ``fleet=True`` routes ?class=/?tier= through FleetExecutor.submit
+    and maps shed requests to 429 + Retry-After."""
+    app = ServeApp(executor, with_cycle, fleet=fleet)
     server = ThreadingHTTPServer((host, port), make_handler(app))
     server.daemon_threads = True
     return server, app
@@ -167,6 +225,19 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--panels", action="store_true",
                    help="compile the fused forward+cycle program so "
                         "?panels=1 works (costs a second generator pass)")
+    p.add_argument("--fleet", default=0, type=int, metavar="N",
+                   help="fleet mode: N replica workers behind one "
+                        "admission-controlled EDF queue (0 = classic "
+                        "single-replica pipeline)")
+    p.add_argument("--capacity", default=256, type=int,
+                   help="fleet admission queue bound; past it requests "
+                        "shed (429 + Retry-After), lowest class first")
+    p.add_argument("--default_class", default="batch",
+                   choices=["interactive", "batch", "best_effort"],
+                   help="deadline class for requests without ?class=")
+    p.add_argument("--int8", action="store_true",
+                   help="also compile the int8 weight-quantized program "
+                        "tier (?tier=int8 routes to it)")
     p.add_argument("--obs_jsonl", default=None,
                    help="telemetry stream path (PR-1 schema; fold with "
                         "tools/obs_report.py)")
@@ -206,25 +277,44 @@ def main(argv: Optional[list] = None) -> None:
                      **build_manifest(config, query_devices=False,
                                       role="serve"))
 
+    if args.int8 and args.panels:
+        raise SystemExit("--int8 and --panels are mutually exclusive "
+                         "(the int8 tier has no fused cycle program)")
     serve_cfg = ServeConfig(
         batch_buckets=tuple(sorted({1, args.batch_bucket})),
         sizes=(model_cfg.image_size,),
         dtype=args.dtype or model_cfg.compute_dtype,
         with_cycle=args.panels,
+        int8_tier=args.int8,
     )
-    print(f"compiling {len(serve_cfg.batch_buckets) * len(serve_cfg.sizes)} "
-          f"serve programs (warm cache makes this instant — "
-          f"tools/cache_warm.py)...", flush=True)
+    n_progs = (len(serve_cfg.batch_buckets) * len(serve_cfg.sizes)
+               * (2 if args.int8 else 1))
+    print(f"compiling {n_progs} serve programs (warm cache makes this "
+          f"instant — tools/cache_warm.py)...", flush=True)
     engine = InferenceEngine(model_cfg, fwd_params, bwd_params,
                              serve_cfg=serve_cfg, logger=logger)
-    executor = PipelinedExecutor(engine, max_wait_ms=args.max_wait_ms,
-                                 logger=logger)
+    if args.fleet > 0:
+        from cyclegan_tpu.serve.fleet import FleetConfig, FleetExecutor
+
+        executor = FleetExecutor(
+            engine,
+            FleetConfig(n_replicas=args.fleet, capacity=args.capacity,
+                        max_wait_ms=args.max_wait_ms,
+                        default_class=args.default_class),
+            logger=logger)
+    else:
+        executor = PipelinedExecutor(engine, max_wait_ms=args.max_wait_ms,
+                                     logger=logger)
     server, _app = make_server(executor, args.host, args.port,
-                               with_cycle=args.panels)
+                               with_cycle=args.panels,
+                               fleet=args.fleet > 0)
     host, port = server.server_address[:2]
+    mode = (f"fleet x{args.fleet} (capacity {args.capacity})"
+            if args.fleet > 0 else "pipelined")
     print(f"serving on http://{host}:{port}  "
           f"(buckets {serve_cfg.batch_buckets} @ {serve_cfg.sizes}, "
-          f"dtype {serve_cfg.dtype})", flush=True)
+          f"dtype {serve_cfg.dtype}, tiers {engine.tiers}, {mode})",
+          flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

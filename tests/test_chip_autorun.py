@@ -52,10 +52,11 @@ def test_queue_order_and_budgets():
     q = build_queue("remote")
     names = [s.name for s in q]
     # Highest value first (VERDICT r4 item 1): health probe, official
-    # number cold then warm, the pad lever, 512^2 rows, trace, e2e run.
+    # number cold then warm, the pad lever, 512^2 rows, the serving
+    # sweep, trace, e2e run.
     assert names == ["diag", "bench_cold", "bench_warm", "pad_sweep",
-                     "epilogue_sweep", "accum512", "scan512", "trace",
-                     "timed_main"]
+                     "epilogue_sweep", "accum512", "scan512",
+                     "serve_sweep", "trace", "timed_main"]
     by = {s.name: s for s in q}
     assert by["diag"].abort_queue_on_fail  # diag failing = relay sick
     # cold run gets the cache-warming budget; warm run is the record
@@ -99,6 +100,20 @@ def test_epilogue_sweep_always_forces_local_compile():
         assert s.env["CYCLEGAN_AXON_LOCAL_COMPILE"] == "1"
         assert s.env["PALLAS_AXON_POOL_IPS"] == ""
         assert "scan:b16epi" in s.argv
+
+
+def test_serve_sweep_keeps_the_one_json_line_contract():
+    """The serving sweep lands like the bench steps: stdout captured to
+    a round-tagged docs JSON (validated before commit), with an explicit
+    time budget the step timeout outlives."""
+    for mode in ("remote", "local_compile"):
+        s = {st.name: st for st in build_queue(mode)}["serve_sweep"]
+        assert s.argv[-1].endswith("bench_serve.py")
+        assert s.stdout_to.startswith("docs") and \
+            s.stdout_to.endswith("_onchip.json")
+        assert "bench_serve" in s.stdout_to
+        budget = float(s.env["BENCH_SERVE_TIME_BUDGET_S"])
+        assert budget + 120 <= s.timeout_s  # SIGALRM partial-line slack
 
 
 def test_timed_main_writes_outside_repo():

@@ -1,0 +1,534 @@
+"""Fleet serving layer (cyclegan_tpu/serve/fleet): admission control,
+EDF dispatch order, class-ordered load shedding, backpressure bounds,
+continuous-batching refill, the HTTP 429 path, and the int8 tier.
+
+The queueing/dispatch tests run against a fake engine (deterministic,
+no compiles) so they probe the fleet's control plane, not XLA. The int8
+tests use the real tiny engine at 16 px so both program tiers compile
+in seconds on the CPU mesh.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from cyclegan_tpu.config import GeneratorConfig, ModelConfig  # noqa: E402
+from cyclegan_tpu.serve.fleet import (  # noqa: E402
+    AdmissionController,
+    DEFAULT_CLASSES,
+    DeadlineClass,
+    DeadlineExceeded,
+    FleetConfig,
+    FleetExecutor,
+    ShedError,
+    class_map,
+)
+from cyclegan_tpu.serve.fleet.admission import FleetRequest  # noqa: E402
+
+CLASSES = class_map(DEFAULT_CLASSES)
+INTERACTIVE, BATCH, BEST_EFFORT = (CLASSES["interactive"],
+                                   CLASSES["batch"],
+                                   CLASSES["best_effort"])
+
+
+def _req(klass, size=32, tier="base", now=None):
+    return FleetRequest(np.zeros((size, size, 3), np.float32),
+                        size, tier, klass, now=now)
+
+
+class FakeEngine:
+    """Engine-shaped test double: same routing surface the fleet uses
+    (programs / buckets / tiers / run), with controllable flush latency
+    and an optional gate that stalls flushes until released."""
+
+    def __init__(self, sizes=(32,), buckets=(1, 4), tiers=("base",),
+                 flush_s=0.0):
+        self.programs = {(s, b): object()
+                         for s in sizes for b in buckets}
+        self._sizes = tuple(sorted(sizes))
+        self._buckets = tuple(sorted(buckets))
+        self._tiers = tuple(tiers)
+        self.flush_s = flush_s
+        self.gate = None  # threading.Event: run() waits on it when set
+        self.entered = threading.Event()  # set each time run() starts
+        self.flushes = []  # (n, size, tier, class names) log
+        self._lock = threading.Lock()
+
+    @property
+    def max_batch(self):
+        return self._buckets[-1]
+
+    @property
+    def tiers(self):
+        return self._tiers
+
+    def resolve_tier(self, tier):
+        if tier is None or tier == "base":
+            return "base"
+        if tier in self._tiers:
+            return tier
+        raise ValueError(f"unknown tier {tier!r}; have {self._tiers}")
+
+    def batch_bucket(self, n):
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return None
+
+    def size_bucket(self, h, w):
+        side = max(h, w)
+        for s in self._sizes:
+            if side <= s:
+                return s
+        return self._sizes[-1]
+
+    def run(self, batch_np, size=None, tier=None):
+        self.entered.set()
+        if self.gate is not None:
+            assert self.gate.wait(timeout=30)
+        if self.flush_s:
+            time.sleep(self.flush_s)
+        with self._lock:
+            self.flushes.append((len(batch_np), size, tier))
+        return (batch_np.copy(),), len(batch_np)
+
+
+# -- deadline classes ------------------------------------------------------
+
+def test_default_classes_are_strictly_ordered():
+    assert (INTERACTIVE.deadline_ms < BATCH.deadline_ms
+            < BEST_EFFORT.deadline_ms)
+    assert (INTERACTIVE.shed_rank < BATCH.shed_rank
+            < BEST_EFFORT.shed_rank)
+    with pytest.raises(ValueError):
+        DeadlineClass("bad", deadline_ms=0, shed_rank=0)
+    with pytest.raises(ValueError):
+        DeadlineClass("bad", deadline_ms=100, shed_rank=-1)
+
+
+# -- EDF ordering ----------------------------------------------------------
+
+def test_admission_pops_in_edf_order():
+    """A later-arriving interactive request overtakes every queued batch
+    request: the pop order is absolute deadline, not arrival."""
+    adm = AdmissionController(capacity=16)
+    t0 = time.perf_counter()
+    early_batch = _req(BATCH, now=t0)
+    late_batch = _req(BATCH, now=t0 + 0.001)
+    interactive = _req(INTERACTIVE, now=t0 + 0.002)  # arrives LAST
+    for r in (early_batch, late_batch, interactive):
+        adm.offer(r)
+    batch = adm.next_batch(max_n=3, max_wait_s=0.0)
+    assert batch == [interactive, early_batch, late_batch]
+
+
+def test_edf_degrades_to_fifo_within_a_class():
+    adm = AdmissionController(capacity=16)
+    t0 = time.perf_counter()
+    reqs = [_req(BATCH, now=t0 + i * 1e-4) for i in range(4)]
+    for r in reversed(reqs):  # offer out of order
+        adm.offer(r)
+    assert adm.next_batch(max_n=4, max_wait_s=0.0) == reqs
+
+
+def test_batches_stay_homogeneous_in_size_and_tier():
+    """Non-matching entries are put back, not dropped: the next pop
+    serves them."""
+    adm = AdmissionController(capacity=16)
+    t0 = time.perf_counter()
+    a = _req(INTERACTIVE, size=32, now=t0)
+    b = _req(INTERACTIVE, size=16, now=t0 + 1e-4)
+    c = _req(INTERACTIVE, size=32, now=t0 + 2e-4)
+    for r in (a, b, c):
+        adm.offer(r)
+    assert adm.next_batch(max_n=4, max_wait_s=0.0) == [a, c]
+    assert adm.next_batch(max_n=4, max_wait_s=0.0) == [b]
+
+
+def test_expired_sheddable_dropped_expired_interactive_served():
+    """A best_effort request whose deadline passed while queued is
+    dropped at pop time (DeadlineExceeded); an expired interactive
+    request still serves — late beats never for a user-facing reply."""
+    tight = DeadlineClass("tick", deadline_ms=1, shed_rank=2)
+    tight_inter = DeadlineClass("itick", deadline_ms=1, shed_rank=0)
+    adm = AdmissionController(capacity=16)
+    doomed = _req(tight)
+    kept = _req(tight_inter)
+    adm.offer(doomed)
+    adm.offer(kept)
+    time.sleep(0.02)  # both deadlines pass while queued
+    batch = adm.next_batch(max_n=4, max_wait_s=0.0)
+    assert batch == [kept]
+    with pytest.raises(DeadlineExceeded):
+        doomed.future.result(timeout=1)
+    assert adm.stats()["shed_reasons"] == {"expired": 1}
+
+
+# -- class-ordered shedding + backpressure bounds --------------------------
+
+def test_shedding_evicts_lowest_class_first():
+    adm = AdmissionController(capacity=2)
+    be = _req(BEST_EFFORT)
+    ba = _req(BATCH)
+    adm.offer(be)
+    adm.offer(ba)
+    # Queue full. Interactive arrival evicts best_effort (not batch).
+    inter = _req(INTERACTIVE)
+    fut = adm.offer(inter)
+    with pytest.raises(ShedError) as ei:
+        be.future.result(timeout=1)
+    assert ei.value.reason == "evicted" and ei.value.klass == "best_effort"
+    assert ei.value.retry_after_s >= 1.0
+    assert not fut.done() and not ba.future.done()
+    # Another interactive arrival now evicts batch (next rank up).
+    adm.offer(_req(INTERACTIVE))
+    with pytest.raises(ShedError) as ei:
+        ba.future.result(timeout=1)
+    assert ei.value.klass == "batch"
+
+
+def test_shedding_rejects_when_no_lower_class_queued():
+    """best_effort arriving at a queue full of equal-or-higher classes
+    is itself rejected — ShedError raised AT THE CALLER (the 429 path),
+    never an eviction of better work."""
+    adm = AdmissionController(capacity=2)
+    adm.offer(_req(INTERACTIVE))
+    adm.offer(_req(BATCH))
+    with pytest.raises(ShedError) as ei:
+        adm.offer(_req(BEST_EFFORT))
+    assert ei.value.reason == "rejected"
+    assert ei.value.retry_after_s >= 1.0
+    # Same-class arrival at a same-class-full queue also rejects
+    # (no victim has a STRICTLY lower class).
+    with pytest.raises(ShedError):
+        adm.offer(_req(BATCH))
+    stats = adm.stats()
+    assert stats["depth"] == 2 and stats["max_depth"] <= adm.capacity
+    assert stats["shed"] == {"best_effort": 1, "batch": 1}
+    assert stats["shed_reasons"] == {"rejected": 2}
+
+
+def test_admission_depth_never_exceeds_capacity():
+    adm = AdmissionController(capacity=4)
+    admitted, shed = 0, 0
+    for _ in range(20):
+        try:
+            adm.offer(_req(BATCH))
+            admitted += 1
+        except ShedError:
+            shed += 1
+    assert admitted == 4 and shed == 16
+    assert adm.stats()["max_depth"] == 4
+
+
+# -- fleet executor end-to-end (fake engine) -------------------------------
+
+def test_fleet_serves_interactive_before_earlier_batch():
+    """With the single replica pinned busy, queued requests re-order by
+    class: the interactive request submitted LAST is flushed first once
+    the replica frees."""
+    eng = FakeEngine()
+    eng.gate = threading.Event()
+    fleet = FleetExecutor(eng, FleetConfig(
+        n_replicas=1, capacity=16, max_batch=1, max_wait_ms=0.0))
+    img = np.zeros((32, 32, 3), np.float32)
+    order, order_lock = [], threading.Lock()
+
+    def tag(name, fut):
+        def cb(_):
+            with order_lock:
+                order.append(name)
+        fut.add_done_callback(cb)
+        return fut
+
+    pin = tag("pin", fleet.submit(img, klass="batch"))  # occupies the replica
+    assert eng.entered.wait(timeout=10)
+    futs_batch = [tag(f"batch{i}", fleet.submit(img, klass="batch"))
+                  for i in range(2)]
+    fut_inter = tag("interactive", fleet.submit(img, klass="interactive"))
+    eng.gate.set()
+    for f in [pin, fut_inter] + futs_batch:
+        assert f.result(timeout=30)["fake"].shape == (32, 32, 3)
+    summary = fleet.close()
+    # The pin resolves first (it was already on the replica); the
+    # interactive request — submitted last — overtakes both queued
+    # batch requests.
+    assert order == ["pin", "interactive", "batch0", "batch1"]
+    assert summary["classes"]["interactive"]["deadline_misses"] == 0
+    assert summary["shed"] == {}
+
+
+def test_fleet_sheds_best_effort_before_interactive_misses():
+    """The acceptance shape: saturate a tiny fleet with best_effort,
+    sprinkle interactive on top — best_effort sheds (submit-time 429s
+    and/or evictions) while interactive serves with zero deadline
+    misses and nothing interactive shed."""
+    eng = FakeEngine(flush_s=0.005)
+    fleet = FleetExecutor(eng, FleetConfig(
+        n_replicas=1, capacity=4, max_batch=4, max_wait_ms=1.0))
+    img = np.zeros((32, 32, 3), np.float32)
+    futs, rejected = [], 0
+    for i in range(40):
+        try:
+            futs.append(fleet.submit(img, klass="best_effort"))
+        except ShedError as e:
+            assert e.klass == "best_effort"
+            rejected += 1
+        if i % 10 == 9:
+            futs.append(fleet.submit(img, klass="interactive"))
+    done = 0
+    for f in futs:
+        try:
+            f.result(timeout=30)
+            done += 1
+        except (ShedError, DeadlineExceeded):
+            pass
+    summary = fleet.close()
+    assert done >= 4  # the fleet still made progress under overload
+    shed = summary["shed"]
+    assert shed.get("best_effort", 0) + rejected > 0
+    assert "interactive" not in shed
+    assert summary["classes"]["interactive"]["deadline_misses"] == 0
+
+
+def test_fleet_refills_partial_buckets_while_replica_busy():
+    """Continuous batching: with one replica held down by a full slow
+    flush, later arrivals go out to the second replica as a PARTIAL
+    bucket at the wait-window edge, flagged ``refill``."""
+    eng = FakeEngine(flush_s=0.15)
+    fleet = FleetExecutor(eng, FleetConfig(
+        n_replicas=2, capacity=64, max_batch=4, max_wait_ms=20.0))
+    img = np.zeros((32, 32, 3), np.float32)
+    full = [fleet.submit(img) for _ in range(4)]  # full flush, replica A
+    assert eng.entered.wait(timeout=10)
+    time.sleep(0.01)
+    partial = [fleet.submit(img) for _ in range(2)]  # lands on replica B
+    for f in full + partial:
+        f.result(timeout=30)
+    summary = fleet.close()
+    assert summary["refill_flushes"] >= 1
+    assert summary["n_images"] == 6
+    fills = sorted(n for n, _, _ in eng.flushes)
+    assert fills == [2, 4]
+
+
+def test_fleet_config_and_submit_validation():
+    eng = FakeEngine()
+    with pytest.raises(ValueError, match="n_replicas"):
+        FleetConfig(n_replicas=0)
+    with pytest.raises(ValueError, match="default_class"):
+        FleetConfig(default_class="platinum")
+    with pytest.raises(ValueError, match="exceeds"):
+        FleetExecutor(eng, FleetConfig(max_batch=64))
+    # A class routed to a tier the engine never compiled fails at
+    # construction, not per-request.
+    with pytest.raises(ValueError, match="tier"):
+        FleetExecutor(eng, FleetConfig(
+            classes=(DeadlineClass("fast", 500, 0, tier="int8"),),
+            default_class="fast"))
+    fleet = FleetExecutor(eng, FleetConfig(n_replicas=1))
+    img = np.zeros((32, 32, 3), np.float32)
+    with pytest.raises(KeyError, match="platinum"):
+        fleet.submit(img, klass="platinum")
+    with pytest.raises(ValueError, match="tier"):
+        fleet.submit(img, tier="int8")
+    fleet.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        fleet.submit(img)
+    assert fleet.close() == {}  # idempotent
+
+
+def test_fleet_stats_snapshot_shape():
+    eng = FakeEngine()
+    fleet = FleetExecutor(eng, FleetConfig(n_replicas=2))
+    img = np.zeros((32, 32, 3), np.float32)
+    for _ in range(3):
+        fleet.submit(img).result(timeout=30)
+    snap = fleet.stats()
+    assert snap["n_replicas"] == 2
+    assert snap["admission"]["capacity"] == 256
+    assert snap["n_images_done"] == 3
+    assert "batch" in snap["classes"]
+    assert snap["tiers"] == ["base"]
+    fleet.close()
+
+
+# -- HTTP front-end: 429 + Retry-After -------------------------------------
+
+def test_http_fleet_sheds_with_429_and_retry_after():
+    import io
+    import json
+    import urllib.error
+    import urllib.request
+
+    from cyclegan_tpu.serve.server import make_server
+
+    eng = FakeEngine()
+    eng.gate = threading.Event()
+    fleet = FleetExecutor(eng, FleetConfig(
+        n_replicas=1, capacity=1, max_batch=1, max_wait_ms=0.0))
+    server, app = make_server(fleet, port=0, fleet=True)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        img = np.zeros((32, 32, 3), np.float32)
+        # Pin the replica, then fill the 1-slot queue.
+        pinned = fleet.submit(img, klass="best_effort")
+        assert eng.entered.wait(timeout=10)
+        queued = fleet.submit(img, klass="best_effort")
+
+        buf = io.BytesIO()
+        np.save(buf, np.zeros((32, 32, 3), np.uint8))
+        req = urllib.request.Request(
+            f"http://{host}:{port}/translate?class=best_effort",
+            data=buf.getvalue(), method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        body = json.loads(ei.value.read())
+        assert body["error"] == "overloaded"
+        assert body["class"] == "best_effort"
+        assert body["retry_after_s"] >= 1.0
+
+        eng.gate.set()
+        pinned.result(timeout=30)
+        queued.result(timeout=30)
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/stats", timeout=30) as r:
+            stats = json.loads(r.read())
+        assert stats["fleet"] is True and stats["n_shed"] == 1
+        assert stats["admission"]["shed"] == {"best_effort": 1}
+    finally:
+        server.shutdown()
+        fleet.close()
+
+
+# -- int8 tier (real engine) -----------------------------------------------
+
+def _tiny_model_cfg():
+    return ModelConfig(
+        generator=GeneratorConfig(filters=4, num_residual_blocks=1),
+        image_size=16,
+        compute_dtype="float32",
+    )
+
+
+@pytest.fixture(scope="module")
+def int8_engine():
+    import jax
+    import jax.numpy as jnp
+
+    from cyclegan_tpu.serve.engine import (
+        InferenceEngine,
+        ServeConfig,
+        build_generator,
+    )
+
+    cfg = _tiny_model_cfg()
+    gen = build_generator(cfg)
+    params = gen.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, 16, 16, 3), jnp.float32))
+    return InferenceEngine(
+        cfg, params,
+        serve_cfg=ServeConfig(batch_buckets=(2,), sizes=(16,),
+                              dtype="float32", int8_tier=True))
+
+
+def test_int8_quantize_roundtrip_error_is_small_but_nonzero():
+    """Per-output-channel symmetric quantization: the dequantized weight
+    differs from the original (it IS lossy) but by at most one quant
+    step of that channel's scale."""
+    from cyclegan_tpu.serve.engine import (
+        dequantize_params,
+        quantize_params_int8,
+    )
+
+    rng = np.random.RandomState(0)
+    params = {"conv": {"kernel": rng.randn(3, 3, 4, 8)
+                       .astype(np.float32)},
+              "bias": rng.randn(8).astype(np.float32)}
+    q = quantize_params_int8(params)
+    leaf = q["conv"]["kernel"]
+    assert set(leaf) == {"int8_q", "int8_scale"}
+    assert np.asarray(leaf["int8_q"]).dtype == np.int8
+    assert np.asarray(leaf["int8_scale"]).shape == (1, 1, 1, 8)
+    # 1-D leaves (biases, norm params) stay full precision.
+    assert np.asarray(q["bias"]).dtype == np.float32
+    dq = dequantize_params(q)
+    err = np.abs(np.asarray(dq["conv"]["kernel"])
+                 - params["conv"]["kernel"])
+    assert float(err.max()) > 0.0  # lossy, really quantized
+    step = np.asarray(leaf["int8_scale"])
+    assert np.all(err <= step * 0.5 + 1e-7)  # round-to-nearest bound
+    np.testing.assert_array_equal(np.asarray(dq["bias"]),
+                                  params["bias"])
+
+
+def test_int8_tier_compiles_and_tracks_base(int8_engine):
+    eng = int8_engine
+    assert eng.tiers == ("base", "int8")
+    assert set(eng.programs_int8) == set(eng.programs)
+    assert eng.resolve_tier(None) == "base"
+    assert eng.resolve_tier("base") == "base"
+    assert eng.resolve_tier("int8") == "int8"
+    with pytest.raises(ValueError):
+        eng.resolve_tier("fp4")
+    x = np.random.RandomState(1).uniform(
+        -1, 1, (2, 16, 16, 3)).astype(np.float32)
+    base = np.asarray(eng.run(x, size=16)[0][0])
+    int8 = np.asarray(eng.run(x, size=16, tier="int8")[0][0])
+    assert int8.dtype == np.float32  # f32 accumulate/output
+    assert np.all(np.isfinite(int8))
+    # Weight-only int8 over an instance-norm trunk: outputs stay close
+    # to the f32 program (tanh-bounded, so absolute tolerance).
+    assert float(np.max(np.abs(int8 - base))) < 0.05
+
+
+def test_int8_tier_refuses_fused_cycle():
+    from cyclegan_tpu.serve.engine import ServeConfig
+
+    with pytest.raises(ValueError, match="int8"):
+        ServeConfig(with_cycle=True, int8_tier=True)
+
+
+def test_base_engine_rejects_int8_tier_requests(int8_engine):
+    import jax
+    import jax.numpy as jnp
+
+    from cyclegan_tpu.serve.engine import (
+        InferenceEngine,
+        ServeConfig,
+        build_generator,
+    )
+
+    cfg = _tiny_model_cfg()
+    gen = build_generator(cfg)
+    params = gen.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, 16, 16, 3), jnp.float32))
+    eng = InferenceEngine(cfg, params,
+                          serve_cfg=ServeConfig(batch_buckets=(2,),
+                                                sizes=(16,)))
+    assert eng.tiers == ("base",)
+    with pytest.raises(ValueError, match="int8"):
+        eng.resolve_tier("int8")
+
+
+# -- hot-path no-sync coverage ---------------------------------------------
+
+def test_no_sync_check_covers_fleet_directory():
+    from check_no_sync import hot_path_entries, run_check
+
+    entries = dict(hot_path_entries())
+    for mod in ("admission", "classes", "controller", "replica",
+                "__init__"):
+        assert entries.get(f"cyclegan_tpu/serve/fleet/{mod}.py") is True
+    assert run_check() == []

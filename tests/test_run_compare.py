@@ -121,6 +121,84 @@ def test_mixed_artifact_kinds_fail():
     assert checks[0][0] == FAIL and checks[0][1] == "kind"
 
 
+# ------------------------------------------------- serving axis
+
+
+def _serve_record(**over):
+    rec = {
+        "metric": "cyclegan_serve_images_per_sec_1chip",
+        "value": 150.0, "unit": "images/sec", "platform": "cpu",
+        "config": "serve/float32/b4/i64",
+        "latency_low_load_ms": {"p50_ms": 12.0, "p95_ms": 14.0},
+        "latency_saturated_ms": {"p50_ms": 80.0, "p95_ms": 140.0},
+        "fleet": {
+            "n_replicas": 2, "images_per_sec": 165.0,
+            "latency_saturated_ms": {"p50_ms": 130.0, "p95_ms": 140.0},
+            "overload": {
+                "shed_by_class": {"best_effort": 5},
+                "interactive_p95_ms": 70.0, "batch_p95_ms": 75.0,
+            },
+        },
+        "int8": {"images_per_sec": 168.0, "p95_ms": 136.0},
+    }
+    rec.update(over)
+    return rec
+
+
+def test_serve_profile_extracts_fleet_and_classes():
+    p = run_compare.serve_profile(_serve_record(), "x.json")
+    assert p["kind"] == "serve"
+    assert p["value"] == pytest.approx(150.0)
+    assert p["fleet_ips"] == pytest.approx(165.0)
+    assert p["int8_ips"] == pytest.approx(168.0)
+    assert p["p95_ms"]["low_load"] == pytest.approx(14.0)
+    assert p["p95_ms"]["overload interactive"] == pytest.approx(70.0)
+    assert p["shed_by_class"] == {"best_effort": 5}
+
+
+def test_serve_pair_passes_and_gates_regressions(tmp_path):
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_serve_record()) + "\n")
+    same = tmp_path / "same.json"
+    same.write_text(json.dumps(_serve_record()) + "\n")
+    assert run_compare.run([str(base), str(same)], make_thresholds(),
+                           out=io.StringIO()) == 0
+    # A fleet-throughput collapse and a p95 blowup each trip the gate.
+    slow = tmp_path / "slow.json"
+    bad_fleet = _serve_record()
+    bad_fleet["fleet"] = dict(bad_fleet["fleet"], images_per_sec=100.0)
+    slow.write_text(json.dumps(bad_fleet) + "\n")
+    assert run_compare.run([str(base), str(slow)], make_thresholds(),
+                           out=io.StringIO()) == 1
+    lat = tmp_path / "lat.json"
+    bad_lat = _serve_record(
+        latency_low_load_ms={"p50_ms": 12.0, "p95_ms": 50.0})
+    lat.write_text(json.dumps(bad_lat) + "\n")
+    assert run_compare.run([str(base), str(lat)], make_thresholds(),
+                           out=io.StringIO()) == 1
+
+
+def test_serve_shed_ordering_invariant():
+    """A candidate that shed interactive while best_effort went unshed
+    violates the class-ordering contract — FAIL regardless of speed."""
+    base = run_compare.serve_profile(_serve_record(), "base.json")
+    bad = _serve_record()
+    bad["fleet"] = dict(bad["fleet"],
+                        overload={"shed_by_class": {"interactive": 2},
+                                  "interactive_p95_ms": 70.0})
+    cand = run_compare.serve_profile(bad, "cand.json")
+    checks = compare_profiles(base, cand, make_thresholds())
+    assert (FAIL, "serve shed ordering") in [(s, a) for s, a, _ in checks]
+
+
+def test_serve_cross_platform_pair_skips():
+    base = run_compare.serve_profile(_serve_record(), "base.json")
+    cand = run_compare.serve_profile(_serve_record(platform="tpu"),
+                                     "cand.json")
+    checks = compare_profiles(base, cand, make_thresholds())
+    assert [s for s, _, _ in checks] == [SKIP]
+
+
 # ------------------------------------------------- committed BENCH series
 
 

@@ -292,6 +292,25 @@ def test_executor_end_to_end_with_telemetry(engine, tmp_path):
     assert "serving:" in text and "serve summary:" in text
 
 
+def test_executor_public_stats_snapshot(engine):
+    """stats() is the executor's PUBLIC snapshot — the HTTP /stats
+    handler consumes exactly this, never `executor._batchers`. It must
+    surface the batcher high-water mark and per-bucket depths."""
+    ex = PipelinedExecutor(engine, max_wait_ms=5.0)
+    futs = [ex.submit(_images(1)[0]) for _ in range(3)]
+    for f in futs:
+        f.result(timeout=120)
+    snap = ex.stats()
+    assert set(snap) >= {"queue_depths", "max_queue_depth", "n_flushes",
+                         "n_queued_requests", "n_images_done", "tiers"}
+    assert snap["n_queued_requests"] == 3
+    assert snap["n_images_done"] == 3
+    assert snap["max_queue_depth"] >= 1
+    assert "32/base" in snap["queue_depths"]
+    assert snap["tiers"] == ["base"]
+    ex.close()
+
+
 def test_executor_rejects_unbucketed_max_batch(engine):
     with pytest.raises(ValueError, match="exceeds"):
         PipelinedExecutor(engine, max_batch=16)

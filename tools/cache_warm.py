@@ -145,6 +145,18 @@ def serve_programs() -> list:
                     "pad_impl": "pad", "accum": None, "with_cycle": False,
                     "covers": [f"serve/{dtype}/b{batch}/i{size}"],
                 })
+        # The int8 weight-quantized tier (server --int8 / fleet class
+        # routing): f32 accumulate over per-channel-dequantized weights,
+        # one program per bucket — same grammar as the base tier.
+        for batch in DEFAULT_BATCH_BUCKETS:
+            progs.append({
+                "key": f"serve int8:b{batch}i{size}",
+                "mode": "serve", "dtype": "float32", "batch": batch,
+                "image": size, "k": 1, "pad_mode": "reflect",
+                "pad_impl": "pad", "accum": None, "with_cycle": False,
+                "quantized": True,
+                "covers": [f"serve/int8/b{batch}/i{size}"],
+            })
         # The --panels fused two-pass program, largest bucket only
         # (panel requests are batch-CLI traffic, not the server's
         # low-latency path).
@@ -176,11 +188,20 @@ def _lower(prog: dict):
         from cyclegan_tpu.serve.engine import (
             lower_forward,
             param_specs,
+            quantized_param_specs,
             serve_model_config,
         )
 
         model_cfg = serve_model_config(prog["dtype"], image)
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            if prog.get("quantized"):
+                # The int8 tier's params enter as the quantized tree
+                # (int8 weights + f32 scales); eval_shape turns the
+                # startup quantization into pure avals — identical
+                # trace to InferenceEngine's int8_tier compile.
+                p_spec = quantized_param_specs(model_cfg, (image,))
+                return lower_forward(model_cfg, p_spec, None, batch,
+                                     image, False, quantized=True)
             p_spec = param_specs(model_cfg, (image,))
         bwd = p_spec if prog.get("with_cycle") else None
         return lower_forward(model_cfg, p_spec, bwd, batch, image,

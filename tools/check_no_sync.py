@@ -64,10 +64,16 @@ HOT_PATH_FILES: List[Tuple[str, bool]] = [
 #   design is deferred fetches — the completer thread's one bounded
 #   `device_get` per flush carries the marker; anything else (an
 #   engine/batcher/server sync) would re-serialize the pipeline.
+# - serve/fleet (sanctioned sites allowed): listed separately because
+#   the directory scan is deliberately non-recursive; the replica
+#   worker's one deferred fetch per flush is the package's only
+#   sanctioned sync — admission/dispatch must stay pure host-side
+#   queueing.
 HOT_PATH_DIRS: List[Tuple[str, bool]] = [
     ("cyclegan_tpu/obs", False),
     ("cyclegan_tpu/ops/pallas", False),
     ("cyclegan_tpu/serve", True),
+    ("cyclegan_tpu/serve/fleet", True),
 ]
 
 

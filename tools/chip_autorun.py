@@ -10,7 +10,8 @@ session:
 
     diag -> bench cold -> bench warm -> pad A/B sweep (zero/fused)
     -> epilogue sweep (pad_impl=epilogue, local-compile forced)
-    -> accum 512^2 row -> 512^2 scan rows -> profiler trace
+    -> accum 512^2 row -> 512^2 scan rows -> serving sweep
+    (bench_serve: pipeline + fleet + int8 tiers) -> profiler trace
     -> timed main.py run
 
 Each step is a subprocess with a generous timeout, stdout+stderr teed
@@ -187,6 +188,16 @@ def build_queue(mode: str, round_tag: str = ROUND_TAG) -> list:
         Step("scan512",
              [py, "tools/chip_sweep.py", "scan:b4k2i512",
               "scan:b4k2zeroi512"], 3600.0, env=env, artifacts=[sweeps]),
+        # Serving open-loop sweep on chip (ROADMAP serving item): the
+        # bench_serve contract — serial baseline, saturated pipeline,
+        # offered-load curve, fleet/int8 tiers — lands as one JSON line,
+        # validated before commit like the bench steps. Budget covers
+        # the serve-program compiles (cache_warm pre-warms them) plus
+        # the sweep itself.
+        Step("serve_sweep", [py, "bench_serve.py"], 3600.0,
+             env={**env, "BENCH_SERVE_TIME_BUDGET_S": "1800"},
+             stdout_to=os.path.join(
+                 "docs", f"bench_serve_{round_tag}_onchip.json")),
         # Profiler trace of the headline config (runbook item 3):
         # attributes the unexplained 18% between the 337 ms measured
         # step and the 277 ms bandwidth floor.

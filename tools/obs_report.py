@@ -75,6 +75,9 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
         "serve_compiles": [],   # serve engine AOT program compiles
         "serve_flushes": [],    # per-flush serving events
         "serve_summary": None,  # executor close() rollup
+        "fleet_flushes": [],    # per-flush fleet dispatcher events
+        "fleet_sheds": [],      # admission-control shed decisions
+        "fleet_summary": None,  # FleetExecutor close() rollup
         "end": None,
     }
     for ev in events:
@@ -121,6 +124,12 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             report["serve_flushes"].append(ev)
         elif kind == "serve_summary":
             report["serve_summary"] = ev
+        elif kind == "fleet_flush":
+            report["fleet_flushes"].append(ev)
+        elif kind == "fleet_shed":
+            report["fleet_sheds"].append(ev)
+        elif kind == "fleet_summary":
+            report["fleet_summary"] = ev
         elif kind == "end":
             report["end"] = ev
         # unknown events: ignored by design
@@ -211,6 +220,40 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             "fetch_block_p50_s": _percentile(
                 [float(ev["fetch_block_s"]) for ev in flushes
                  if "fetch_block_s" in ev], .5),
+        }
+
+    # Fleet rollup: trigger mix (refill fraction = is continuous
+    # batching engaging?), per-replica flush balance, and the shed
+    # census by class and reason — overload behavior in one block.
+    ff = report["fleet_flushes"]
+    if ff or report["fleet_sheds"]:
+        triggers = {}
+        per_replica: Dict[str, int] = {}
+        for ev in ff:
+            trig = str(ev.get("trigger", "?"))
+            triggers[trig] = triggers.get(trig, 0) + 1
+            rep = str(ev.get("replica", "?"))
+            per_replica[rep] = per_replica.get(rep, 0) + 1
+        shed_class: Dict[str, int] = {}
+        shed_reason: Dict[str, int] = {}
+        for ev in report["fleet_sheds"]:
+            shed_class[str(ev.get("klass", "?"))] = \
+                shed_class.get(str(ev.get("klass", "?")), 0) + 1
+            shed_reason[str(ev.get("reason", "?"))] = \
+                shed_reason.get(str(ev.get("reason", "?")), 0) + 1
+        fills = [float(ev["n"]) / float(ev["bucket"]) for ev in ff
+                 if ev.get("n") and ev.get("bucket")]
+        report["fleet_rollup"] = {
+            "n_flushes": len(ff),
+            "n_images": sum(int(ev.get("n", 0)) for ev in ff),
+            "triggers": triggers,
+            "flushes_per_replica": per_replica,
+            "mean_fill": (sum(fills) / len(fills)) if fills else None,
+            "n_shed": len(report["fleet_sheds"]),
+            "shed_by_class": shed_class,
+            "shed_by_reason": shed_reason,
+            "max_queue_depth": max(
+                (int(ev.get("queue_depth", 0)) for ev in ff), default=0),
         }
     return report
 
@@ -459,6 +502,37 @@ def render(report: dict) -> str:
           f"p50 {_fmt(ss.get('latency_p50_s'))}s / "
           f"p95 {_fmt(ss.get('latency_p95_s'))}s / "
           f"p99 {_fmt(ss.get('latency_p99_s'))}s")
+
+    froll = report.get("fleet_rollup")
+    if froll:
+        w(f"-- fleet: {froll['n_images']} images in "
+          f"{froll['n_flushes']} flushes --")
+        trig = ", ".join(f"{k}={v}"
+                         for k, v in sorted(froll["triggers"].items()))
+        w(f"flush triggers: {trig}  (refill=continuous batching engaged)")
+        reps = ", ".join(f"r{k}={v}" for k, v in
+                         sorted(froll["flushes_per_replica"].items()))
+        w(f"flushes per replica: {reps}  mean fill "
+          f"{_fmt(froll.get('mean_fill'), '.3f')}  "
+          f"max queue depth: {froll['max_queue_depth']}")
+        if froll["n_shed"]:
+            by_c = ", ".join(f"{k}={v}" for k, v in
+                             sorted(froll["shed_by_class"].items()))
+            by_r = ", ".join(f"{k}={v}" for k, v in
+                             sorted(froll["shed_by_reason"].items()))
+            w(f"shed: {froll['n_shed']} ({by_c}; {by_r})")
+        else:
+            w("shed: none (never saturated past capacity)")
+    if report["fleet_summary"]:
+        fs = report["fleet_summary"]
+        w(f"fleet summary: {_fmt(fs.get('images_per_sec'), '.2f')} "
+          f"images/sec over {fs.get('n_replicas', '?')} replicas "
+          f"({fs.get('n_images', '?')} images, "
+          f"{fs.get('refill_flushes', '?')} refill flushes)")
+        for name, row in sorted((fs.get("classes") or {}).items()):
+            w(f"  class {name}: n={row.get('n', '?')} "
+              f"p50 {_fmt(row.get('p50_s'))}s / p95 {_fmt(row.get('p95_s'))}s"
+              f"  deadline misses: {row.get('deadline_misses', 0)}")
 
     end = report["end"]
     if end:

@@ -27,6 +27,14 @@ sweep dict (intersection of configs) plus the headline value.
 Cross-platform pairs (cpu seed rounds vs the first TPU round) are
 SKIPPED, not failed: the committed series legally changes platform.
 
+bench_serve records (metric `cyclegan_serve_*`) get a serving axis:
+saturated pipeline + fleet + int8-tier images/sec (each gated by
+--max_bench_drop), the p95 latency set — low-load, saturated, and the
+overload sweep's per-class p95s — gated by --max_serve_p95_increase,
+and the class-ordered-shedding invariant (a candidate that sheds
+`interactive` while `best_effort` goes unshed FAILS regardless of the
+base). The same cross-platform SKIP rule applies.
+
 With 3+ files the tool runs the consecutive-pair gate over the whole
 series (this is how bench.py's end-of-run hook uses it: newest
 committed round vs the record just produced).
@@ -61,6 +69,10 @@ def load_profile(path: str) -> dict:
     except ValueError:
         obj = None
     if isinstance(obj, dict) and ("parsed" in obj or "metric" in obj):
+        parsed = obj.get("parsed") if isinstance(obj.get("parsed"), dict) \
+            else obj
+        if str(parsed.get("metric", "")).startswith("cyclegan_serve"):
+            return serve_profile(obj, name=os.path.basename(path))
         return bench_profile(obj, name=os.path.basename(path))
     events = []
     skipped = 0
@@ -97,6 +109,47 @@ def bench_profile(record: dict, name: str = "?") -> dict:
             for k, v in (parsed.get("all") or {}).items()
             if (fv := _float(v)) is not None
         },
+    }
+
+
+def serve_profile(record: dict, name: str = "?") -> dict:
+    """Profile of one bench_serve.py summary record: the saturated
+    pipeline/fleet/int8 throughputs, every p95 the record carries
+    (low-load, saturated, overload per-class), and the overload shed
+    census (for the class-ordering invariant)."""
+    parsed = record.get("parsed") if isinstance(record.get("parsed"), dict) \
+        else record
+    fleet = parsed.get("fleet") if isinstance(parsed.get("fleet"), dict) \
+        else {}
+    int8 = parsed.get("int8") if isinstance(parsed.get("int8"), dict) \
+        else {}
+    overload = fleet.get("overload") \
+        if isinstance(fleet.get("overload"), dict) else {}
+    p95: Dict[str, float] = {}
+    for label, src in (("low_load", parsed.get("latency_low_load_ms")),
+                       ("saturated", parsed.get("latency_saturated_ms")),
+                       ("fleet_saturated",
+                        fleet.get("latency_saturated_ms"))):
+        if isinstance(src, dict) and (v := _float(src.get("p95_ms"))) \
+                is not None:
+            p95[label] = v
+    for k, v in overload.items():
+        if str(k).endswith("_p95_ms") and (fv := _float(v)) is not None:
+            p95[f"overload {str(k)[:-len('_p95_ms')]}"] = fv
+    shed = overload.get("shed_by_class") \
+        if isinstance(overload.get("shed_by_class"), dict) else {}
+    return {
+        "kind": "serve",
+        "name": name,
+        "platform": parsed.get("platform"),
+        "value": _float(parsed.get("value")),
+        "unit": parsed.get("unit"),
+        "config": parsed.get("config"),
+        "fleet_ips": _float(fleet.get("images_per_sec")),
+        "int8_ips": _float(int8.get("images_per_sec")),
+        "p95_ms": p95,
+        "shed_by_class": {str(k): int(v) for k, v in shed.items()
+                          if isinstance(v, (int, float))},
     }
 
 
@@ -168,6 +221,8 @@ def compare_profiles(base: dict, cand: dict, th: argparse.Namespace) -> List[Che
                  f"{cand['kind']} artifact")]
     if base["kind"] == "bench":
         return _compare_bench(base, cand, th)
+    if base["kind"] == "serve":
+        return _compare_serve(base, cand, th)
     return _compare_streams(base, cand, th)
 
 
@@ -203,6 +258,51 @@ def _compare_bench(base: dict, cand: dict, th) -> List[Check]:
                        + ", ".join(only_base)))
     if not checks:
         checks.append((SKIP, "bench", "no comparable values in either record"))
+    return checks
+
+
+def _compare_serve(base: dict, cand: dict, th) -> List[Check]:
+    checks: List[Check] = []
+    if base.get("platform") != cand.get("platform"):
+        return [(SKIP, "platform",
+                 f"platform changed {base.get('platform')} -> "
+                 f"{cand.get('platform')}: serving perf not comparable")]
+    for axis, key in (("serve headline", "value"),
+                      ("serve fleet", "fleet_ips"),
+                      ("serve int8", "int8_ips")):
+        bv, cv = base.get(key), cand.get(key)
+        if bv is None or cv is None:
+            checks.append((SKIP, axis,
+                           "missing in one record (older round?)"))
+            continue
+        drop = _rel_drop(bv, cv)
+        status = FAIL if drop > th.max_bench_drop else PASS
+        checks.append((status, axis,
+                       f"{bv:.2f} -> {cv:.2f} img/s (drop {100 * drop:.1f}% "
+                       f"vs limit {100 * th.max_bench_drop:.1f}%)"))
+    common_p95 = sorted(set(base["p95_ms"]) & set(cand["p95_ms"]))
+    for key in common_p95:
+        bv, cv = base["p95_ms"][key], cand["p95_ms"][key]
+        limit = bv * (1.0 + th.max_serve_p95_increase)
+        status = FAIL if cv > limit else PASS
+        checks.append((status, f"serve p95 {key}",
+                       f"{bv:.1f} -> {cv:.1f} ms (limit {limit:.1f})"))
+    if not common_p95:
+        checks.append((SKIP, "serve p95", "no common p95 rows"))
+    # Class-ordered shedding is an invariant of the CANDIDATE, not a
+    # diff: interactive shed while best_effort went unshed means the
+    # admission queue picked victims in the wrong order.
+    shed = cand.get("shed_by_class") or {}
+    if shed:
+        ordered = not (shed.get("interactive", 0) > 0
+                       and shed.get("best_effort", 0) == 0)
+        checks.append((PASS if ordered else FAIL, "serve shed ordering",
+                       f"overload shed {_fmt_kinds(shed)}"
+                       + ("" if ordered else
+                          " — interactive shed before best_effort")))
+    else:
+        checks.append((INFO, "serve shed ordering",
+                       "no overload shedding recorded"))
     return checks
 
 
@@ -314,6 +414,7 @@ def make_thresholds(
     max_gnorm_ratio: float = 5.0,
     max_new_faults: int = 0,
     max_bench_drop: float = 0.10,
+    max_serve_p95_increase: float = 0.50,
     json: bool = False,
 ) -> argparse.Namespace:
     """Programmatic threshold bundle (bench.py's end-of-run hook)."""
@@ -323,6 +424,7 @@ def make_thresholds(
         max_gnorm_ratio=max_gnorm_ratio,
         max_new_faults=max_new_faults,
         max_bench_drop=max_bench_drop,
+        max_serve_p95_increase=max_serve_p95_increase,
         json=json,
     )
 
@@ -346,6 +448,9 @@ def main(argv=None) -> int:
     parser.add_argument("--max_bench_drop", default=0.10, type=float,
                         help="max relative drop of bench images/sec "
                              "(headline and per-config)")
+    parser.add_argument("--max_serve_p95_increase", default=0.50, type=float,
+                        help="max relative increase of any serve p95 latency "
+                             "(per phase and class)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable report")
     args = parser.parse_args(argv)
