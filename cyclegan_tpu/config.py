@@ -76,6 +76,18 @@ class ModelConfig:
     #              all three (checkpoints interchange). Requires
     #              pad_mode="reflect" and a Pallas-capable norm impl.
     pad_impl: str = "pad"  # "pad" | "fused" | "epilogue"
+    # Generator trunk tier (no reference counterpart):
+    # "resnet"  = the reference's 3x3-conv residual blocks (model.py:136-146)
+    #             — parity baseline;
+    # "perturb" = Perturbative-GAN-style blocks (PAPERS.md,
+    #             arXiv:1902.01514): a FIXED random perturbation mask plus
+    #             a 1x1 conv replaces each 3x3 conv, cutting trunk conv
+    #             FLOPs 9x per layer. Different param tree (1x1 kernels),
+    #             so checkpoints record the trunk via model_meta and
+    #             translate/evaluate rebuild the right architecture.
+    #             Quality (not parity) tier — A/B-gated by the health
+    #             monitor + run_compare, never silently swapped in.
+    trunk_impl: str = "resnet"  # "resnet" | "perturb"
 
     def __post_init__(self):
         # A typo like "Reflect" would otherwise silently select zero/SAME
@@ -95,6 +107,26 @@ class ModelConfig:
             raise ValueError(
                 "pad_impl must be 'pad', 'fused' or 'epilogue', "
                 f"got {self.pad_impl!r}"
+            )
+        if self.trunk_impl not in ("resnet", "perturb"):
+            raise ValueError(
+                f"trunk_impl must be 'resnet' or 'perturb', got "
+                f"{self.trunk_impl!r}"
+            )
+        if self.trunk_impl == "perturb" and self.scan_blocks:
+            raise ValueError(
+                "trunk_impl='perturb' is incompatible with scan_blocks: "
+                "each perturb block derives a DISTINCT fixed mask from its "
+                "block index, while lax.scan shares one traced body across "
+                "all blocks — unroll the trunk (scan_blocks=False)"
+            )
+        if self.trunk_impl == "perturb" and self.pad_impl == "epilogue":
+            raise ValueError(
+                "trunk_impl='perturb' is incompatible with "
+                "pad_impl='epilogue': the epilogue kernel fuses the resnet "
+                "trunk's IN>ReLU>reflect-pad chains, and the perturb trunk "
+                "has no 3x3 pad sites to fuse — use pad_impl='fused' (edge "
+                "convs still benefit) or 'pad'"
             )
         # Invalid combinations fail HERE, not at trace time (or worse,
         # silently): "fused"/"epilogue" schedule reflect semantics, so
@@ -214,6 +246,30 @@ class TrainConfig:
     # exactly equal to the big-batch update (train/steps.py
     # make_accum_train_step). Mutually exclusive with steps_per_dispatch.
     grad_accum: int = 1
+    # Gradient engine (no reference counterpart; semantics identical):
+    # "combined"  = one scalar, one jax.grad over four param trees
+    #               (train/steps.py module docstring) — each discriminator
+    #               runs TWICE per fake (stopped-params adversarial site +
+    #               live-params D-loss site);
+    # "fusedprop" = explicit jax.vjp formulation (FusedProp,
+    #               arXiv:2004.03335): each discriminator runs ONCE per
+    #               fake and the shared pullback is invoked with both
+    #               cotangents (input-side -> generator adversarial grad,
+    #               param-side -> D fake-term grad). Gradients equal
+    #               "combined" to f32 tolerance (tests/test_fusedprop.py);
+    #               the saving is one disc forward + one activation
+    #               backward per fake (utils/flops.py: 14d vs 16d).
+    grad_impl: str = "combined"  # "combined" | "fusedprop"
+
+    def __post_init__(self):
+        # A typo like "fused" would silently fall back nowhere — fail at
+        # construction (argparse choices only guard the CLI; bench/tools
+        # construct TrainConfig programmatically and land here).
+        if self.grad_impl not in ("combined", "fusedprop"):
+            raise ValueError(
+                f"train.grad_impl must be 'combined' or 'fusedprop', got "
+                f"{self.grad_impl!r}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

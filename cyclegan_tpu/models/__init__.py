@@ -5,6 +5,7 @@ TPU-native re-design of /root/reference/cyclegan/model.py.
 
 from cyclegan_tpu.models.modules import (
     InstanceNorm,
+    PerturbBlock,
     ResidualBlock,
     Downsample,
     Upsample,
@@ -18,6 +19,7 @@ from cyclegan_tpu.models.discriminator import PatchGANDiscriminator
 
 __all__ = [
     "InstanceNorm",
+    "PerturbBlock",
     "ResidualBlock",
     "Downsample",
     "Upsample",

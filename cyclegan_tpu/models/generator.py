@@ -24,6 +24,7 @@ from cyclegan_tpu.config import GeneratorConfig
 from cyclegan_tpu.models.modules import (
     Downsample,
     InstanceNorm,
+    PerturbBlock,
     ResidualBlock,
     Upsample,
 )
@@ -63,6 +64,11 @@ class ResNetGenerator(nn.Module):
     # kernel where VMEM-eligible (ops/pallas/epilogue_kernel.py). All
     # values share one param tree.
     pad_impl: str = "pad"
+    # "perturb": Perturbative-GAN trunk tier (modules.PerturbBlock) —
+    # fixed masks + 1x1 convs in place of the 3x3 residual convs.
+    # DIFFERENT param tree (checkpoints record it via model_meta);
+    # requires the unrolled trunk (per-block mask salts).
+    trunk_impl: str = "resnet"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -104,6 +110,14 @@ class ResNetGenerator(nn.Module):
         # compiler-friendly-control-flow trade. Convert checkpoints between
         # layouts with stack_trunk_params/unstack_trunk_params.
         if self.scan_blocks:
+            if self.trunk_impl != "resnet":
+                # ModelConfig.__post_init__ rejects this combo for the
+                # config-driven path; guard direct construction too.
+                raise ValueError(
+                    "scan_blocks requires trunk_impl='resnet' (perturb "
+                    "blocks need per-block mask salts; the scanned trunk "
+                    f"shares one body), got {self.trunk_impl!r}"
+                )
             trunk = nn.scan(
                 _TrunkBody,
                 variable_axes={"params": 0},
@@ -118,6 +132,22 @@ class ResNetGenerator(nn.Module):
                 name="ScannedTrunk",
             )
             y, _ = trunk(y, None)
+        elif self.trunk_impl == "perturb":
+            # Cheap tier: fixed-mask + 1x1-conv blocks. Named
+            # "ResidualBlock_i" like the resnet trunk so the REST of the
+            # tree (edge convs, down/upsamples) stays path-identical;
+            # the kernels inside differ in shape, which model_meta's
+            # recorded trunk_impl makes explicit.
+            block_cls = PerturbBlock
+            if self.remat:
+                block_cls = nn.remat(PerturbBlock)
+            for i in range(cfg.num_residual_blocks):
+                y = block_cls(
+                    salt=i,
+                    dtype=self.dtype,
+                    norm_impl=self.norm_impl,
+                    name=f"ResidualBlock_{i}",
+                )(y)
         else:
             block_cls = ResidualBlock
             if self.remat:

@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Any, Callable, Optional, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from cyclegan_tpu.ops.norm import instance_norm, instance_norm_relu_pad
@@ -184,6 +185,77 @@ class ResidualBlock(nn.Module):
             y = nn.relu(y)
             y = reflect_pad(y, 1) if reflect and not fused else y
             y = conv("Conv_1")(y)
+        y = InstanceNorm(impl=self.norm_impl, name="InstanceNorm_1")(y)
+        return x + y
+
+
+# Root seed for the perturb trunk's fixed masks (arXiv number of the
+# Perturbative GAN paper). Part of the architecture contract: the masks
+# are pure functions of (seed, block salt, layer, activation shape), so
+# every reconstruction of the module — G and F, train and serve, any
+# host in a mesh — sees bit-identical masks without storing them in the
+# checkpoint.
+PERTURB_SEED = 1902
+
+
+def perturb_mask(salt: int, layer: int, shape) -> jnp.ndarray:
+    """The fixed N(0,1) perturbation mask for one perturb-conv site.
+
+    Derived in-trace from a static key: XLA constant-folds it, so it
+    costs HBM for one (H, W, C) constant per site and zero per-step
+    compute. NOT a parameter — the Perturbative GAN result is that the
+    perturbations stay frozen while only the 1x1 combinations learn,
+    and keeping it out of the param tree means no checkpoint bloat and
+    no optimizer state for it.
+    """
+    key = jax.random.fold_in(
+        jax.random.fold_in(jax.random.PRNGKey(PERTURB_SEED), salt), layer
+    )
+    return jax.random.normal(key, shape, jnp.float32)
+
+
+class PerturbBlock(nn.Module):
+    """Perturbative-GAN residual block (arXiv:1902.01514): each of the
+    reference block's 3x3 convs becomes `Conv1x1(ReLU(x + fixed_mask))` —
+    a frozen random perturbation provides the spatial mixing and a
+    learned 1x1 conv recombines channels, cutting the conv FLOPs 9x per
+    layer. Layout mirrors ResidualBlock (same module names Conv_0/1,
+    InstanceNorm_0/1, same no-bias/IN/skip structure) but the kernels
+    are (1, 1, f, f) — a DIFFERENT param tree, which is why checkpoints
+    record trunk_impl in model_meta instead of silently interchanging.
+
+    `salt` must be the block index: each block gets distinct masks (the
+    paper's per-layer independent perturbations), which is also why the
+    perturb trunk cannot ride the scanned-trunk path (one shared body).
+    """
+
+    salt: int
+    dtype: Optional[Dtype] = None
+    norm_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        filters = x.shape[-1]
+
+        def perturb_conv(layer: int, name: str, y: jnp.ndarray) -> jnp.ndarray:
+            mask = perturb_mask(self.salt, layer, y.shape[1:])
+            if self.dtype is not None:
+                y = y.astype(self.dtype)
+                mask = mask.astype(self.dtype)
+            y = nn.relu(y + mask)
+            return nn.Conv(
+                filters,
+                (1, 1),
+                padding="VALID",
+                use_bias=False,
+                kernel_init=init_normal,
+                dtype=self.dtype,
+                name=name,
+            )(y)
+
+        y = perturb_conv(0, "Conv_0", x)
+        y = InstanceNorm(impl=self.norm_impl, name="InstanceNorm_0")(y)
+        y = perturb_conv(1, "Conv_1", y)
         y = InstanceNorm(impl=self.norm_impl, name="InstanceNorm_1")(y)
         return x + y
 

@@ -20,6 +20,17 @@ from typing import Callable
 import jax
 from jax.sharding import PartitionSpec as P
 
+# jax.shard_map landed as a top-level API after 0.4.x; older releases
+# (the image pins 0.4.37) only ship jax.experimental.shard_map, and its
+# keyword is check_rep, not check_vma.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _CHECK_KW = "check_vma"
+else:  # pragma: no cover - exercised on jax<0.5 images
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
 from cyclegan_tpu.config import Config
 from cyclegan_tpu.obs import health
 from cyclegan_tpu.parallel.mesh import MeshPlan
@@ -46,12 +57,12 @@ def shard_map_train_step(
         metrics = jax.lax.psum(metrics, axis)
         return grads, metrics
 
-    sharded_grads = jax.shard_map(
+    sharded_grads = _shard_map(
         local_grads,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P(axis)),
         out_specs=(P(), P()),
-        check_vma=False,
+        **{_CHECK_KW: False},
     )
 
     with_health = config.obs.health

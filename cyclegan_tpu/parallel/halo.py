@@ -23,6 +23,15 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _axis_size(axis_name) -> int:
+    """Static size of a mapped axis. lax.axis_size is the modern API;
+    on older jax (the image pins 0.4.37) jax.core.axis_frame(name)
+    returns the size directly."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return jax.core.axis_frame(axis_name)  # pragma: no cover
+
+
 def halo_exchange(
     x: jnp.ndarray, halo: int, axis_name: str, mode: str = "reflect"
 ) -> jnp.ndarray:
@@ -42,7 +51,7 @@ def halo_exchange(
     """
     if mode not in ("reflect", "zero"):
         raise ValueError(f"unknown halo mode: {mode!r}")
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     # Zero mode only needs `halo` neighbor rows; reflect additionally
     # mirrors halo rows past the border row on the boundary shards, which
@@ -110,13 +119,20 @@ def make_sharded_conv(plan, mode: str = "reflect"):
     Returns fn(x, kernel): x row-sharded NHWC, kernel replicated HWIO."""
     from jax.sharding import PartitionSpec as P
 
+    # Older jax (the image pins 0.4.37) only ships the experimental
+    # spelling — same shim as parallel/collective.py.
+    if hasattr(jax, "shard_map"):
+        shard_map = jax.shard_map
+    else:  # pragma: no cover - exercised on jax<0.5 images
+        from jax.experimental.shard_map import shard_map
+
     spec = P(plan.data_axis, plan.spatial_axis, None, None)
 
     def fn(x, k):
         return sharded_conv(x, k, plan.spatial_axis, mode=mode)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             fn,
             mesh=plan.mesh,
             in_specs=(spec, P()),

@@ -72,6 +72,7 @@ def build_generator(model_cfg):
         norm_impl=model_cfg.instance_norm_impl,
         pad_mode=model_cfg.pad_mode,
         pad_impl=model_cfg.pad_impl,
+        trunk_impl=model_cfg.trunk_impl,
     )
 
 

@@ -67,6 +67,7 @@ def build_models(
         norm_impl=m.instance_norm_impl,
         pad_mode=m.pad_mode,
         pad_impl=m.pad_impl,
+        trunk_impl=m.trunk_impl,
     )
     disc = PatchGANDiscriminator(
         config=m.discriminator, dtype=dtype, norm_impl=m.instance_norm_impl

@@ -36,6 +36,33 @@ sum(w * per_sample) / global_batch_size (main.py:172-174), so under a
 batch-sharded mesh the global scalar equals the reference's
 MirroredStrategy SUM-reduction (main.py:264-267) — XLA inserts the
 all-reduce over ICI where NCCL did it for the reference.
+
+Gradient engines (config.train.grad_impl; docs/DESIGN.md):
+
+  "combined"  — the scalar construction above: one jax.grad, but the
+      stop_gradient bookkeeping makes each discriminator run TWICE per
+      fake — `disc.apply(stop(dy_params), fake_y)` for the adversarial
+      term and `disc.apply(dy_params, stop(fake_y))` for the D loss are
+      the same forward conv stack traced twice with different taping.
+  "fusedprop" — FusedProp (arXiv:2004.03335) via explicit jax.vjp: run
+      each discriminator ONCE per fake,
+
+        d_fake, pull = jax.vjp(disc.apply, dy_params, fake_y)
+
+      and invoke the shared pullback with both cotangents —
+      `pull(ct_adv)[1]` (input-side) is the generator's adversarial
+      gradient and `pull(ct_dfake)[0]` (param-side) is the D fake-term
+      gradient, where ct_adv = dL_adv/dd_fake and ct_dfake =
+      dL_D/dd_fake come from scalar-loss vjps. The real-image forwards
+      are likewise shared between the D loss and the health moments.
+      Both pullback calls reuse ONE set of forward residuals, so per
+      disc per step the fake site costs 1 forward + 2 activation-chain
+      backwards + 1 weight-grad pass (4 forward-equivalents) instead of
+      the combined impl's 2 forwards + 2 chains + 1 weight-grad (5).
+      Gradients and metrics are mathematically IDENTICAL — same loss
+      surfaces, same taping — differing only by float reassociation;
+      tests/test_fusedprop.py pins <=1e-5 f32 agreement across plain,
+      accum, and shard_map/dp step variants.
 """
 
 from __future__ import annotations
@@ -61,12 +88,14 @@ def _param_tuple(state: CycleGANState):
 
 
 def make_grad_fn(config: Config, global_batch_size: int) -> Callable:
-    """Build the fused gradient function.
+    """Build the fused gradient function for `config.train.grad_impl`.
 
     Returned fn: (g_params, f_params, dx_params, dy_params, x, y, w)
     -> ((g_g, g_f, g_dx, g_dy), metrics): the four per-network gradients
     from ONE backward pass, plus the ten training scalars of
-    main.py:228-237, 247 under identical keys.
+    main.py:228-237, 247 under identical keys. Every step variant
+    (plain, accum, shard_map/dp, torch-parity harness) consumes this one
+    entry point, so the impl choice threads everywhere automatically.
 
     With `config.obs.health` the metrics also carry the internal
     `_health/` D raw-output moments (obs/health.py): LINEAR scalars
@@ -75,7 +104,15 @@ def make_grad_fn(config: Config, global_batch_size: int) -> Callable:
     to mean/σ by `health.finalize_health_metrics` after aggregation.
     They live in the aux output, so they cost a few reductions on
     activations the forward already produced — no extra backward work.
+    Both impls emit the SAME metric key set (tests/test_fusedprop.py).
     """
+    if config.train.grad_impl == "fusedprop":
+        return _make_fusedprop_grad_fn(config, global_batch_size)
+    return _make_combined_grad_fn(config, global_batch_size)
+
+
+def _make_combined_grad_fn(config: Config, global_batch_size: int) -> Callable:
+    """One combined scalar, one jax.grad (module docstring derivation)."""
     gen, disc = build_models(config)
     lam_c = config.loss.lambda_cycle
     lam_i = config.loss.lambda_identity
@@ -142,6 +179,133 @@ def make_grad_fn(config: Config, global_batch_size: int) -> Callable:
         return combined, metrics
 
     return jax.grad(combined_loss, argnums=(0, 1, 2, 3), has_aux=True)
+
+
+def _make_fusedprop_grad_fn(config: Config, global_batch_size: int) -> Callable:
+    """FusedProp (arXiv:2004.03335): shared-forward G/D gradients.
+
+    Each discriminator forward appears ONCE per fake and once per real;
+    the adversarial (generator-side) and D-loss (param-side) gradients
+    both come from that single forward's pullback. Contract identical to
+    `_make_combined_grad_fn` — same gradients to f32 tolerance, same
+    metric keys, same linear `_health/` moments (module docstring).
+    """
+    gen, disc = build_models(config)
+    lam_c = config.loss.lambda_cycle
+    lam_i = config.loss.lambda_identity
+    with_health = config.obs.health
+    gbs = float(global_batch_size)
+
+    def tree_add(a, b):
+        return jax.tree.map(jnp.add, a, b)
+
+    def grad_fn(g_params, f_params, dx_params, dy_params, x, y, w):
+        # Forward fakes (main.py:210-211), keeping each generator's
+        # pullback for the adversarial cotangent arriving later.
+        fake_y, pull_gen_g = jax.vjp(lambda p: gen.apply(p, x), g_params)
+        fake_x, pull_gen_f = jax.vjp(lambda p: gen.apply(p, y), f_params)
+
+        # THE shared forwards: one disc apply per fake, differentiable in
+        # BOTH params and input. In the combined impl these are two
+        # applies each (stopped-params adversarial + stopped-input D
+        # site); here the same residuals serve both cotangents.
+        d_fake_y, pull_dy_fake = jax.vjp(disc.apply, dy_params, fake_y)
+        d_fake_x, pull_dx_fake = jax.vjp(disc.apply, dx_params, fake_x)
+
+        # Real-image forwards: param-side gradient only, and the same
+        # outputs feed the D losses and the health moments below.
+        d_real_y, pull_dy_real = jax.vjp(lambda p: disc.apply(p, y), dy_params)
+        d_real_x, pull_dx_real = jax.vjp(lambda p: disc.apply(p, x), dx_params)
+
+        # Scalar losses and their cotangents w.r.t. the disc outputs.
+        # The LSGAN cotangents are NOT proportional (ct_adv ∝ 2(d-1)
+        # from the generator loss, ct_dfake ∝ d from the D loss), so the
+        # pullback is invoked twice — the saving is the shared forward,
+        # not a merged backward.
+        def loss_and_ct(fn, *outs):
+            val, pull = jax.vjp(fn, *outs)
+            return val, pull(jnp.ones_like(val))
+
+        g_adv, (ct_adv_y,) = loss_and_ct(
+            lambda o: losses.generator_loss(o, w, gbs), d_fake_y
+        )
+        f_adv, (ct_adv_x,) = loss_and_ct(
+            lambda o: losses.generator_loss(o, w, gbs), d_fake_x
+        )
+        y_loss, (ct_y_real, ct_y_fake) = loss_and_ct(
+            lambda r, f: losses.discriminator_loss(r, f, w, gbs),
+            d_real_y, d_fake_y,
+        )
+        x_loss, (ct_x_real, ct_x_fake) = loss_and_ct(
+            lambda r, f: losses.discriminator_loss(r, f, w, gbs),
+            d_real_x, d_fake_x,
+        )
+
+        # Shared pullback, both cotangents. The discarded halves (param
+        # grads of the adversarial call, input grads of the D call) are
+        # dead code XLA eliminates — each fake site lowers to one
+        # forward, two activation-chain backwards, one weight-grad pass.
+        ct_fake_y = pull_dy_fake(ct_adv_y)[1]  # input-side -> G adversarial
+        ct_fake_x = pull_dx_fake(ct_adv_x)[1]  # input-side -> F adversarial
+        g_dy = tree_add(pull_dy_fake(ct_y_fake)[0], pull_dy_real(ct_y_real)[0])
+        g_dx = tree_add(pull_dx_fake(ct_x_fake)[0], pull_dx_real(ct_x_real)[0])
+
+        # Cycle + identity terms (main.py:219-223) see STOPPED fakes
+        # (reference var_list semantics — identical to the combined impl)
+        # so they form a self-contained scalar per generator.
+        sfake_y = stop(fake_y)
+        sfake_x = stop(fake_x)
+
+        def g_rest(p):
+            g_cycle = losses.cycle_loss(y, gen.apply(p, sfake_x), w, gbs, lam_c)
+            g_id = losses.identity_loss(y, gen.apply(p, y), w, gbs, lam_i)
+            return g_cycle + g_id, (g_cycle, g_id)
+
+        def f_rest(p):
+            f_cycle = losses.cycle_loss(x, gen.apply(p, sfake_y), w, gbs, lam_c)
+            f_id = losses.identity_loss(x, gen.apply(p, x), w, gbs, lam_i)
+            return f_cycle + f_id, (f_cycle, f_id)
+
+        (_, (g_cycle, g_id)), g_rest_grad = jax.value_and_grad(
+            g_rest, has_aux=True
+        )(g_params)
+        (_, (f_cycle, f_id)), f_rest_grad = jax.value_and_grad(
+            f_rest, has_aux=True
+        )(f_params)
+
+        g_g = tree_add(pull_gen_g(ct_fake_y)[0], g_rest_grad)
+        g_f = tree_add(pull_gen_f(ct_fake_x)[0], f_rest_grad)
+
+        g_total = g_adv + g_cycle + g_id
+        f_total = f_adv + f_cycle + f_id
+        metrics = {
+            "loss_G/loss": g_adv,
+            "loss_G/cycle": g_cycle,
+            "loss_G/identity": g_id,
+            "loss_G/total": g_total,
+            "loss_F/loss": f_adv,
+            "loss_F/cycle": f_cycle,
+            "loss_F/identity": f_id,
+            "loss_F/total": f_total,
+            "loss_X/loss": x_loss,
+            "loss_Y/loss": y_loss,
+        }
+        if with_health:
+            # Same moments as the combined impl, over the SHARED forward
+            # outputs — the combined impl's disc_fake_*_d duplicates are
+            # numerically these same arrays.
+            for side, d_out_real, d_out_fake in (
+                ("dX", d_real_x, d_fake_x),
+                ("dY", d_real_y, d_fake_y),
+            ):
+                for which, d_out in (("real", d_out_real), ("fake", d_out_fake)):
+                    k1, k2 = health.moment_keys(side, which)
+                    metrics[k1], metrics[k2] = losses.disc_raw_moments(
+                        stop(d_out), w, gbs
+                    )
+        return (g_g, g_f, g_dx, g_dy), metrics
+
+    return grad_fn
 
 
 def make_update_fn(config: Config) -> Callable:

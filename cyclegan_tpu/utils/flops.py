@@ -7,17 +7,35 @@ exact architectures in models/generator.py and models/discriminator.py
 to report TFLOP/s and MFU against the chip's peak so "fast" is judged
 against hardware capability rather than an estimated baseline rig.
 
-Backward-pass weighting (per apply site in train/steps.py):
+Backward-pass weighting (per apply site in train/steps.py), in
+forward-equivalents: a full backward ~= 2x forward (activation-gradient
+chain + weight gradients), so live-params sites cost 3, stopped-params
+sites 2 (chain only), and a FusedProp shared site is 1 forward + 2
+chains + 1 weight-grad pass = 4.
 
+grad_impl="combined" (train/steps.py:_make_combined_grad_fn):
 - The 6 generator applies and the 4 discriminator applies with LIVE
-  params cost forward + full backward ~= 3x forward (the standard 2x
-  backward: activation-gradient chain + weight gradients).
-- The 2 discriminator applies with STOPPED params (adversarial terms,
-  steps.py:77-78) need only the activation-gradient chain back to the
-  fake images ~= 2x forward total.
+  params cost forward + full backward = 3x forward each.
+- The 2 discriminator applies with STOPPED params (adversarial terms)
+  need only the activation-gradient chain back to the fakes = 2x.
+- Per discriminator: fake-adversarial site (2) + fake-D site (3) +
+  real site (3) = 8 -> 16d per pair. Step = 18g + 16d.
 
-Stopped *inputs* (e.g. gen.apply on stop(fake_x), steps.py:84-85) save
-only the first layer's input gradient — negligible, counted as full.
+grad_impl="fusedprop" (train/steps.py:_make_fusedprop_grad_fn):
+- Each discriminator's fake forward happens ONCE; its shared pullback
+  is invoked with the adversarial cotangent (chain only) and the D-loss
+  cotangent (chain + weight grads): 1 + 1 + (1 + 1) = 4x forward for
+  what "combined" buys with 5. Real site unchanged at 3.
+- Per discriminator: 4 + 3 = 7 -> 14d per pair. Step = 18g + 14d.
+  The generator's 18g is identical (same 6 apply sites).
+
+Stopped *inputs* (e.g. gen.apply on stop(fake_x)) save only the first
+layer's input gradient — negligible, counted as full.
+
+trunk_impl="perturb" changes the generator layer walk itself: each
+residual block's two 3x3 convs become 1x1 (models/modules.PerturbBlock),
+a 9x MAC cut per trunk layer; `generator_layers(trunk_impl=...)` and the
+config-driven entry points below account for it.
 """
 
 from __future__ import annotations
@@ -42,18 +60,25 @@ def generator_layers(
     num_upsample_blocks: int = 2,
     in_channels: int = 3,
     out_channels: int = 3,
+    trunk_impl: str = "resnet",
 ) -> List[_Layer]:
-    """Conv shapes of ResNetGenerator (models/generator.py:57-134)."""
+    """Conv shapes of ResNetGenerator (models/generator.py:57-134).
+
+    trunk_impl="perturb" swaps each residual block's two 3x3 convs for
+    the PerturbBlock 1x1 convs (the fixed-mask add and ReLU are
+    bandwidth-bound, like norms — not counted).
+    """
     s = image_size
     f = filters
+    trunk_k = 1 if trunk_impl == "perturb" else 3
     layers: List[_Layer] = [(s, s, in_channels, f, 7, 7)]  # c7s1, reflect+valid
     for _ in range(num_downsampling_blocks):  # Conv3x3 s2 SAME
         s //= 2
         layers.append((s, s, f, 2 * f, 3, 3))
         f *= 2
-    for _ in range(num_residual_blocks):  # two Conv3x3 (reflect+valid)
-        layers.append((s, s, f, f, 3, 3))
-        layers.append((s, s, f, f, 3, 3))
+    for _ in range(num_residual_blocks):  # two trunk convs (3x3 | 1x1)
+        layers.append((s, s, f, f, trunk_k, trunk_k))
+        layers.append((s, s, f, f, trunk_k, trunk_k))
     for _ in range(num_upsample_blocks):
         # ConvTranspose 3x3 s2: each INPUT pixel multiplies the full
         # kernel, so MACs = in_h*in_w*c_in*c_out*k*k; record via output
@@ -94,6 +119,7 @@ def generator_fwd_flops(config: Config) -> int:
             num_residual_blocks=g.num_residual_blocks,
             num_downsampling_blocks=g.num_downsampling_blocks,
             num_upsample_blocks=g.num_upsample_blocks,
+            trunk_impl=config.model.trunk_impl,
         )
     )
 
@@ -111,16 +137,22 @@ def discriminator_fwd_flops(config: Config) -> int:
 
 
 def train_step_flops_per_pair(config: Config) -> int:
-    """FLOPs of one fused train step per (x, y) example pair.
+    """FLOPs of one fused train step per (x, y) example pair, for the
+    active `config.train.grad_impl` (module docstring derivation).
 
-    Apply sites (train/steps.py:71-102): 6 generator applies with live
-    params (x3), 4 discriminator applies with live params (x3), and 2
-    discriminator applies with stopped params (x2 — activation-gradient
-    chain only). The optimizer update is O(params), negligible next to
+    combined:  6 generator applies live (x3) + per disc {fake-adv site
+               x2, fake-D site x3, real site x3} = 18g + 16d.
+    fusedprop: same generator work; per disc the fake forward is SHARED
+               (1 fwd + 2 activation chains + 1 weight-grad pass = 4)
+               and the real site stays 3 = 18g + 14d — strictly lower.
+
+    The optimizer update is O(params), negligible next to
     O(params * spatial).
     """
     g = generator_fwd_flops(config)
     d = discriminator_fwd_flops(config)
+    if config.train.grad_impl == "fusedprop":
+        return 6 * 3 * g + 2 * (4 + 3) * d
     return 6 * 3 * g + 4 * 3 * d + 2 * 2 * d
 
 

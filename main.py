@@ -82,6 +82,7 @@ def main(args: argparse.Namespace) -> None:
             pad_impl=args.pad_impl,
             instance_norm_impl=args.norm_impl,
             image_size=args.image_size,
+            trunk_impl=args.trunk_impl,
         ),
         data=DataConfig(
             dataset=args.dataset,
@@ -104,6 +105,7 @@ def main(args: argparse.Namespace) -> None:
             steps_per_dispatch=args.steps_per_dispatch,
             prefetch_batches=args.prefetch_batches,
             grad_accum=args.grad_accum,
+            grad_impl=args.grad_impl,
         ),
         obs=ObsConfig(
             enabled=not args.no_obs,
@@ -478,6 +480,31 @@ if __name__ == "__main__":
                              "traffic lever is --pad_mode zero (non-parity "
                              "borders). Checkpoints interchange across all "
                              "pad_impl values")
+    parser.add_argument("--grad_impl", default="combined",
+                        choices=["combined", "fusedprop"],
+                        help="gradient engine (train/steps.py): 'combined' "
+                             "takes one jax.grad of a combined scalar — "
+                             "each discriminator runs twice per fake "
+                             "(adversarial + D-loss sites); 'fusedprop' "
+                             "(FusedProp, arXiv:2004.03335) runs each "
+                             "discriminator ONCE per fake via explicit "
+                             "jax.vjp and reuses the shared pullback for "
+                             "both gradients — same gradients to f32 "
+                             "tolerance (tests/test_fusedprop.py), "
+                             "analytically 18g+14d vs 18g+16d FLOPs/pair "
+                             "(utils/flops.py)")
+    parser.add_argument("--trunk_impl", default="resnet",
+                        choices=["resnet", "perturb"],
+                        help="generator residual-trunk tier: 'resnet' is "
+                             "reference parity (3x3 convs); 'perturb' "
+                             "(Perturbative GAN, arXiv:1902.01514) swaps "
+                             "each 3x3 conv for a fixed random perturbation "
+                             "mask + learned 1x1 conv — 9x fewer trunk conv "
+                             "MACs, a DIFFERENT param tree (checkpoints "
+                             "record the trunk and tools rebuild it), "
+                             "quality-gated by the health monitor + "
+                             "run_compare rather than parity-pinned; "
+                             "requires the unrolled trunk (no --scan_blocks)")
     parser.add_argument("--norm_impl", default="auto",
                         choices=["auto", "xla", "pallas"],
                         help="instance-norm implementation: 'auto' resolves "

@@ -124,6 +124,46 @@ def test_config_key_format():
         {"mode": "scan", "dtype": "bfloat16", "batch": 16,
          "pad_mode": "zero"}
     ) == "scan/bfloat16/b16/zero"
+    # grad_impl / trunk_impl segments: defaults add nothing (BENCH_r05
+    # keys stay stable for run_compare), non-defaults land after the
+    # pad-impl segment and before /zero.
+    assert bench._config_key(
+        {"mode": "scan", "dtype": "bfloat16", "batch": 16,
+         "grad_impl": "fusedprop"}
+    ) == "scan/bfloat16/b16/fusedprop"
+    assert bench._config_key(
+        {"mode": "scan", "dtype": "bfloat16", "batch": 16,
+         "trunk_impl": "perturb"}
+    ) == "scan/bfloat16/b16/perturb"
+    assert bench._config_key(
+        {"mode": "scan", "dtype": "bfloat16", "batch": 16,
+         "grad_impl": "fusedprop", "trunk_impl": "perturb",
+         "pad_mode": "zero"}
+    ) == "scan/bfloat16/b16/fusedprop/perturb/zero"
+    assert bench._config_key(
+        {"mode": "steps", "dtype": "float32", "batch": 1,
+         "grad_impl": "combined", "trunk_impl": "resnet"}
+    ) == "steps/float32/b1"
+
+
+def test_emit_headline_excludes_perturb_rows(capsys):
+    """The perturb trunk is a different (cheaper) model — its img/s may
+    ride in `all` but must never claim the reference-parity headline."""
+    bench._emit({"scan/bfloat16/b16": 95.0,
+                 "scan/bfloat16/b16/perturb": 200.0}, done=True)
+    d = _last_json(capsys)
+    assert d["value"] == 95.0 and d["config"] == "scan/bfloat16/b16"
+    assert d["all"]["scan/bfloat16/b16/perturb"] == 200.0
+
+
+def test_emit_headline_allows_fusedprop_rows(capsys):
+    """fusedprop computes the SAME model and gradients — it is parity
+    tier and may claim the headline when it wins."""
+    bench._emit({"scan/bfloat16/b16": 95.0,
+                 "scan/bfloat16/b16/fusedprop": 110.0}, done=True)
+    d = _last_json(capsys)
+    assert d["value"] == 110.0
+    assert d["config"] == "scan/bfloat16/b16/fusedprop"
 
 
 def test_emit_headline_excludes_zero_pad_rows(capsys):
@@ -171,7 +211,8 @@ def test_bench_dispatch_smoke(monkeypatch):
     import jax.numpy as jnp
 
     def fake_build(dtype, batch, image, norm, pad_mode="reflect",
-                   pad_impl="pad"):
+                   pad_impl="pad", grad_impl="combined",
+                   trunk_impl="resnet"):
         state = jnp.zeros(())
 
         def step_fn(st, x, y, w):

@@ -38,7 +38,8 @@ def test_autorun_sweep_rows_are_covered():
     covered = {key for p in cache_warm.official_programs()
                for key in p["covers"]}
     for spec in ("scan:b16zero", "scan:b24zero", "scan:b16fused",
-                 "scan:b16epi", "accum:b1k8i512", "scan:b4k2i512",
+                 "scan:b16epi", "scan:b16fp", "scan:b16pb",
+                 "scan:b16fppb", "accum:b1k8i512", "scan:b4k2i512",
                  "scan:b4k2zeroi512"):
         assert f"sweep {spec}" in covered
 

@@ -122,9 +122,10 @@ def test_accum_spec_routes_to_bench_accum(tmp_path, monkeypatch):
     calls = {}
     stub = types.ModuleType("bench")
 
-    def fake_accum(dtype, micro, image, accum, norm_impl, pad_mode, pad_impl):
+    def fake_accum(dtype, micro, image, accum, norm_impl, pad_mode,
+                   pad_impl, grad_impl, trunk_impl):
         calls.update(micro=micro, image=image, accum=accum,
-                     pad_mode=pad_mode)
+                     pad_mode=pad_mode, grad_impl=grad_impl)
         return 12.34
 
     stub.bench_accum = fake_accum
@@ -134,7 +135,7 @@ def test_accum_spec_routes_to_bench_accum(tmp_path, monkeypatch):
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     chip_sweep.run_spec("accum:b2k4zeroi512")
     assert calls == {"micro": 2, "image": 512, "accum": 4,
-                     "pad_mode": "zero"}
+                     "pad_mode": "zero", "grad_impl": "combined"}
     rows = json.loads((tmp_path / "rec.json").read_text())
     assert rows[0]["key"] == "accum:b2k4zeroi512"
     assert rows[0]["img_per_sec"] == 12.34
@@ -216,32 +217,77 @@ def test_corrupt_record_aborts_before_measuring(tmp_path):
 
 
 @pytest.mark.parametrize("spec,expect", [
-    ("scan:b8", ("scan", 8, 8, False, "reflect", "pad", False, 256)),
-    ("scan:b16k16", ("scan", 16, 16, False, "reflect", "pad", False, 256)),
-    ("dispatch:b16", ("dispatch", 16, 1, False, "reflect", "pad", False, 256)),
+    ("scan:b8",
+     ("scan", 8, 8, False, "reflect", "pad", "combined", "resnet",
+      False, 256)),
+    ("scan:b16k16",
+     ("scan", 16, 16, False, "reflect", "pad", "combined", "resnet",
+      False, 256)),
+    ("dispatch:b16",
+     ("dispatch", 16, 1, False, "reflect", "pad", "combined", "resnet",
+      False, 256)),
     ("dispatch:b1k1i64",
-     ("dispatch", 1, 1, False, "reflect", "pad", False, 64)),
+     ("dispatch", 1, 1, False, "reflect", "pad", "combined", "resnet",
+      False, 64)),
     ("scan:b16pallasi512",
-     ("scan", 16, 8, True, "reflect", "pad", False, 512)),
-    ("scan:b16zero", ("scan", 16, 8, False, "zero", "pad", False, 256)),
+     ("scan", 16, 8, True, "reflect", "pad", "combined", "resnet",
+      False, 512)),
+    ("scan:b16zero",
+     ("scan", 16, 8, False, "zero", "pad", "combined", "resnet",
+      False, 256)),
     ("dispatch:b16k8zeroi512",
-     ("dispatch", 16, 8, False, "zero", "pad", False, 512)),
-    ("scan:b16fused", ("scan", 16, 8, False, "reflect", "fused", False, 256)),
+     ("dispatch", 16, 8, False, "zero", "pad", "combined", "resnet",
+      False, 512)),
+    ("scan:b16fused",
+     ("scan", 16, 8, False, "reflect", "fused", "combined", "resnet",
+      False, 256)),
     ("dispatch:b16k8fusedi512",
-     ("dispatch", 16, 8, False, "reflect", "fused", False, 512)),
+     ("dispatch", 16, 8, False, "reflect", "fused", "combined", "resnet",
+      False, 512)),
     # epi = pad_impl="epilogue" (Pallas trunk epilogue; local-compile only)
-    ("scan:b16epi", ("scan", 16, 8, False, "reflect", "epilogue", False, 256)),
+    ("scan:b16epi",
+     ("scan", 16, 8, False, "reflect", "epilogue", "combined", "resnet",
+      False, 256)),
     ("dispatch:b16k8epii512",
-     ("dispatch", 16, 8, False, "reflect", "epilogue", False, 512)),
+     ("dispatch", 16, 8, False, "reflect", "epilogue", "combined", "resnet",
+      False, 512)),
     ("dispatch:b16k8pf",
-     ("dispatch", 16, 8, False, "reflect", "pad", True, 256)),
+     ("dispatch", 16, 8, False, "reflect", "pad", "combined", "resnet",
+      True, 256)),
     ("dispatch:b16k8zeropfi512",
-     ("dispatch", 16, 8, False, "zero", "pad", True, 512)),
+     ("dispatch", 16, 8, False, "zero", "pad", "combined", "resnet",
+      True, 512)),
+    # fp = grad_impl="fusedprop" (shared-forward gradient engine);
+    # pb = trunk_impl="perturb" (cheap trunk tier) — composable with the
+    # pad words and with each other.
+    ("scan:b16fp",
+     ("scan", 16, 8, False, "reflect", "pad", "fusedprop", "resnet",
+      False, 256)),
+    ("scan:b16pb",
+     ("scan", 16, 8, False, "reflect", "pad", "combined", "perturb",
+      False, 256)),
+    ("scan:b16fppb",
+     ("scan", 16, 8, False, "reflect", "pad", "fusedprop", "perturb",
+      False, 256)),
+    ("scan:b16fusedfp",
+     ("scan", 16, 8, False, "reflect", "fused", "fusedprop", "resnet",
+      False, 256)),
+    ("dispatch:b16k8zerofppbpfi512",
+     ("dispatch", 16, 8, False, "zero", "pad", "fusedprop", "perturb",
+      True, 512)),
+    ("accum:b1k8fpi512",
+     ("accum", 1, 8, False, "reflect", "pad", "fusedprop", "resnet",
+      False, 512)),
     # accum mode: b = MICRObatch, k = microbatches per update (default 8)
-    ("accum:b1k8i512", ("accum", 1, 8, False, "reflect", "pad", False, 512)),
-    ("accum:b1i512", ("accum", 1, 8, False, "reflect", "pad", False, 512)),
+    ("accum:b1k8i512",
+     ("accum", 1, 8, False, "reflect", "pad", "combined", "resnet",
+      False, 512)),
+    ("accum:b1i512",
+     ("accum", 1, 8, False, "reflect", "pad", "combined", "resnet",
+      False, 512)),
     ("accum:b2k4zeroi512",
-     ("accum", 2, 4, False, "zero", "pad", False, 512)),
+     ("accum", 2, 4, False, "zero", "pad", "combined", "resnet",
+      False, 512)),
 ])
 def test_spec_grammar(spec, expect):
     assert chip_sweep.parse_spec(spec) == expect
@@ -254,7 +300,12 @@ def test_spec_grammar(spec, expect):
                                  "scan:b16epifused", "scan:b16epipallas",
                                  "scan:b16pf",
                                  "dispatch:b16pfk8", "accum:b1pf",
-                                 "accum:b0k8", "accum:b1k0"])
+                                 "accum:b0k8", "accum:b1k0",
+                                 # order is fixed: fp before pb before pf
+                                 "scan:b16pbfp", "dispatch:b16k8pffp",
+                                 "scan:b16fpfused",
+                                 # pb has no epilogue trunk to fuse
+                                 "scan:b16epipb"])
 def test_spec_grammar_rejects(bad):
     with pytest.raises(SystemExit):
         chip_sweep.parse_spec(bad)

@@ -4,6 +4,7 @@ the compiler-scheduled jit path and the explicit shard_map+psum path."""
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
 import numpy as np
 import pytest
 
@@ -57,7 +58,7 @@ def test_dp_jit_equals_single_device(tiny_config, state0, batch, devices):
     assert plan.n_data == 8
     step = shard_train_step(plan, make_train_step(cfg, gbs))
     xs, ys, ws = shard_batch(plan, x, y, w)
-    state_rep = jax.device_put(state0, jax.NamedSharding(plan.mesh, jax.P()))
+    state_rep = jax.device_put(state0, NamedSharding(plan.mesh, PartitionSpec()))
     s8, m8 = step(state_rep, xs, ys, ws)
 
     for k in m1:
@@ -92,7 +93,7 @@ def test_dp_test_step_matches(tiny_config, state0, batch, devices):
     plan = make_mesh_plan(ParallelConfig(), devices)
     step = shard_test_step(plan, make_test_step(cfg, gbs))
     xs, ys, ws = shard_batch(plan, x, y, w)
-    m8 = step(jax.device_put(state0, jax.NamedSharding(plan.mesh, jax.P())), xs, ys, ws)
+    m8 = step(jax.device_put(state0, NamedSharding(plan.mesh, PartitionSpec())), xs, ys, ws)
     for k in m1:
         np.testing.assert_allclose(float(m1[k]), float(m8[k]), rtol=2e-4, atol=1e-6, err_msg=k)
 
@@ -117,7 +118,7 @@ def test_ragged_final_batch_padding(tiny_config, state0, devices):
     plan = make_mesh_plan(ParallelConfig(), devices)
     step = shard_test_step(plan, make_test_step(cfg, gbs))
     xs, ys, ws = shard_batch(plan, xp, yp, wp)
-    m_pad = step(jax.device_put(state0, jax.NamedSharding(plan.mesh, jax.P())), xs, ys, ws)
+    m_pad = step(jax.device_put(state0, NamedSharding(plan.mesh, PartitionSpec())), xs, ys, ws)
     for k in m_ref:
         np.testing.assert_allclose(float(m_ref[k]), float(m_pad[k]), rtol=2e-4, atol=1e-6, err_msg=k)
 
@@ -134,6 +135,6 @@ def test_spatial_sharding_compiles_and_matches(tiny_config, state0, batch, devic
     assert plan.n_data == 4 and plan.n_spatial == 2
     step = shard_test_step(plan, make_test_step(cfg, gbs))
     xs, ys, ws = shard_batch(plan, x, y, w)
-    m8 = step(jax.device_put(state0, jax.NamedSharding(plan.mesh, jax.P())), xs, ys, ws)
+    m8 = step(jax.device_put(state0, NamedSharding(plan.mesh, PartitionSpec())), xs, ys, ws)
     for k in m1:
         np.testing.assert_allclose(float(m1[k]), float(m8[k]), rtol=5e-4, atol=1e-5, err_msg=k)

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from cyclegan_tpu.config import Config, GeneratorConfig, ModelConfig
+from cyclegan_tpu.config import Config, GeneratorConfig, ModelConfig, TrainConfig
 from cyclegan_tpu.models.discriminator import PatchGANDiscriminator
 from cyclegan_tpu.models.generator import ResNetGenerator
 from cyclegan_tpu.utils import flops as F
@@ -61,6 +61,20 @@ def test_nondefault_architecture_walk_matches_real_params():
     assert walked == _conv_param_count(params)
 
 
+def test_perturb_layer_walk_matches_real_params():
+    """The perturb trunk swaps the residual 3x3s for 1x1s; the walk's
+    kernel shapes must track the REAL perturb generator's params."""
+    model = ResNetGenerator(trunk_impl="perturb")
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    walked = sum(
+        ci * co * kh * kw
+        for _, _, ci, co, kh, kw in F.generator_layers(
+            64, trunk_impl="perturb"
+        )
+    )
+    assert walked == _conv_param_count(params)
+
+
 def test_step_flops_magnitude():
     cfg = Config()
     g = F.generator_fwd_flops(cfg)
@@ -71,6 +85,30 @@ def test_step_flops_magnitude():
     pair = F.train_step_flops_per_pair(cfg)
     assert pair == 18 * g + 16 * d
     assert F.train_step_flops_per_image(cfg) == pair / 2.0
+
+
+def test_fusedprop_flops_strictly_lower():
+    """FusedProp shares each discriminator's fake forward between the
+    adversarial and D gradients: 14d per pair instead of 16d. The
+    analytic model must record the saving, and it must be a strict
+    improvement (the acceptance criterion of the optimisation)."""
+    combined = Config()
+    fused = Config(train=TrainConfig(grad_impl="fusedprop"))
+    g = F.generator_fwd_flops(combined)
+    d = F.discriminator_fwd_flops(combined)
+    pair_c = F.train_step_flops_per_pair(combined)
+    pair_fp = F.train_step_flops_per_pair(fused)
+    assert pair_c == 18 * g + 16 * d
+    assert pair_fp == 18 * g + 14 * d
+    assert pair_fp < pair_c
+
+
+def test_perturb_trunk_flops_strictly_lower():
+    resnet = Config()
+    perturb = Config(model=ModelConfig(trunk_impl="perturb"))
+    assert F.generator_fwd_flops(perturb) < F.generator_fwd_flops(resnet)
+    assert F.train_step_flops_per_pair(perturb) < (
+        F.train_step_flops_per_pair(resnet))
 
 
 def test_flops_scale_quadratically_with_image_size():

@@ -1,0 +1,178 @@
+"""Perturbative cheap-trunk tier (--trunk_impl perturb).
+
+Perturbative GAN (arXiv:1902.01514) replaces each residual block's two
+3x3 convs with fixed random perturbation masks followed by 1x1 convs —
+~9x fewer trunk conv FLOPs (utils/flops.py). Pinned here: the block's
+parameter tree really is 1x1 (the FLOP claim is structural, not
+aspirational), masks are deterministic functions of (salt, layer) and
+NOT parameters (no checkpoint bloat), the architecture round-trips
+through the checkpoint sidecar, config validation rejects the
+unsupported combinations, and the assembled system still learns.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cyclegan_tpu.config import Config, GeneratorConfig, ModelConfig
+from cyclegan_tpu.models import PerturbBlock
+from cyclegan_tpu.models.modules import perturb_mask
+from cyclegan_tpu.train import build_models, create_state, make_train_step
+from cyclegan_tpu.utils.checkpoint import Checkpointer
+
+
+def _perturb_config(config):
+    return dataclasses.replace(
+        config, model=dataclasses.replace(config.model, trunk_impl="perturb")
+    )
+
+
+# ------------------------------------------------------------- validation
+
+def test_unknown_trunk_impl_rejected():
+    with pytest.raises(ValueError, match="trunk_impl"):
+        ModelConfig(trunk_impl="dense")
+
+
+def test_perturb_rejects_scan_blocks():
+    with pytest.raises(ValueError, match="scan_blocks"):
+        ModelConfig(trunk_impl="perturb", scan_blocks=True)
+
+
+def test_perturb_rejects_pallas_epilogue():
+    with pytest.raises(ValueError, match="epilogue"):
+        ModelConfig(trunk_impl="perturb", pad_impl="epilogue")
+
+
+# ----------------------------------------------------- structure + masks
+
+def test_perturb_trunk_params_are_1x1(tiny_config):
+    cfg = _perturb_config(tiny_config)
+    gen, _ = build_models(cfg)
+    s = cfg.model.image_size
+    params = gen.init(jax.random.PRNGKey(0), jnp.zeros((1, s, s, 3)))
+
+    tree = params["params"]
+    blocks = [k for k in tree if k.startswith("ResidualBlock_")]
+    assert len(blocks) == cfg.model.generator.num_residual_blocks
+    for bk in blocks:
+        block = tree[bk]
+        assert set(block) == {"Conv_0", "InstanceNorm_0",
+                              "Conv_1", "InstanceNorm_1"}
+        for ck in ("Conv_0", "Conv_1"):
+            kernel = block[ck]["kernel"]
+            assert kernel.shape[:2] == (1, 1), (bk, ck, kernel.shape)
+            assert "bias" not in block[ck]  # masks replace the bias role
+        # Masks must NOT appear as parameters or variables of any kind.
+        assert not any("mask" in k.lower() for k in block)
+
+
+def test_perturb_forward_shape_and_dtype(tiny_config):
+    cfg = _perturb_config(tiny_config)
+    gen, _ = build_models(cfg)
+    s = cfg.model.image_size
+    x = jax.random.uniform(jax.random.PRNGKey(1), (2, s, s, 3),
+                           minval=-1, maxval=1)
+    params = gen.init(jax.random.PRNGKey(0), x)
+    out = gen.apply(params, x)
+    assert out.shape == (2, s, s, 3)
+    assert out.dtype == jnp.float32
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_perturb_masks_deterministic_and_distinct():
+    shape = (8, 8, 4)
+    m_a = perturb_mask(0, 0, shape)
+    m_b = perturb_mask(0, 0, shape)
+    np.testing.assert_array_equal(np.asarray(m_a), np.asarray(m_b))
+    # Different layer or different block salt -> different mask.
+    assert not np.array_equal(np.asarray(m_a),
+                              np.asarray(perturb_mask(0, 1, shape)))
+    assert not np.array_equal(np.asarray(m_a),
+                              np.asarray(perturb_mask(1, 0, shape)))
+
+
+def test_perturb_blocks_differ_by_salt(tiny_config):
+    """Two blocks share parameter SHAPES but see different fixed masks, so
+    with identical weights they compute different functions."""
+    x = jax.random.uniform(jax.random.PRNGKey(2), (1, 8, 8, 4))
+    b0 = PerturbBlock(salt=0)
+    b1 = PerturbBlock(salt=1)
+    params = b0.init(jax.random.PRNGKey(0), x)
+    out0 = b0.apply(params, x)
+    out1 = b1.apply(params, x)  # same params, different salt
+    assert not np.allclose(np.asarray(out0), np.asarray(out1))
+
+
+# ------------------------------------------------- checkpoint round-trip
+
+def test_perturb_checkpoint_roundtrip(tiny_config, tmp_path):
+    """The sidecar records trunk_impl, model_from_meta rebuilds the same
+    architecture, and the saved params restore into it exactly."""
+    cfg = _perturb_config(tiny_config)
+    state = create_state(cfg, jax.random.PRNGKey(0))
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(state, epoch=0, meta=cfg.model_meta())
+
+    meta = ckpt.read_meta()
+    assert meta["model"]["trunk_impl"] == "perturb"
+    rebuilt = Config.model_from_meta(meta)
+    assert rebuilt.trunk_impl == "perturb"
+
+    template = create_state(
+        dataclasses.replace(cfg, model=rebuilt), jax.random.PRNGKey(7))
+    restored, next_epoch = ckpt.restore(template)
+    assert next_epoch == 1
+    for (pa, la), (pb, lb) in zip(
+        jax.tree_util.tree_leaves_with_path(state.g_params),
+        jax.tree_util.tree_leaves_with_path(restored.g_params),
+    ):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_resnet_meta_does_not_leak_perturb(tiny_config):
+    meta = tiny_config.model_meta()
+    assert meta["model"]["trunk_impl"] == "resnet"
+    assert Config.model_from_meta(meta).trunk_impl == "resnet"
+
+
+# -------------------------------------------------------- learning smoke
+
+def test_perturb_training_learns(tiny_config):
+    """Same probe as tests/test_training_learns.py: the discriminator
+    objective must fall fast against the perturb generator too — the
+    cheap trunk changes the generator's function class, not the
+    trainability of the assembled system."""
+    cfg = _perturb_config(tiny_config)
+    batch = 4
+    step = jax.jit(make_train_step(cfg, batch))
+    state = create_state(cfg, jax.random.PRNGKey(3))
+
+    rng = np.random.RandomState(3)
+    s = cfg.model.image_size
+    data = [
+        (
+            (rng.rand(batch, s, s, 3).astype(np.float32) * 2 - 1),
+            (rng.rand(batch, s, s, 3).astype(np.float32) * 2 - 1),
+        )
+        for _ in range(2)
+    ]
+    w = np.ones((batch,), np.float32)
+
+    history = []
+    for i in range(120):
+        x, y = data[i % len(data)]
+        state, metrics = step(state, x, y, w)
+        m = jax.device_get(metrics)
+        history.append(float(m["loss_X/loss"]) + float(m["loss_Y/loss"]))
+
+    early = np.mean(history[:5])
+    late = np.mean(history[-5:])
+    assert np.isfinite(history).all()
+    assert late < 0.8 * early, (
+        f"perturb-trunk run did not improve: {early:.4f} -> {late:.4f}"
+    )

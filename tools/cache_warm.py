@@ -69,10 +69,12 @@ def official_programs() -> list:
     seen = {}
 
     def add(key, mode, dtype, batch, image=256, k=1, pad_mode="reflect",
-            pad_impl="pad", accum=None):
+            pad_impl="pad", accum=None, grad_impl="combined",
+            trunk_impl="resnet"):
         # program signature: pf changes nothing (host-side staging);
         # steps ≡ dispatch-k1 (plain per-step jit); scan ≡ dispatch-k>1
-        # (both run bench._fused_k_step's scanned program)
+        # (both run bench._fused_k_step's scanned program). grad_impl and
+        # trunk_impl change the traced HLO, so they are part of identity.
         if mode == "accum":
             prog_mode = "accum"
         elif mode == "steps" or (mode == "dispatch" and k == 1):
@@ -80,14 +82,15 @@ def official_programs() -> list:
         else:
             prog_mode = "fused_k"
         sig = (prog_mode, dtype, batch, image, k if prog_mode != "step"
-               else 1, pad_mode, pad_impl, accum)
+               else 1, pad_mode, pad_impl, accum, grad_impl, trunk_impl)
         if sig in seen:
             seen[sig]["covers"].append(key)
             return
         entry = {"key": key, "mode": mode, "dtype": dtype,
                  "batch": batch, "image": image, "k": k,
                  "pad_mode": pad_mode, "pad_impl": pad_impl,
-                 "accum": accum, "covers": [key]}
+                 "accum": accum, "grad_impl": grad_impl,
+                 "trunk_impl": trunk_impl, "covers": [key]}
         seen[sig] = entry
         progs.append(entry)
 
@@ -96,7 +99,9 @@ def official_programs() -> list:
             image=c.get("image", 256),
             k=c.get("k", 8 if c["mode"] == "scan" else 1),
             pad_mode=c.get("pad_mode", "reflect"),
-            pad_impl=c.get("pad_impl", "pad"))
+            pad_impl=c.get("pad_impl", "pad"),
+            grad_impl=c.get("grad_impl", "combined"),
+            trunk_impl=c.get("trunk_impl", "resnet"))
     # chip_autorun queue rows (tools/chip_autorun.py build_queue).
     # k=8 matches chip_sweep's scan default (parse_spec) — the k the
     # sweep will actually compile; omitting it would warm k=1 programs
@@ -111,6 +116,17 @@ def official_programs() -> list:
     # Dedups against the TPU_CONFIGS /epi row by signature.
     add("sweep scan:b16epi", "scan", "bfloat16", 16, k=8,
         pad_impl="epilogue")
+    # chip_autorun's grad_sweep step (ISSUE 7): the fusedprop gradient
+    # engine and the perturb trunk tier at the headline geometry. The
+    # fp/pb rows dedup against the TPU_CONFIGS /fusedprop and /perturb
+    # rows by signature; the combined b16 baseline they are compared
+    # against is already warmed by row 1.
+    add("sweep scan:b16fp", "scan", "bfloat16", 16, k=8,
+        grad_impl="fusedprop")
+    add("sweep scan:b16pb", "scan", "bfloat16", 16, k=8,
+        trunk_impl="perturb")
+    add("sweep scan:b16fppb", "scan", "bfloat16", 16, k=8,
+        grad_impl="fusedprop", trunk_impl="perturb")
     add("sweep accum:b1k8i512", "accum", "bfloat16", 1, image=512, k=8,
         accum=8)
     add("sweep scan:b4k2i512", "scan", "bfloat16", 4, image=512, k=2)
@@ -213,7 +229,9 @@ def _lower(prog: dict):
         effective = accum * micro
         cfg = bench._config_for(prog["dtype"], effective, image, "auto",
                                 prog["pad_mode"], prog["pad_impl"],
-                                grad_accum=accum)
+                                grad_accum=accum,
+                                grad_impl=prog.get("grad_impl", "combined"),
+                                trunk_impl=prog.get("trunk_impl", "resnet"))
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
             state = create_state(cfg, jax.random.PRNGKey(0))
         step = make_accum_train_step(cfg, effective, accum)
@@ -223,7 +241,9 @@ def _lower(prog: dict):
         return jax.jit(step, donate_argnums=(0,)).lower(state, xs, xs, ws)
 
     cfg = bench._config_for(prog["dtype"], batch, image, "auto",
-                            prog["pad_mode"], prog["pad_impl"])
+                            prog["pad_mode"], prog["pad_impl"],
+                            grad_impl=prog.get("grad_impl", "combined"),
+                            trunk_impl=prog.get("trunk_impl", "resnet"))
     with jax.default_device(jax.local_devices(backend="cpu")[0]):
         state = create_state(cfg, jax.random.PRNGKey(0))
     step_fn = make_train_step(cfg, batch)

@@ -50,6 +50,10 @@ HOT_PATH_FILES: List[Tuple[str, bool]] = [
     # path; a device fetch on it would re-serialize the boundary it
     # overlaps (callers hand it already-fetched host copies).
     ("cyclegan_tpu/utils/services.py", False),
+    # Both gradient engines (combined jax.grad and the fusedprop vjp
+    # path) build traced-only code; any host fetch here would run once
+    # per step inside the dispatch chain. Zero sanctioned sites.
+    ("cyclegan_tpu/train/steps.py", False),
 ]
 
 # Directories whose EVERY .py file is hot-path. Scanned as a directory

@@ -181,6 +181,16 @@ def build_queue(mode: str, round_tag: str = ROUND_TAG) -> list:
              env={**env, "PALLAS_AXON_POOL_IPS": "",
                   "CYCLEGAN_AXON_LOCAL_COMPILE": "1"},
              artifacts=[sweeps]),
+        # The FLOP-reduction levers (ISSUE 7, ROADMAP item 3): fusedprop
+        # shared-forward gradients (fp — gradient-parity, 18g+14d vs
+        # 18g+16d analytic FLOPs/pair) and the Perturbative-GAN cheap
+        # trunk (pb — quality tier, health-gated), both at the headline
+        # scan:b16 geometry plus the combined stack (fppb). The combined
+        # baseline these rows pair against is bench_warm's scan b16 row;
+        # cache_warm pre-warms all three programs.
+        Step("grad_sweep",
+             [py, "tools/chip_sweep.py", "scan:b16fp", "scan:b16pb",
+              "scan:b16fppb"], 3600.0, env=env, artifacts=[sweeps]),
         # 512^2 HBM-relief rows (runbook item 5): accum 8x1 (the
         # certified memory contract) and the plain/zero 512 scans.
         Step("accum512", [py, "tools/chip_sweep.py", "accum:b1k8i512"],

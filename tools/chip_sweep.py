@@ -9,10 +9,16 @@ so the record is regenerable:
     python tools/chip_sweep.py scan:b8 scan:b24 scan:b32 scan:b16k16
 
 Spec grammar:
-<scan|dispatch|accum>:b<batch>[k<K>][pallas][zero|fused|epi][pf][i<image>]
+<scan|dispatch|accum>:b<batch>[k<K>][pallas][zero|fused|epi][fp][pb][pf]
+[i<image>]
 — parts in that order; k defaults to 8 for scan / 1 for dispatch, image
 to 256; `zero` selects pad_mode="zero" (conv built-in SAME padding, the
 compiler-certified −32% traffic variant — docs/BENCHMARKS.md pad-probe);
+`fp` selects grad_impl="fusedprop" (FusedProp shared-forward gradients —
+train/steps.py; gradient-parity engine, 18g+14d vs 18g+16d analytic
+FLOPs/pair);
+`pb` selects trunk_impl="perturb" (the Perturbative-GAN cheap generator
+trunk — fixed masks + 1x1 convs; a quality tier, not a parity config);
 `fused` selects pad_impl="fused" (ReflectConv: reflect SEMANTICS without
 materialized pads — the parity-preserving variant of the same lever);
 `epi` selects pad_impl="epilogue" (the fused scheduling PLUS the trunk
@@ -67,13 +73,13 @@ RECORD_PATH = os.environ.get("CYCLEGAN_SWEEP_RECORD") or os.path.join(
     "docs", "bench_sweeps.json")
 
 SPEC_RE = re.compile(
-    r"(scan|dispatch|accum):b(\d+)(?:k(\d+))?(pallas)?(zero|fused|epi)?(pf)?"
-    r"(?:i(\d+))?")
+    r"(scan|dispatch|accum):b(\d+)(?:k(\d+))?(pallas)?(zero|fused|epi)?"
+    r"(fp)?(pb)?(pf)?(?:i(\d+))?")
 
 
 def parse_spec(spec: str):
-    """spec -> (mode, batch, k, pallas, pad_mode, pad_impl, prefetch,
-    image).
+    """spec -> (mode, batch, k, pallas, pad_mode, pad_impl, grad_impl,
+    trunk_impl, prefetch, image).
     Raises SystemExit on a malformed spec or zero batch/k/image (the
     regex's \\d+ admits 0, which `k or default` would silently coerce to
     the default — a mislabeled record in a file the docs treat as ground
@@ -85,17 +91,24 @@ def parse_spec(spec: str):
     mode, batch, k, pallas, prefetch, image = (
         m.group(1), int(m.group(2)),
         int(m.group(3)) if m.group(3) else None,
-        bool(m.group(4)), bool(m.group(6)),
-        int(m.group(7)) if m.group(7) else 256)
+        bool(m.group(4)), bool(m.group(8)),
+        int(m.group(9)) if m.group(9) else 256)
     pad_mode = "zero" if pad_word == "zero" else "reflect"
     pad_impl = {"fused": "fused", "epi": "epilogue"}.get(pad_word, "pad")
+    grad_impl = "fusedprop" if m.group(6) else "combined"
+    trunk_impl = "perturb" if m.group(7) else "resnet"
     if batch < 1 or image < 1 or (k is not None and k < 1):
         raise SystemExit(f"bad spec: {spec} (batch/k/image must be >= 1)")
     if prefetch and mode != "dispatch":
         raise SystemExit(f"bad spec: {spec} (pf applies to dispatch only)")
+    if trunk_impl == "perturb" and pad_impl == "epilogue":
+        # Mirrors ModelConfig validation: the epilogue kernel fuses the
+        # resnet trunk's pad chains; a perturb trunk has none.
+        raise SystemExit(f"bad spec: {spec} (pb is incompatible with epi)")
     if k is None:
         k = 1 if mode == "dispatch" else 8
-    return mode, batch, k, pallas, pad_mode, pad_impl, prefetch, image
+    return (mode, batch, k, pallas, pad_mode, pad_impl, grad_impl,
+            trunk_impl, prefetch, image)
 
 
 def _load_records() -> list:
@@ -187,8 +200,8 @@ def run_spec(spec: str) -> bool:
     """Measure one spec; returns True when the attempt died on
     infrastructure (nothing recorded, caller should exit nonzero)."""
     # abort BEFORE compile
-    mode, batch, k, pallas, pad_mode, pad_impl, prefetch, image = (
-        parse_spec(spec))
+    (mode, batch, k, pallas, pad_mode, pad_impl, grad_impl, trunk_impl,
+     prefetch, image) = parse_spec(spec)
     # Honor JAX_PLATFORMS=cpu (the axon sitecustomize overrides the env
     # var; main.py re-asserts it the same way) so the tool is drivable
     # off-chip and fails fast instead of hanging when the relay is down.
@@ -217,17 +230,22 @@ def run_spec(spec: str) -> bool:
         if mode == "scan":
             ips = bench.bench_scan("bfloat16", batch, image=image,
                                    norm_impl=norm, k=k, pad_mode=pad_mode,
-                                   pad_impl=pad_impl)
+                                   pad_impl=pad_impl, grad_impl=grad_impl,
+                                   trunk_impl=trunk_impl)
         elif mode == "accum":
             ips = bench.bench_accum("bfloat16", micro=batch, image=image,
                                     accum=k, norm_impl=norm,
-                                    pad_mode=pad_mode, pad_impl=pad_impl)
+                                    pad_mode=pad_mode, pad_impl=pad_impl,
+                                    grad_impl=grad_impl,
+                                    trunk_impl=trunk_impl)
         else:
             ips = bench.bench_dispatch("bfloat16", batch, image=image,
                                        norm_impl=norm, k=k,
                                        pad_mode=pad_mode,
                                        pad_impl=pad_impl,
-                                       prefetch=prefetch)
+                                       prefetch=prefetch,
+                                       grad_impl=grad_impl,
+                                       trunk_impl=trunk_impl)
         rec["img_per_sec"] = round(ips, 2)
         print(f"[sweep] {spec}: {ips:.2f} img/s "
               f"({time.perf_counter() - t0:.0f}s incl. compile)", flush=True)

@@ -27,9 +27,16 @@ no device op detail) a smaller host-function table is printed instead.
 
 Usage:
     python tools/trace_report.py [PATH] [--top N] [--markdown] [--json]
+    python tools/trace_report.py --diff A B [--markdown] [--json]
 
 PATH may be an .xplane.pb file, a .trace.json.gz file, or a directory
 to search (default: newest profile dir under docs/chip_logs/*/).
+
+`--diff A B` mines both artifacts and prints a per-bucket delta table
+(B minus A) plus the idle-fraction delta — the check that a claimed
+optimisation (fusedprop shared forwards, the perturb trunk tier)
+actually moved the conv bucket rather than shuffling time between
+categories.
 """
 
 from __future__ import annotations
@@ -434,6 +441,80 @@ def mine_xplane(path: str, plane_prefix: str = "/device:") -> dict:
     }
 
 
+def diff_reports(report_a: dict, report_b: dict) -> dict:
+    """Per-bucket deltas between two mined device reports (B minus A).
+
+    Works on the bucket rollup rather than per-op rows because op
+    symbols are not stable across programs — a fusedprop step and a
+    combined step fuse differently, so `fusion.219` in one trace has no
+    counterpart in the other.  The BUCKETS axes are the comparable
+    vocabulary.
+    """
+    rows = []
+    for name in BUCKETS:
+        a = report_a["buckets"].get(name, {"total_ms": 0.0, "count": 0,
+                                           "pct_of_op_time": 0.0})
+        b = report_b["buckets"].get(name, {"total_ms": 0.0, "count": 0,
+                                           "pct_of_op_time": 0.0})
+        rows.append({
+            "bucket": name,
+            "a_ms": a["total_ms"],
+            "b_ms": b["total_ms"],
+            "delta_ms": b["total_ms"] - a["total_ms"],
+            "a_pct": a.get("pct_of_op_time", 0.0),
+            "b_pct": b.get("pct_of_op_time", 0.0),
+            "delta_pct": (b.get("pct_of_op_time", 0.0)
+                          - a.get("pct_of_op_time", 0.0)),
+        })
+    return {
+        "kind": "diff",
+        "path_a": report_a["path"],
+        "path_b": report_b["path"],
+        "buckets": rows,
+        "a_busy_ms": report_a["busy_ms"],
+        "b_busy_ms": report_b["busy_ms"],
+        "delta_busy_ms": report_b["busy_ms"] - report_a["busy_ms"],
+        "a_idle_fraction": report_a["idle_fraction"],
+        "b_idle_fraction": report_b["idle_fraction"],
+        "delta_idle_fraction": (report_b["idle_fraction"]
+                                - report_a["idle_fraction"]),
+    }
+
+
+def render_diff(diff: dict, markdown: bool) -> str:
+    out: List[str] = [
+        f"trace diff: A={diff['path_a']}",
+        f"            B={diff['path_b']}",
+        f"  busy {diff['a_busy_ms']:.2f} ms -> {diff['b_busy_ms']:.2f} ms "
+        f"({diff['delta_busy_ms']:+.2f} ms); "
+        f"idle {100 * diff['a_idle_fraction']:.2f}% -> "
+        f"{100 * diff['b_idle_fraction']:.2f}% "
+        f"({100 * diff['delta_idle_fraction']:+.2f} pp)",
+        "",
+    ]
+    rows = sorted(diff["buckets"], key=lambda r: abs(r["delta_ms"]),
+                  reverse=True)
+    if markdown:
+        out.append("| bucket | A (ms) | B (ms) | Δ ms | A % | B % | Δ pp |")
+        out.append("|---|---:|---:|---:|---:|---:|---:|")
+        for r in rows:
+            out.append(
+                f"| {r['bucket']} | {r['a_ms']:.2f} | {r['b_ms']:.2f} "
+                f"| {r['delta_ms']:+.2f} | {r['a_pct']:.1f}% "
+                f"| {r['b_pct']:.1f}% | {r['delta_pct']:+.1f} |"
+            )
+    else:
+        out.append(f"{'bucket':<16} {'A ms':>10} {'B ms':>10} {'Δ ms':>10} "
+                   f"{'A %':>7} {'B %':>7} {'Δ pp':>7}")
+        for r in rows:
+            out.append(
+                f"{r['bucket']:<16} {r['a_ms']:>10.2f} {r['b_ms']:>10.2f} "
+                f"{r['delta_ms']:>+10.2f} {r['a_pct']:>6.1f}% "
+                f"{r['b_pct']:>6.1f}% {r['delta_pct']:>+7.1f}"
+            )
+    return "\n".join(out)
+
+
 # --------------------------------------------------------------------------
 # Host-trace fallback (vm.trace.json.gz has host threads only — no device
 # op detail — but its top functions still show where the HOST went).
@@ -571,7 +652,25 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--plane", default="/device:", help="plane name prefix to mine (default /device:)"
     )
+    ap.add_argument(
+        "--diff", nargs=2, metavar=("A", "B"), default=None,
+        help="mine two xplane artifacts and print the per-bucket delta "
+        "table (B minus A) instead of a single report",
+    )
     args = ap.parse_args(argv)
+
+    if args.diff is not None:
+        if args.path is not None:
+            ap.error("--diff takes its two paths as arguments; drop the positional PATH")
+        diff = diff_reports(
+            mine_xplane(_resolve(args.diff[0]), plane_prefix=args.plane),
+            mine_xplane(_resolve(args.diff[1]), plane_prefix=args.plane),
+        )
+        if args.as_json:
+            print(json.dumps(diff, indent=2))
+        else:
+            print(render_diff(diff, markdown=args.markdown))
+        return 0
 
     path = _resolve(args.path)
     if path.endswith((".json.gz", ".json")):
