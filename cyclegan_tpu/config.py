@@ -228,6 +228,11 @@ class TrainConfig:
     clear_output_dir: bool = False  # main.py:411
     seed: int = 1234  # main.py:366-367
     checkpoint_every: int = 10  # main.py:400
+    # Checkpoint-ring depth (utils/checkpoint.py): 1 = the reference's
+    # single overwritten slot; K > 1 keeps the K newest epoch slots,
+    # each with a sha256 manifest — what --on_nan rollback restores from
+    # when the newest slot is corrupt.
+    ckpt_keep: int = 3
     plot_samples: int = 5  # main.py:77
     # TPU knob (no reference counterpart): train steps fused into one
     # lax.scan dispatch; hides host->device dispatch latency. 1 = the
@@ -265,6 +270,9 @@ class TrainConfig:
         # A typo like "fused" would silently fall back nowhere — fail at
         # construction (argparse choices only guard the CLI; bench/tools
         # construct TrainConfig programmatically and land here).
+        if self.ckpt_keep < 1:
+            raise ValueError(
+                f"train.ckpt_keep must be >= 1, got {self.ckpt_keep}")
         if self.grad_impl not in ("combined", "fusedprop"):
             raise ValueError(
                 f"train.grad_impl must be 'combined' or 'fusedprop', got "
@@ -307,8 +315,16 @@ class ObsConfig:
     health: bool = True
     # Non-finite gradient policy: "warn" records a health_fault event
     # and keeps training; "halt" flushes telemetry, leaves the last-good
-    # checkpoint slot untouched, and exits nonzero.
+    # checkpoint slot untouched, and exits nonzero; "rollback"
+    # (resil/rollback.py) restores the newest verified checkpoint-ring
+    # slot, rewinds the epoch counter, re-seeds the data pipeline, and
+    # keeps training — halting only after `max_rollbacks` consecutive
+    # faults with no clean epoch in between.
     on_nan: str = "warn"
+    # Rollback budget for on_nan="rollback": consecutive HealthFaults
+    # tolerated before the fault propagates and the run halts. A clean
+    # epoch resets the count. Ignored under warn/halt.
+    max_rollbacks: int = 2
     # EMA divergence detector: warn when loss_G/total or loss_F/total
     # exceeds this multiple of its own EMA (armed after a warmup window;
     # 0 disables the detector).
@@ -324,9 +340,14 @@ class ObsConfig:
         # A typo like "Halt" would silently select the warn path on the
         # one run where halting mattered (argparse choices only guard
         # the CLI; programmatic construction lands here).
-        if self.on_nan not in ("warn", "halt"):
+        if self.on_nan not in ("warn", "halt", "rollback"):
             raise ValueError(
-                f"obs.on_nan must be 'warn' or 'halt', got {self.on_nan!r}"
+                f"obs.on_nan must be 'warn', 'halt', or 'rollback', "
+                f"got {self.on_nan!r}"
+            )
+        if self.max_rollbacks < 0:
+            raise ValueError(
+                f"obs.max_rollbacks must be >= 0, got {self.max_rollbacks}"
             )
 
 

@@ -70,6 +70,7 @@ class CycleGANData:
         self.test_batch_size = int(test_batch_size or global_batch_size)
         self.source = source or resolve_source(c)
         self.seed = config.train.seed
+        self._base_seed = self.seed  # reseed() anchor (rollback recovery)
 
         self.n_train = min(self.source.split_size("trainA"), self.source.split_size("trainB"))
         self.n_test = min(self.source.split_size("testA"), self.source.split_size("testB"))
@@ -93,6 +94,20 @@ class CycleGANData:
         # main.py:53-54) when cache_augmented.
         self._train_cache: Optional[Tuple[List[np.ndarray], List[np.ndarray]]] = None
         if c.cache_augmented:
+            self._train_cache = (
+                self._prep_train("trainA", epoch=0),
+                self._prep_train("trainB", epoch=0),
+            )
+
+    def reseed(self, salt: int) -> None:
+        """Derive a new deterministic seed from the base seed + salt —
+        the rollback path (resil/rollback.py) calls this so replayed
+        epochs walk a different (but still reproducible) shuffle order
+        and augmentation stream instead of re-entering the exact batch
+        sequence that preceded a numeric fault. Rebuilds the epoch-0
+        augmentation cache, which was materialized under the old seed."""
+        self.seed = (self._base_seed + 0x9E3779B1 * int(salt)) % (1 << 32)
+        if self._train_cache is not None:
             self._train_cache = (
                 self._prep_train("trainA", epoch=0),
                 self._prep_train("trainB", epoch=0),

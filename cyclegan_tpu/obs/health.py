@@ -170,8 +170,10 @@ class HealthMonitor:
         collapse_patience: int = 50,
         echo=None,
     ):
-        if on_nan not in ("warn", "halt"):
-            raise ValueError(f"on_nan must be 'warn' or 'halt', got {on_nan!r}")
+        if on_nan not in ("warn", "halt", "rollback"):
+            raise ValueError(
+                f"on_nan must be 'warn', 'halt', or 'rollback', "
+                f"got {on_nan!r}")
         self.telemetry = telemetry
         self.on_nan = on_nan
         self.divergence_multiple = float(divergence_multiple)
@@ -250,7 +252,11 @@ class HealthMonitor:
         self._nonfinite_rows += 1
         self._fault(
             "nonfinite",
-            halt=self.on_nan == "halt",
+            # "rollback" also raises HealthFault out of the loop — the
+            # difference is who catches it: main.py's RollbackController
+            # turns it into a restore + rewind instead of exit 3.
+            halt=self.on_nan in ("halt", "rollback"),
+            policy=self.on_nan,
             count=None if not math.isfinite(count) else int(count),
             bad_losses=bad_losses,
             message=(
@@ -341,7 +347,8 @@ class HealthMonitor:
                     ),
                 )
 
-    def _fault(self, kind: str, halt: bool, message: str, **details) -> None:
+    def _fault(self, kind: str, halt: bool, message: str,
+               policy: str = None, **details) -> None:
         self.fault_counts[kind] = self.fault_counts.get(kind, 0) + 1
         self._epoch_faults[kind] = self._epoch_faults.get(kind, 0) + 1
         tele = self.telemetry
@@ -351,7 +358,7 @@ class HealthMonitor:
                 kind=kind,
                 epoch=self._epoch,
                 row=self._row,
-                policy="halt" if halt else "warn",
+                policy=policy or ("halt" if halt else "warn"),
                 **{k: v for k, v in details.items() if v is not None},
             )
         if self.echo is not None and self._epoch_faults[kind] == 1:

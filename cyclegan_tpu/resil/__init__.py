@@ -1,0 +1,61 @@
+"""Resilience layer: deterministic fault injection, bounded-backoff
+retries, and in-run rollback — detection (obs/health.py) turned into
+recovery.
+
+Three modules, one per recovery mechanism:
+
+- faults.py   — the seeded fault-injection registry behind ``--inject``:
+                every recovery path in this repo is exercised on CPU by
+                deterministically injecting the failure it exists for
+                (NaN'd gradients, checkpoint I/O errors, replica
+                crashes, data stalls, SIGTERM), instead of waiting for
+                a TPU pod to produce it at 3am. All injection points
+                are HOST-SIDE ONLY (docs/DESIGN.md): faults fire at
+                dispatch/IO boundaries, never inside a traced program,
+                so the compiled step under test is bit-identical to
+                production and the no-fault path costs one `is not
+                None` check.
+- retry.py    — bounded exponential backoff with deterministic jitter
+                around host I/O (Orbax save/restore, sidecar reads, the
+                data-iterator ``next()``), emitting ``retry`` telemetry
+                events so absorbed faults stay visible in the stream.
+- rollback.py — the ``--on_nan rollback`` policy: a HealthFault becomes
+                a restore of the newest *verified* checkpoint-ring slot
+                (utils/checkpoint.py), an epoch rewind, a re-seeded
+                data pipeline, and a ``health_recovery`` event — the
+                run halts only after ``--max_rollbacks`` consecutive
+                failures.
+
+tools/check_no_sync.py scans this package as hot-path with ZERO
+sanctioned sites: resilience must never add a device sync to the loop.
+"""
+
+from cyclegan_tpu.resil.faults import (
+    FAULT_KINDS,
+    Fault,
+    FaultInjector,
+    InjectedCrash,
+    InjectedIOError,
+)
+from cyclegan_tpu.resil.retry import (
+    DEFAULT_RETRY_POLICY,
+    RetryPolicy,
+    RetryingIterator,
+    backoff_delay,
+    retry_call,
+)
+from cyclegan_tpu.resil.rollback import RollbackController
+
+__all__ = [
+    "DEFAULT_RETRY_POLICY",
+    "FAULT_KINDS",
+    "Fault",
+    "FaultInjector",
+    "InjectedCrash",
+    "InjectedIOError",
+    "RetryPolicy",
+    "RetryingIterator",
+    "RollbackController",
+    "backoff_delay",
+    "retry_call",
+]

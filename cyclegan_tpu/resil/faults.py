@@ -1,0 +1,199 @@
+"""Deterministic, seeded fault injection (``--inject``).
+
+Spec grammar — comma-separated entries, each ``kind@key=N`` with an
+optional ``xM`` repeat count (default 1)::
+
+    --inject nan_grads@step=6
+    --inject ckpt_io_error@epoch=0x2,replica_crash@flush=1
+    --inject data_stall@step=3,sigterm@step=40
+
+Kinds and where they fire (ALL host-side — see docs/DESIGN.md):
+
+- ``nan_grads@step=K``     — the K-th dispatched train step's input
+  batch is multiplied by NaN at the dispatch boundary (train/loop.py →
+  train/steps.py poison helper). The poison flows through the untouched
+  jitted step and surfaces as non-finite gradients — exactly the
+  production failure the ``--on_nan`` tripwire exists for.
+- ``ckpt_io_error@epoch=N`` — the checkpoint save I/O for epoch N
+  raises ``InjectedIOError`` inside the retry wrapper
+  (utils/checkpoint.py → resil/retry.py), exercising bounded backoff.
+- ``replica_crash@flush=M`` — the fleet's M-th replica flush dies
+  mid-flight (``InjectedCrash`` escapes the worker loop, thread exits
+  without resolving futures or freeing itself) — the failure the
+  FleetExecutor's self-healing monitor recovers from.
+- ``data_stall@step=K``     — the K-th staged-batch fetch raises a
+  transient ``InjectedIOError`` inside the data path's RetryingIterator.
+- ``sigterm@step=K``        — the process signals ITSELF with SIGTERM
+  at the K-th dispatched step, driving the PreemptionGuard's
+  finish-epoch/checkpoint/exit path.
+
+Determinism: firing is a pure function of the spec and the per-site
+counters the run advances (no clocks, no RNG), so a drill replays
+identically; ``times`` (the ``xM`` suffix) lets one fault outlast a
+retry budget. The no-fault cost is a single ``injector is not None``
+check at each site — ``from_spec("")`` returns None so disabled runs
+never construct an injector at all.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, List, Optional
+
+# kind -> (spec index key, check site). The check site names the
+# counter (or explicit index) the fault is matched against; several
+# kinds share the "step" site so one dispatch check covers them all.
+FAULT_KINDS: Dict[str, tuple] = {
+    "nan_grads": ("step", "step"),
+    "sigterm": ("step", "step"),
+    "data_stall": ("step", "data"),
+    "ckpt_io_error": ("epoch", "ckpt"),
+    "replica_crash": ("flush", "flush"),
+}
+
+_SPEC_RE = re.compile(
+    r"^(?P<kind>[a-z_]+)@(?P<key>[a-z]+)=(?P<at>\d+)(?:x(?P<times>\d+))?$"
+)
+
+
+class InjectedIOError(OSError):
+    """A transient I/O failure injected under ``--inject`` — retryable
+    by design (subclasses OSError so the retry machinery treats it
+    exactly like a real filesystem/network error)."""
+
+
+class InjectedCrash(BaseException):
+    """A simulated hard replica crash: derives from BaseException so
+    the replica worker's fail-the-flush Exception handler does NOT
+    absorb it — the thread dies with its futures unresolved, which is
+    the failure mode the fleet monitor must recover from."""
+
+
+class Fault:
+    """One armed fault: fires when its site counter/index reaches
+    ``at``, up to ``times`` times."""
+
+    __slots__ = ("kind", "site", "at", "times", "fired")
+
+    def __init__(self, kind: str, at: int, times: int = 1):
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r}; have {sorted(FAULT_KINDS)}")
+        if at < 0 or times < 1:
+            raise ValueError(f"fault {kind}: at must be >= 0 and times >= 1")
+        self.kind = kind
+        self.site = FAULT_KINDS[kind][1]
+        self.at = int(at)
+        self.times = int(times)
+        self.fired = 0
+
+    @property
+    def exhausted(self) -> bool:
+        return self.fired >= self.times
+
+    def __repr__(self) -> str:  # telemetry/debug
+        key = FAULT_KINDS[self.kind][0]
+        sfx = f"x{self.times}" if self.times != 1 else ""
+        return f"{self.kind}@{key}={self.at}{sfx}"
+
+
+def parse_spec(spec: str) -> List[Fault]:
+    """Parse a ``--inject`` string into Fault objects; '' -> []."""
+    faults: List[Fault] = []
+    for entry in (spec or "").replace(" ", "").split(","):
+        if not entry:
+            continue
+        m = _SPEC_RE.match(entry)
+        if m is None:
+            raise ValueError(
+                f"bad --inject entry {entry!r}: expected kind@key=N[xM], "
+                f"e.g. nan_grads@step=6 or ckpt_io_error@epoch=0x2")
+        kind, key = m.group("kind"), m.group("key")
+        want = FAULT_KINDS.get(kind, (None,))[0]
+        if want is None:
+            raise ValueError(
+                f"unknown fault kind {kind!r}; have {sorted(FAULT_KINDS)}")
+        if key != want:
+            raise ValueError(
+                f"fault {kind} is indexed by {want!r}, not {key!r} "
+                f"(write {kind}@{want}=N)")
+        faults.append(Fault(kind, int(m.group("at")),
+                            int(m.group("times") or 1)))
+    return faults
+
+
+class FaultInjector:
+    """The per-run fault registry. Sites pass through ``fire()`` which
+    advances that site's counter (or matches an explicit index) and
+    returns the faults that just armed. Thread-safe: the fleet's
+    replica threads share the ``flush`` counter."""
+
+    def __init__(self, faults: List[Fault], telemetry=None):
+        self.faults = list(faults)
+        self.telemetry = telemetry
+        self._counters: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_spec(cls, spec: Optional[str],
+                  telemetry=None) -> Optional["FaultInjector"]:
+        """None for an empty spec — callers keep the zero-cost
+        ``injector is None`` fast path."""
+        faults = parse_spec(spec or "")
+        return cls(faults, telemetry=telemetry) if faults else None
+
+    def fire(self, site: str, index: Optional[int] = None,
+             advance: int = 1) -> List[Fault]:
+        """Check (and consume) faults at ``site``. With ``index`` None
+        the site's internal counter advances by ``advance`` and a fault
+        fires if its ``at`` falls inside the covered window [c, c+adv)
+        — a fused K-step dispatch covers K step indices. A counter-site
+        fault with ``times`` left keeps firing on later checks even
+        though the counter moved past it (a "stuck" fault: how
+        ``data_stall@step=Kx2`` outlasts one retry), so retry loops
+        re-check with ``advance=0``. With an explicit ``index`` (the
+        checkpoint path passes the epoch) the counter is untouched and
+        only exact index matches fire."""
+        fired: List[Fault] = []
+        with self._lock:
+            if index is None:
+                lo = self._counters.get(site, 0)
+                hi = lo + max(0, int(advance))
+                self._counters[site] = hi
+            else:
+                lo, hi = int(index), int(index) + 1
+            for f in self.faults:
+                if f.site != site or f.exhausted:
+                    continue
+                stuck = index is None and 0 < f.fired < f.times
+                if stuck or lo <= f.at < hi:
+                    f.fired += 1
+                    fired.append(f)
+        for f in fired:
+            if self.telemetry is not None:
+                self.telemetry.event(
+                    "fault_injected", kind=f.kind, site=site,
+                    at=f.at, fired=f.fired, of=f.times, spec=repr(f))
+        return fired
+
+    def maybe_raise(self, site: str, index: Optional[int] = None,
+                    advance: int = 1) -> None:
+        """I/O-site variant: a fired ckpt_io_error/data_stall raises
+        ``InjectedIOError`` — transient by contract, absorbed by the
+        retry wrapper it fires inside. Retry loops pass ``advance=0``
+        on attempts after the first so backoff attempts don't consume
+        data indices."""
+        for f in self.fire(site, index=index, advance=advance):
+            if f.kind in ("ckpt_io_error", "data_stall"):
+                raise InjectedIOError(
+                    f"injected {f.kind} ({f!r}, firing {f.fired}/{f.times})")
+
+    def pending(self) -> List[Fault]:
+        """Faults that have not (fully) fired — drills assert this
+        drains to [] so a mis-indexed spec fails loudly."""
+        with self._lock:
+            return [f for f in self.faults if not f.exhausted]
+
+    def __repr__(self) -> str:
+        return f"FaultInjector({', '.join(map(repr, self.faults))})"

@@ -1,0 +1,160 @@
+"""Bounded exponential backoff with deterministic jitter.
+
+The training path previously had ZERO retry anywhere: one transient
+filesystem hiccup during an Orbax commit, one flaky NFS read of the
+epoch sidecar, one stalled data fetch — and the run died with hours of
+work behind it. ``retry_call`` wraps those host I/O boundaries
+(utils/checkpoint.py save/restore + sidecar reads; train/loop.py wraps
+its staged-batch iterator in ``RetryingIterator``) with a bounded
+budget: transient errors are absorbed, persistent ones still fail the
+run after ``attempts`` tries.
+
+Every absorbed failure emits a ``retry`` telemetry event (site,
+attempt, delay, error) so recovery is visible in the stream —
+tools/obs_report.py folds them into the Resilience section and
+tools/run_compare.py's recovery axis gates on them.
+
+Jitter is DETERMINISTIC (sha256 of site/index/attempt, not a clock or
+global RNG): two processes retrying the same op still decorrelate, and
+a chaos drill replays the same delays every run. All of this is pure
+host code — tools/check_no_sync.py scans this package with zero
+sanctioned sync sites.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from typing import Callable, Iterable, Iterator, Optional, Tuple
+
+# What counts as transient: OS-level I/O errors (InjectedIOError
+# subclasses OSError) and timeouts. ValueError/TypeError and friends
+# are bugs, not weather — they propagate immediately.
+RETRYABLE: Tuple[type, ...] = (OSError, TimeoutError)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """attempts = TOTAL tries (1 initial + attempts-1 retries)."""
+
+    attempts: int = 4
+    base_delay_s: float = 0.05
+    multiplier: float = 2.0
+    max_delay_s: float = 2.0
+    jitter: float = 0.25  # fraction of the delay shaved off, [0, 1)
+
+    def __post_init__(self):
+        if self.attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {self.attempts}")
+        if not 0.0 <= self.jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {self.jitter}")
+
+
+DEFAULT_RETRY_POLICY = RetryPolicy()
+
+
+def backoff_delay(policy: RetryPolicy, attempt: int,
+                  site: str = "", salt: int = 0) -> float:
+    """Delay before retry ``attempt`` (0-based): capped exponential,
+    shaved by deterministic jitter derived from (site, salt, attempt)."""
+    base = min(policy.base_delay_s * (policy.multiplier ** attempt),
+               policy.max_delay_s)
+    if policy.jitter <= 0.0:
+        return base
+    digest = hashlib.sha256(
+        f"{site}:{salt}:{attempt}".encode()).digest()
+    frac = int.from_bytes(digest[:8], "big") / float(1 << 64)
+    return base * (1.0 - policy.jitter * frac)
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    site: str,
+    policy: RetryPolicy = DEFAULT_RETRY_POLICY,
+    telemetry=None,
+    injector=None,
+    index: Optional[int] = None,
+    retryable: Tuple[type, ...] = RETRYABLE,
+    sleep: Callable[[float], None] = time.sleep,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)`` with bounded-backoff retries.
+
+    ``site`` names the operation in ``retry`` events and seeds the
+    jitter; ``index`` (e.g. the epoch) both salts the jitter and is the
+    injection index — when ``injector`` carries a matching
+    ``ckpt_io_error``/``data_stall`` fault, it raises inside the try so
+    the injected failure exercises the SAME absorb path a real one
+    would. The final attempt's failure re-raises unchanged."""
+    last_attempt = policy.attempts - 1
+    for attempt in range(policy.attempts):
+        try:
+            if injector is not None:
+                injector.maybe_raise(site, index=index)
+            return fn(*args, **kwargs)
+        except retryable as e:
+            if attempt >= last_attempt:
+                raise
+            delay = backoff_delay(policy, attempt, site=site,
+                                  salt=index or 0)
+            if telemetry is not None:
+                telemetry.event(
+                    "retry", site=site, attempt=attempt + 1,
+                    of=policy.attempts, delay_s=round(delay, 4),
+                    error=f"{type(e).__name__}: {e}")
+            sleep(delay)
+
+
+class RetryingIterator:
+    """``next()`` with the same bounded-backoff contract, for iterators
+    whose fetch can transiently fail (network-backed data sources; the
+    injected ``data_stall`` fault). StopIteration passes straight
+    through — end-of-data is not an error. NOTE: a plain generator
+    cannot be resumed after it raises; what the retry budget genuinely
+    covers is (a) injected stalls, which fire in this wrapper BEFORE
+    delegating, and (b) inner iterators that are restartable readers
+    rather than generators."""
+
+    def __init__(self, it: Iterable, site: str = "data",
+                 policy: RetryPolicy = DEFAULT_RETRY_POLICY,
+                 telemetry=None, injector=None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self._it: Iterator = iter(it)
+        self._site = site
+        self._policy = policy
+        self._telemetry = telemetry
+        self._injector = injector
+        self._sleep = sleep
+        self._i = 0  # jitter salt only; the injector owns fault counters
+
+    def __iter__(self) -> "RetryingIterator":
+        return self
+
+    def __next__(self):
+        self._i += 1
+        last_attempt = self._policy.attempts - 1
+        for attempt in range(self._policy.attempts):
+            try:
+                if self._injector is not None:
+                    # Only the first attempt consumes a data index; the
+                    # backoff attempts re-check (advance=0) so a
+                    # multi-fire ("xM") stall can outlast one retry.
+                    self._injector.maybe_raise(
+                        self._site, advance=1 if attempt == 0 else 0)
+                return next(self._it)
+            except StopIteration:
+                raise
+            except RETRYABLE as e:
+                if attempt >= last_attempt:
+                    raise
+                delay = backoff_delay(self._policy, attempt,
+                                      site=self._site, salt=self._i)
+                if self._telemetry is not None:
+                    self._telemetry.event(
+                        "retry", site=self._site, attempt=attempt + 1,
+                        of=self._policy.attempts, delay_s=round(delay, 4),
+                        error=f"{type(e).__name__}: {e}")
+                self._sleep(delay)
+        raise AssertionError("unreachable: final attempt re-raises")

@@ -1,0 +1,104 @@
+"""The ``--on_nan rollback`` policy: HealthFault -> restore -> rewind.
+
+PR 5's health monitor can SEE a non-finite step within one
+deferred-fetch horizon; under ``--on_nan halt`` that knowledge buys an
+orderly death. This controller turns it into recovery: restore the
+newest *verified* checkpoint-ring slot into the live train state
+(reusing the sharding-aware restore in utils/checkpoint.py — the NaN'd
+state is only a structure/sharding template), rewind the epoch counter
+to the slot's, re-seed the data pipeline so the replayed epochs walk a
+salted batch order instead of marching back into the same poison, emit
+a ``health_recovery`` event, and keep training. Only after
+``--max_rollbacks`` CONSECUTIVE faults (no clean epoch in between) does
+the original HealthFault propagate and the run halt with exit 3 —
+persistent numeric collapse still fails loudly; a one-off cosmic ray or
+data glitch no longer costs the run.
+
+Everything here is host-side orchestration between epochs: zero device
+syncs, zero dispatches on the no-fault path (the controller is not even
+consulted until a HealthFault is already in flight)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+
+class RollbackController:
+    """Owns the rollback budget and the recovery sequence. main.py
+    constructs one when ``config.obs.on_nan == "rollback"`` and calls
+    ``recover`` from its HealthFault handler; ``note_clean_epoch``
+    resets the consecutive-failure count after every epoch that
+    completes without a fault."""
+
+    def __init__(self, ckpt, data=None, telemetry=None,
+                 max_rollbacks: int = 2, echo=None):
+        if max_rollbacks < 0:
+            raise ValueError(
+                f"max_rollbacks must be >= 0, got {max_rollbacks}")
+        self.ckpt = ckpt
+        self.data = data
+        self.telemetry = telemetry
+        self.max_rollbacks = int(max_rollbacks)
+        self.echo = echo
+        self.consecutive = 0
+        self.total = 0
+
+    def note_clean_epoch(self) -> None:
+        self.consecutive = 0
+
+    def recover(self, template, fault, epoch: int,
+                services=None, partial: bool = False) -> Tuple[object, int]:
+        """Attempt one rollback; returns (restored_state, next_epoch).
+        Re-raises ``fault`` when the budget is exhausted or no verified
+        slot exists to roll back to (the halt path — main.py's existing
+        HealthFault handler then exits 3 with the stream flushed)."""
+        if self.consecutive >= self.max_rollbacks:
+            self._echo(
+                f"rollback budget exhausted ({self.consecutive} consecutive "
+                f"of max {self.max_rollbacks}): halting")
+            raise fault
+        # A prior epoch's async save may still be committing — its slot
+        # must land (and its manifest be written) before we pick the
+        # newest verified slot to restore.
+        if services is not None:
+            services.barrier()
+        if not self.ckpt.exists():
+            self._echo("no checkpoint slot exists to roll back to: halting")
+            raise fault
+        try:
+            state, next_epoch = self.ckpt.restore(template, partial=partial)
+        except Exception as e:
+            self._echo(f"rollback restore failed ({type(e).__name__}: {e}): "
+                       "halting")
+            raise fault from e
+        self.consecutive += 1
+        self.total += 1
+        if self.data is not None and hasattr(self.data, "reseed"):
+            # Salted data order for the replayed epochs: a fault caused
+            # by a pathological batch sequence must not be replayed
+            # verbatim into the same wall (deterministic per salt, so a
+            # drill still reproduces exactly).
+            self.data.reseed(self.total)
+        slot = getattr(self.ckpt, "slot", None)
+        if self.telemetry is not None:
+            self.telemetry.event(
+                "health_recovery",
+                fault_kind=getattr(fault, "kind", "unknown"),
+                epoch_faulted=int(epoch),
+                resume_epoch=int(next_epoch),
+                slot=slot,
+                consecutive=self.consecutive,
+                total=self.total,
+                max_rollbacks=self.max_rollbacks,
+            )
+            self.telemetry.flush()
+        self._echo(
+            f"HEALTH ROLLBACK ({getattr(fault, 'kind', '?')}): restored "
+            f"{slot}, rewinding epoch {epoch} -> {next_epoch} "
+            f"(rollback {self.consecutive}/{self.max_rollbacks} consecutive, "
+            f"{self.total} total)")
+        return state, next_epoch
+
+    def _echo(self, msg: str) -> None:
+        if self.echo is not None:
+            self.echo(f"resil: {msg}")

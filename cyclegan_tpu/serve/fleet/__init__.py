@@ -21,7 +21,14 @@ Four pieces, one per production failure mode:
                   continuous batching: the moment any replica frees it
                   refills a bucket from whatever is queued (partial
                   buckets ride the max-wait bound), instead of
-                  flush-and-wait.
+                  flush-and-wait. Its monitor thread is the fleet's
+                  self-healing: a dead or wedged replica is detected by
+                  heartbeat/thread-liveness, its in-flight requests are
+                  re-enqueued (attempt-counted, re-shed if their
+                  deadline passed), the worker is respawned, and a
+                  replica failing repeatedly is circuit-broken out of
+                  the fleet (fleet_replica_down / fleet_recovery
+                  events).
 
 tools/check_no_sync.py scans this package as hot-path: the replica's
 one deferred fetch per flush is the only sanctioned device_get.
@@ -38,7 +45,7 @@ from cyclegan_tpu.serve.fleet.classes import (
     class_map,
 )
 from cyclegan_tpu.serve.fleet.controller import FleetConfig, FleetExecutor
-from cyclegan_tpu.serve.fleet.replica import ReplicaWorker
+from cyclegan_tpu.serve.fleet.replica import ReplicaCrashed, ReplicaWorker
 
 __all__ = [
     "AdmissionController",
@@ -47,6 +54,7 @@ __all__ = [
     "DeadlineExceeded",
     "FleetConfig",
     "FleetExecutor",
+    "ReplicaCrashed",
     "ReplicaWorker",
     "ShedError",
     "class_map",

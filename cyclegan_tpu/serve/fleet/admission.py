@@ -71,7 +71,7 @@ class FleetRequest:
     EDF orders by."""
 
     __slots__ = ("image", "size", "tier", "klass", "future", "t_submit",
-                 "deadline", "shed")
+                 "deadline", "shed", "attempts")
 
     def __init__(self, image, size: int, tier: str,
                  klass: DeadlineClass, now: Optional[float] = None):
@@ -83,6 +83,12 @@ class FleetRequest:
         self.t_submit = time.perf_counter() if now is None else now
         self.deadline = self.t_submit + klass.deadline_ms / 1000.0
         self.shed = False  # lazy deletion flag (evicted while heaped)
+        # Dispatch count, bumped by the fleet's crash-recovery path when
+        # it re-enqueues this request: the original deadline and
+        # t_submit survive re-admission (latency accounting and EDF
+        # order stay honest), and FleetConfig.max_request_attempts
+        # bounds how often a possibly-poisonous request may be retried.
+        self.attempts = 0
 
 
 class AdmissionController:

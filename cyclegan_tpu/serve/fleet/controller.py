@@ -47,7 +47,7 @@ from cyclegan_tpu.serve.fleet.classes import (
     DeadlineClass,
     class_map,
 )
-from cyclegan_tpu.serve.fleet.replica import ReplicaWorker
+from cyclegan_tpu.serve.fleet.replica import ReplicaCrashed, ReplicaWorker
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -69,6 +69,22 @@ class FleetConfig:
     max_wait_ms: float = 5.0     # partial-bucket coalescing window
     classes: Tuple[DeadlineClass, ...] = DEFAULT_CLASSES
     default_class: str = "batch"
+    # Self-healing knobs. Crash detection (replica thread dead with a
+    # flush in flight) is always on; `wedge_timeout_s` additionally
+    # treats a flush stuck past that wall (thread alive but hung in the
+    # engine/fetch) as down — None disables wedge detection, since a
+    # legitimate cold-compile flush can take arbitrarily long.
+    wedge_timeout_s: Optional[float] = None
+    # Consecutive failures after which a replica's circuit opens: it is
+    # no longer respawned (its slot leaves the fleet) — a replica dying
+    # every flush would otherwise grind the queue forever. A completed
+    # flush resets the count.
+    max_replica_failures: int = 3
+    # Total dispatches one request may consume across crash recoveries
+    # before its future fails with ReplicaCrashed (bounds the damage of
+    # a poison batch that kills every replica it touches).
+    max_request_attempts: int = 2
+    health_poll_s: float = 0.05  # monitor thread cadence
 
     def __post_init__(self):
         if self.n_replicas < 1:
@@ -77,6 +93,21 @@ class FleetConfig:
         if self.max_wait_ms < 0:
             raise ValueError(
                 f"max_wait_ms must be >= 0, got {self.max_wait_ms}")
+        if self.wedge_timeout_s is not None and self.wedge_timeout_s <= 0:
+            raise ValueError(
+                f"wedge_timeout_s must be > 0 or None, "
+                f"got {self.wedge_timeout_s}")
+        if self.max_replica_failures < 1:
+            raise ValueError(
+                f"max_replica_failures must be >= 1, "
+                f"got {self.max_replica_failures}")
+        if self.max_request_attempts < 1:
+            raise ValueError(
+                f"max_request_attempts must be >= 1, "
+                f"got {self.max_request_attempts}")
+        if self.health_poll_s <= 0:
+            raise ValueError(
+                f"health_poll_s must be > 0, got {self.health_poll_s}")
         names = {c.name for c in self.classes}
         if self.default_class not in names:
             raise ValueError(
@@ -95,10 +126,12 @@ class FleetExecutor:
     """
 
     def __init__(self, engine: InferenceEngine,
-                 cfg: Optional[FleetConfig] = None, *, logger=None):
+                 cfg: Optional[FleetConfig] = None, *, logger=None,
+                 injector=None):
         self.engine = engine
         self.cfg = cfg or FleetConfig()
         self._logger = logger
+        self._injector = injector
         self._classes = class_map(self.cfg.classes)
         max_batch = (engine.max_batch if self.cfg.max_batch is None
                      else self.cfg.max_batch)
@@ -117,7 +150,7 @@ class FleetExecutor:
         self._free: "queue.Queue" = queue.Queue()
         self.replicas = [
             ReplicaWorker(i, engine, on_free=self._free.put,
-                          on_done=self._on_done)
+                          on_done=self._on_done, injector=injector)
             for i in range(self.cfg.n_replicas)
         ]
         for r in self.replicas:
@@ -134,10 +167,21 @@ class FleetExecutor:
         self._n_refill = 0
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
+        # Self-healing state (slot-indexed; guarded by _stats_lock).
+        self._fail_counts = [0] * self.cfg.n_replicas
+        self._circuit_open = [False] * self.cfg.n_replicas
+        self._n_recoveries = 0
+        self._n_requeued = 0
+        self._n_crash_failed = 0
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
             name="fleet-dispatcher")
         self._dispatcher.start()
+        self._monitor_stop = threading.Event()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name="fleet-monitor")
+        self._monitor.start()
 
     # -- submission --------------------------------------------------------
     def submit_raw(self, img: np.ndarray, klass: Optional[str] = None,
@@ -177,6 +221,16 @@ class FleetExecutor:
     def _dispatch_loop(self) -> None:
         while True:
             replica = self._free.get()
+            if replica is None:
+                # close() sentinel: wakes a dispatcher starved of free
+                # replicas (every slot crashed and circuit-opened) so
+                # shutdown never hangs on this get().
+                return
+            if replica.abandoned:
+                # A wedged replica that revived after the monitor gave
+                # up on it and re-put itself: its slot already hosts a
+                # respawn (or an open circuit) — drop, don't re-use.
+                continue
             batch = self.admission.next_batch(self._max_batch,
                                               self._max_wait_s)
             if batch is None:  # closed and drained
@@ -196,16 +250,110 @@ class FleetExecutor:
                 trigger = "refill"
             else:
                 trigger = "window"
+            # Stamp the in-flight record BEFORE the hand-off: if the
+            # worker thread is already dead (crashed between flushes)
+            # the batch would otherwise strand invisibly in its inbox.
+            replica.inflight = (batch, time.perf_counter())
             replica.dispatch(batch, trigger)
+
+    # -- self-healing (monitor thread) -------------------------------------
+    def _monitor_loop(self) -> None:
+        """Detect dead or wedged replicas and route them through
+        _recover. Polling (not event-driven) on purpose: the failure
+        being detected is precisely the one that fires no callback."""
+        while not self._monitor_stop.wait(self.cfg.health_poll_s):
+            now = time.perf_counter()
+            for slot, replica in enumerate(self.replicas):
+                if replica.abandoned or self._circuit_open[slot]:
+                    continue
+                inflight = replica.inflight
+                if not replica.alive():
+                    if inflight is not None or replica.crashed:
+                        self._recover(slot, replica, "crash")
+                    continue
+                if (self.cfg.wedge_timeout_s is not None
+                        and inflight is not None
+                        and now - inflight[1] > self.cfg.wedge_timeout_s):
+                    self._recover(slot, replica, "wedge")
+
+    def _recover(self, slot: int, replica: ReplicaWorker,
+                 reason: str) -> None:
+        """One replica down: re-enqueue its stranded requests
+        (attempt-counted; expired sheddables re-shed at the next pop per
+        their deadline class), then respawn the slot unless its circuit
+        opens. Runs on the monitor thread only — never on the dispatch
+        or replica paths."""
+        inflight = replica.inflight
+        replica.abandoned = True
+        replica.inflight = None
+        batch = inflight[0] if inflight is not None else []
+        with self._stats_lock:
+            if inflight is not None:
+                self._busy -= 1
+            self._fail_counts[slot] += 1
+            consecutive = self._fail_counts[slot]
+            self._n_recoveries += 1
+        if self._logger is not None:
+            self._logger.event(
+                "fleet_replica_down",
+                replica=replica.replica_id, reason=reason,
+                inflight=len(batch), consecutive_failures=consecutive)
+        requeued = failed = 0
+        for req in batch:
+            if req.future.done():
+                continue
+            req.attempts += 1
+            if req.attempts >= self.cfg.max_request_attempts:
+                req.future.set_exception(ReplicaCrashed(
+                    f"replica {replica.replica_id} {reason}: request "
+                    f"burned {req.attempts}/"
+                    f"{self.cfg.max_request_attempts} attempts"))
+                failed += 1
+                continue
+            try:
+                self.admission.offer(req)
+                requeued += 1
+            except Exception as e:  # ShedError, or queue closed
+                req.future.set_exception(e)
+                failed += 1
+        open_circuit = consecutive >= self.cfg.max_replica_failures
+        respawned = False
+        if open_circuit or self._closed:
+            with self._stats_lock:
+                self._circuit_open[slot] = True
+        else:
+            self.replicas[slot] = ReplicaWorker(
+                replica.replica_id, self.engine, on_free=self._free.put,
+                on_done=self._on_done, injector=self._injector)
+            self._free.put(self.replicas[slot])
+            respawned = True
+        with self._stats_lock:
+            self._n_requeued += requeued
+            self._n_crash_failed += failed
+        if self._logger is not None:
+            self._logger.event(
+                "fleet_recovery",
+                replica=replica.replica_id, reason=reason,
+                respawned=respawned, requeued=requeued,
+                failed=failed, circuit_open=not respawned,
+                consecutive_failures=consecutive)
 
     # -- completion callback (replica threads) -----------------------------
     def _on_done(self, replica: ReplicaWorker,
                  batch: List[FleetRequest], n: int, trigger: str,
                  t0: float, t_dispatched: float, t_done: float) -> None:
+        if replica.abandoned:
+            # A revived wedge: _recover already settled this flush's
+            # accounting (busy count, requeues) — double-counting here
+            # would corrupt the rollup.
+            return
         self.admission.on_complete(n)
         lats = [(r.klass.name, t_done - r.t_submit,
                  t_done > r.deadline) for r in batch]
         with self._stats_lock:
+            # A completed flush closes the failure streak: the circuit
+            # breaker counts CONSECUTIVE failures per slot.
+            self._fail_counts[replica.replica_id] = 0
             self._busy -= 1
             self._n_done += n
             self._n_flushes += 1
@@ -264,6 +412,10 @@ class FleetExecutor:
             "admission": self.admission.stats(),
             "classes": per_class,
             "tiers": list(self.engine.tiers),
+            "recoveries": self._n_recoveries,
+            "requeued_requests": self._n_requeued,
+            "crash_failed_requests": self._n_crash_failed,
+            "circuits_open": sum(self._circuit_open),
         })
         return snap
 
@@ -274,10 +426,31 @@ class FleetExecutor:
         if self._closed:
             return {}
         self._closed = True
+        # Monitor first: a replica finishing its last flush during
+        # shutdown must not race a recovery respawn.
+        self._monitor_stop.set()
+        self._monitor.join(timeout=10.0)
         self.admission.close()
+        with self._stats_lock:
+            fleet_dead = all(self._circuit_open)
+        if fleet_dead:
+            # No live replica will ever free itself, so the dispatcher
+            # is parked on _free.get() forever: wake it with the close
+            # sentinel, then fail whatever is still queued — every
+            # future must resolve by the end of this call.
+            self._free.put(None)
         self._dispatcher.join(timeout=60.0)
-        for r in self.replicas:
-            r.close()
+        if fleet_dead:
+            while True:
+                stranded = self.admission.next_batch(self._max_batch, 0.0)
+                if not stranded:
+                    break
+                for req in stranded:
+                    if not req.future.done():
+                        req.future.set_exception(ReplicaCrashed(
+                            "fleet closed with every replica circuit "
+                            "open; request was never dispatched"))
+        unjoined = [r.replica_id for r in self.replicas if not r.close()]
         with self._stats_lock:
             wall = ((self._t_last - self._t_first)
                     if self._t_first is not None and
@@ -311,6 +484,15 @@ class FleetExecutor:
         summary["shed"] = adm["shed"]
         summary["shed_reasons"] = adm["shed_reasons"]
         summary["max_queue_depth"] = adm["max_depth"]
+        with self._stats_lock:
+            summary["recoveries"] = self._n_recoveries
+            summary["requeued_requests"] = self._n_requeued
+            summary["crash_failed_requests"] = self._n_crash_failed
+            summary["circuits_open"] = sum(self._circuit_open)
+        # Replicas that refused to join: a clean fleet reports [] here;
+        # anything else is a wedged worker the caller must not mistake
+        # for a completed shutdown.
+        summary["unjoined_replicas"] = unjoined
         if self._logger is not None:
             self._logger.event("fleet_summary", **summary)
         return summary

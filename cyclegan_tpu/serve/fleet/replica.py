@@ -16,6 +16,14 @@ The worker frees itself back to the dispatcher the moment its fetch
 lands and BEFORE resolving futures — continuous batching wants the next
 flush staged while this one's callers are still being woken.
 
+Failure surface (the part the FleetExecutor's health monitor watches):
+a per-flush engine/fetch error fails THAT flush's futures and keeps the
+replica alive, but a hard crash (a thread-killing error; under test,
+``--inject replica_crash@flush=M`` via resil/faults.py) exits the
+thread with its in-flight futures UNRESOLVED and without freeing itself
+— ``inflight``/``last_beat``/``crashed`` exist so the monitor can tell
+that apart from idle, re-enqueue the stranded requests, and respawn.
+
 The ``jax.device_get`` below is this package's single sanctioned sync
 point (one per flush); tools/check_no_sync.py enforces that it stays
 the only one.
@@ -25,13 +33,23 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, List, Optional
 
 import numpy as np
 
+from cyclegan_tpu.resil.faults import InjectedCrash
 from cyclegan_tpu.serve.fleet.admission import FleetRequest
 
 _STOP = object()
+
+
+class ReplicaCrashed(RuntimeError):
+    """Terminal request failure out of the fleet's recovery path: the
+    replica holding this request died (or wedged) and the request had
+    already burned its re-dispatch attempts (FleetConfig
+    .max_request_attempts) — re-enqueueing again would risk an unbounded
+    crash loop on a poison batch."""
 
 
 class ReplicaWorker:
@@ -41,63 +59,130 @@ class ReplicaWorker:
 
     def __init__(self, replica_id: int, engine,
                  on_free: Callable[["ReplicaWorker"], None],
-                 on_done: Optional[Callable] = None):
+                 on_done: Optional[Callable] = None,
+                 injector=None):
         self.replica_id = replica_id
         self.engine = engine
         self._on_free = on_free
         self._on_done = on_done
+        self.injector = injector
         self._inbox: "queue.Queue" = queue.Queue()
         self.n_flushes = 0
         self.n_images = 0
+        # Health surface, read by the controller's monitor thread:
+        # `inflight` is (batch, t_dispatch) set by the DISPATCHER before
+        # the hand-off and cleared HERE once the flush fully resolves —
+        # so it covers the whole window in which requests would strand
+        # if this thread died (including an item never picked up).
+        # `abandoned` is set by the monitor when it gives up on this
+        # worker; a wedged thread that later revives must then neither
+        # free itself nor double-report stats.
+        self.inflight = None
+        self.abandoned = False
+        self.crashed = False
+        self.last_beat = _now()
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"fleet-replica-{replica_id}")
         self._thread.start()
 
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
     def dispatch(self, batch: List[FleetRequest], trigger: str) -> None:
         self._inbox.put((batch, trigger))
 
-    def close(self, timeout: Optional[float] = 30.0) -> None:
+    def close(self, timeout: Optional[float] = 30.0) -> bool:
+        """Stop and join; True = the thread exited. False = it is STILL
+        RUNNING past the timeout (wedged in the engine or the fetch) —
+        callers must be able to tell a clean shutdown from a hung
+        replica, so this never silently succeeds: the controller folds
+        the unjoined ids into its close() summary and tests assert on
+        the return value."""
         self._inbox.put(_STOP)
         self._thread.join(timeout=timeout)
+        return not self._thread.is_alive()
 
     def _run(self) -> None:
-        import time
-
         import jax
 
+        try:
+            self._loop(jax)
+        except InjectedCrash:
+            # The simulated hard crash: die exactly as a real
+            # thread-killing failure would — in-flight futures
+            # unresolved, no on_free, no stats. The fleet monitor's job
+            # starts here.
+            self.crashed = True
+
+    def _loop(self, jax) -> None:
         while True:
             item = self._inbox.get()
             if item is _STOP:
                 return
             batch, trigger = item
-            t0 = time.perf_counter()
+            self.last_beat = _now()
+            if self.injector is not None:
+                # Host-side injection BEFORE the per-flush error handler:
+                # InjectedCrash must escape the worker (it subclasses
+                # BaseException precisely so the handler below cannot
+                # absorb it into the fail-the-flush path).
+                for fault in self.injector.fire("flush"):
+                    if fault.kind == "replica_crash":
+                        raise InjectedCrash(
+                            f"replica {self.replica_id}: {fault!r}")
+            t0 = _now()
             try:
                 x = np.stack([r.image for r in batch])
                 outs, n = self.engine.run(x, size=batch[0].size,
                                           tier=batch[0].tier)
-                t_dispatched = time.perf_counter()
+                t_dispatched = _now()
                 host = jax.device_get(outs)  # sanctioned-fetch: the replica's one deferred D2H per flush
-            except BaseException as e:  # noqa: BLE001 — fail the flush, keep the replica
+            except Exception as e:  # noqa: BLE001 — fail the flush, keep the replica
+                self.inflight = None
+                if self.abandoned:
+                    continue
                 self._on_free(self)
                 for r in batch:
                     if not r.future.done():
                         r.future.set_exception(e)
                 continue
-            t_done = time.perf_counter()
-            # Free FIRST: the dispatcher can stage the next flush while
-            # this thread is still waking callers below.
+            t_done = _now()
+            self.last_beat = t_done
+            if self.abandoned:
+                # The monitor already gave up on this flush (wedge
+                # timeout) and re-enqueued/failed its requests; resolve
+                # any still-unclaimed futures but stay out of the free
+                # queue and the stats.
+                self._resolve(batch, host)
+                self.inflight = None
+                continue
+            # Clear inflight BEFORE freeing: the moment this replica is
+            # back on the free queue the dispatcher may hand it the next
+            # flush and stamp a new `inflight` — clearing afterwards
+            # would wipe that record and blind the monitor to it.
+            self.inflight = None
+            # Free FIRST (before waking callers): the dispatcher can
+            # stage the next flush while this thread resolves futures.
             self._on_free(self)
-            fake = host[0]
-            cycled = host[1] if len(host) > 1 else None
-            for i, r in enumerate(batch):
-                result = {"fake": fake[i]}
-                if cycled is not None:
-                    result["cycled"] = cycled[i]
-                if not r.future.done():
-                    r.future.set_result(result)
+            self._resolve(batch, host)
             self.n_flushes += 1
             self.n_images += n
             if self._on_done is not None:
                 self._on_done(self, batch, n, trigger,
                               t0, t_dispatched, t_done)
+
+    @staticmethod
+    def _resolve(batch: List[FleetRequest], host) -> None:
+        fake = host[0]
+        cycled = host[1] if len(host) > 1 else None
+        for i, r in enumerate(batch):
+            result = {"fake": fake[i]}
+            if cycled is not None:
+                result["cycled"] = cycled[i]
+            if not r.future.done():
+                r.future.set_result(result)
+
+
+def _now() -> float:
+    return time.perf_counter()

@@ -12,6 +12,8 @@ values under the right labels.
 
 from __future__ import annotations
 
+import os
+import signal
 import time
 from time import perf_counter
 from typing import Callable, Dict
@@ -24,6 +26,7 @@ from cyclegan_tpu.data.pipeline import CycleGANData
 from cyclegan_tpu.obs.telemetry import NULL_TELEMETRY
 from cyclegan_tpu.parallel.mesh import MeshPlan
 from cyclegan_tpu.parallel.dp import shard_batch, shard_stacked_batch
+from cyclegan_tpu.train import steps as steps_mod
 from cyclegan_tpu.train.state import CycleGANState
 from cyclegan_tpu.utils.dicts import append_dict, mean_dict
 from cyclegan_tpu.utils.summary import Summary
@@ -109,6 +112,7 @@ def train_epoch(
     multi_step_fn: Callable = None,
     obs=None,
     health=None,
+    injector=None,
 ) -> CycleGANState:
     """One training pass (reference main.py:332-341). `tracer` is an
     optional utils.profiler.TraceCapture stepped once per train step.
@@ -180,6 +184,15 @@ def train_epoch(
 
     multi = multi_step_fn is not None and k > 1
     staged = _staged_batches(config, data, plan, epoch, multi)
+    if injector is not None:
+        # Fault-path only (the no-fault cost of --inject is the `is not
+        # None` checks in this function): staged fetches gain the
+        # bounded-backoff retry that absorbs an injected data_stall.
+        # Wrapped BEFORE prefetch so retries run where the fetch runs.
+        from cyclegan_tpu.resil.retry import RetryingIterator
+
+        staged = RetryingIterator(staged, site="data",
+                                  telemetry=obs, injector=injector)
     depth = config.train.prefetch_batches
     if depth > 0:
         # Device staging runs ahead on a worker thread (reference
@@ -215,6 +228,18 @@ def train_epoch(
         except StopIteration:
             break
         clock.staged()
+        if injector is not None:
+            # Host-side injection at the dispatch boundary: a fused
+            # dispatch covers K step indices. nan_grads poisons the
+            # INPUT batch (the jitted step stays untouched — see
+            # steps.poison_batch_for_fault); sigterm signals this very
+            # process, driving the PreemptionGuard's real handler.
+            for fault in injector.fire(
+                    "step", advance=k if kind == "multi" else 1):
+                if fault.kind == "nan_grads":
+                    xs, ys = steps_mod.poison_batch_for_fault(xs, ys)
+                elif fault.kind == "sigterm":
+                    os.kill(os.getpid(), signal.SIGTERM)
         if tracer is not None and depth > 0:
             tracer.step()
         if kind == "multi":

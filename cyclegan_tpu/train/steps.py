@@ -506,3 +506,15 @@ def make_test_step(config: Config, global_batch_size: int):
         }
 
     return test_step
+
+
+def poison_batch_for_fault(xs, ys):
+    """Apply the injected ``nan_grads`` fault (resil/faults.py) to one
+    staged batch pair, host-side at the dispatch boundary: multiplying
+    the inputs by NaN guarantees non-finite activations, losses, and
+    gradients out of the UNMODIFIED jitted train step — the injection
+    never touches a traced program, so the step under test is
+    bit-identical to production (docs/DESIGN.md). Fault path only; the
+    no-fault path never calls this."""
+    nan = float("nan")
+    return xs * nan, ys * nan

@@ -1,96 +1,327 @@
-"""Single-slot auto-resume checkpointing via Orbax.
+"""Verified checkpoint-ring auto-resume via Orbax.
 
-Equivalent of the reference's tf.train.Checkpoint flow
-(/root/reference/main.py:148-170): one overwritten slot at
+Descends from the reference's tf.train.Checkpoint flow
+(/root/reference/main.py:148-170): an overwritten slot at
 `<output_dir>/checkpoints/`, written every N epochs, auto-restored on
-startup if present. Improvements over the reference (SURVEY.md §5):
-the epoch counter is saved too, so resume continues from the right epoch
-instead of restarting at 0, and saving is multi-host-safe (Orbax
-coordinates across processes; the epoch sidecar is written by host 0).
+startup. Beyond the reference (SURVEY.md §5) this keeps the epoch
+counter (resume continues from the right epoch), is multi-host-safe
+(Orbax coordinates; sidecar/manifests written by host 0), and — the
+robustness upgrade — maintains a RING of `keep` slots, each with a
+sha256 manifest written after the commit barrier:
+
+- ``keep=1`` (default) preserves the historical single overwritten
+  ``checkpoint`` slot byte-for-byte (now plus a manifest).
+- ``keep=K>1`` names slots ``checkpoint-e<epoch>`` and prunes to the K
+  newest after each commit. One poisoned or corrupted save can no
+  longer destroy the only copy — the failure mode ``--on_nan rollback``
+  (resil/rollback.py) recovers from.
+- ``restore`` walks slots newest-first and takes the newest slot that
+  passes ``verify()`` (manifest sha256 re-hash); corrupted slots are
+  skipped with a clear console/telemetry record naming the fallback
+  slot actually used. A slot with no manifest (legacy, or a crash
+  between slot rename and manifest write) is accepted as unverified —
+  Orbax's tmp-dir+rename commit already guarantees it is complete.
+
+All checkpoint I/O (Orbax save/restore, commit wait, sidecar reads and
+writes) runs under resil/retry.py bounded backoff: transient
+filesystem errors are absorbed with ``retry`` telemetry events;
+``--inject ckpt_io_error@epoch=N`` exercises exactly that path.
+
+Restored states are deep-copied into XLA-owned buffers (``_rebuffer``)
+before being returned: the train step donates its state argument, and
+donating an orbax/tensorstore-backed buffer corrupted every
+post-resume save (and intermittently crashed the process) before the
+copy was added.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-from typing import Optional, Tuple
+import re
+import shutil
+from typing import List, Optional, Tuple
 
 import jax
 
+from cyclegan_tpu.resil.retry import (
+    DEFAULT_RETRY_POLICY,
+    RetryPolicy,
+    retry_call,
+)
 from cyclegan_tpu.train.state import CycleGANState
+
+_RING_RE = re.compile(r"^checkpoint-e(\d+)$")
+_LEGACY = "checkpoint"
+
+
+def _rebuffer(tree):
+    """Deep-copy every restored array into a fresh XLA-owned buffer.
+
+    Orbax/tensorstore-returned arrays can be backed by buffers XLA does
+    not own; the train step DONATES its state argument, and donating
+    such a buffer lets XLA write into (and free) memory tensorstore
+    still manages. Observed failure mode on CPU: a resumed run whose
+    post-resume checkpoint contains NaN/denormal garbage, NaN test
+    metrics right after a verified-clean restore, and intermittent
+    glibc 'corrupted double-linked list' aborts. jnp.copy routes each
+    leaf through an XLA computation, so the result is a normal
+    XLA-allocated array (sharding preserved) and the orbax buffers are
+    never handed to donation."""
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, tree)
 
 
 class Checkpointer:
-    def __init__(self, output_dir: str):
+    def __init__(self, output_dir: str, keep: int = 1, telemetry=None,
+                 injector=None, retry_policy: Optional[RetryPolicy] = None):
         import orbax.checkpoint as ocp
 
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
         self.dir = os.path.abspath(os.path.join(output_dir, "checkpoints"))
         os.makedirs(self.dir, exist_ok=True)
-        self.slot = os.path.join(self.dir, "checkpoint")
+        self.keep = int(keep)
+        self.telemetry = telemetry
+        self.injector = injector
+        self.retry_policy = retry_policy or DEFAULT_RETRY_POLICY
         self.meta_path = os.path.join(self.dir, "meta.json")
         self._ckptr = ocp.StandardCheckpointer()
+        self._last_slot: Optional[str] = None
+
+    # -- slot bookkeeping --------------------------------------------------
+
+    def _slot_path(self, epoch: int) -> str:
+        if self.keep == 1:
+            return os.path.join(self.dir, _LEGACY)
+        return os.path.join(self.dir, f"checkpoint-e{int(epoch):05d}")
+
+    @staticmethod
+    def _manifest_path(slot: str) -> str:
+        return slot + ".manifest.json"
+
+    def _slot_epoch(self, name: str) -> int:
+        m = _RING_RE.match(name)
+        if m is not None:
+            return int(m.group(1))
+        manifest = self._read_manifest(os.path.join(self.dir, name))
+        if manifest is not None and "epoch" in manifest:
+            return int(manifest["epoch"])
+        return int(self.read_meta().get("epoch", -1))
+
+    def slots(self) -> List[Tuple[int, str]]:
+        """Existing complete slots, newest-first as (epoch, path).
+        Orbax tmp dirs (uncommitted saves) are never slots."""
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        out: List[Tuple[int, str]] = []
+        for name in names:
+            if "orbax-checkpoint-tmp" in name:
+                continue
+            if name != _LEGACY and _RING_RE.match(name) is None:
+                continue
+            path = os.path.join(self.dir, name)
+            if os.path.isdir(path):
+                out.append((self._slot_epoch(name), path))
+        out.sort(key=lambda t: (t[0], t[1]), reverse=True)
+        return out
+
+    @property
+    def slot(self) -> str:
+        """The newest slot path (the save target before any save
+        lands) — what main.py prints and error text names."""
+        if self._last_slot is not None:
+            return self._last_slot
+        existing = self.slots()
+        if existing:
+            return existing[0][1]
+        return os.path.join(self.dir, _LEGACY)
+
+    def exists(self) -> bool:
+        return bool(self.slots())
+
+    # -- save --------------------------------------------------------------
 
     def save(self, state: CycleGANState, epoch: int, meta: Optional[dict] = None,
              services=None) -> None:
-        """Overwrite the single slot (reference .write semantics,
-        main.py:157-160) and record the epoch counter plus any extra
-        metadata (main.py passes the model architecture, making the slot
-        self-describing — translate.py rebuilds the right network without
-        the user re-specifying --filters etc.).
+        """Write the ring slot for ``epoch`` (reference .write semantics,
+        main.py:157-160, generalized from one slot to ``keep``) and
+        record the epoch counter plus any extra metadata (main.py passes
+        the model architecture, making slots self-describing —
+        translate.py rebuilds the right network without the user
+        re-specifying --filters etc.).
 
         `services` (an utils.services.EpochServices) makes the save
         asynchronous: Orbax's `save()` returns once the state is fetched
         to host (so the caller may immediately donate/overwrite the
-        device buffers), and the commit barrier + sidecar write move to
-        the service thread. The caller owns the completion contract:
-        `services.barrier()` (or close()) before process exit.
+        device buffers), and the commit barrier + manifest + sidecar +
+        ring prune move to the service thread. The caller owns the
+        completion contract: `services.barrier()` (or close()) before
+        process exit.
 
         Crash semantics either way: Orbax materializes the slot in a tmp
-        dir and renames it into place, so `restore_if_exists` sees the
-        previous complete slot or the new complete slot, never a torn
-        one. The sidecar is written only AFTER the commit barrier, so a
-        crash mid-save leaves the previous epoch's meta.json paired with
-        whichever complete slot survives. (Worst case — crash between
-        slot rename and sidecar write — resume re-runs the last saved
-        epoch; it never reads a half-written state.)"""
-        self._ckptr.save(self.slot, state, force=True)
+        dir and renames it into place, so restore sees complete slots
+        only, never a torn one. The sha256 manifest and the sidecar are
+        written only AFTER the commit barrier; a crash in the gap leaves
+        a complete-but-unverified slot (restore accepts it) or the
+        previous epoch's sidecar paired with whichever complete slots
+        survive. Worst case, resume re-runs the last saved epoch; it
+        never reads a half-written state."""
+        slot = self._slot_path(epoch)
+        self._last_slot = slot
+        # The dispatch (state fetch) under retry: `--inject
+        # ckpt_io_error@epoch=N` fires here, inside the same bounded
+        # backoff a real transient I/O error would hit.
+        retry_call(self._ckptr.save, slot, state, force=True,
+                   site="ckpt", index=int(epoch),
+                   policy=self.retry_policy, telemetry=self.telemetry,
+                   injector=self.injector)
         if services is not None:
             services.submit(f"checkpoint:e{epoch}", self._finalize_save,
-                            epoch, meta)
+                            epoch, meta, slot)
         else:
-            self._finalize_save(epoch, meta)
+            self._finalize_save(epoch, meta, slot)
 
-    def _finalize_save(self, epoch: int, meta: Optional[dict]) -> None:
-        """Block until the slot is committed, then write the epoch
-        sidecar. Runs synchronously or on the epoch-services thread."""
-        self._ckptr.wait_until_finished()
+    def _finalize_save(self, epoch: int, meta: Optional[dict],
+                       slot: str) -> None:
+        """Block until the slot is committed, then write the manifest,
+        the epoch sidecar, and prune the ring. Runs synchronously or on
+        the epoch-services thread — never on the dispatch path."""
+        retry_call(self._ckptr.wait_until_finished, site="ckpt_commit",
+                   index=int(epoch), policy=self.retry_policy,
+                   telemetry=self.telemetry)
         if jax.process_index() == 0:
+            self._write_manifest(slot, epoch, meta)
             record = dict(meta or {})
             record["epoch"] = int(epoch)
+            record["slot"] = os.path.basename(slot)
             # Atomic: a preemption mid-write must never truncate the
-            # sidecar (the slot itself is valid; a broken meta.json would
-            # brick auto-resume).
-            tmp = self.meta_path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(record, f)
-            os.replace(tmp, self.meta_path)
+            # sidecar (the slot itself is valid; a broken meta.json
+            # would brick auto-resume).
+            retry_call(self._write_sidecar, record, site="ckpt_meta",
+                       index=int(epoch), policy=self.retry_policy,
+                       telemetry=self.telemetry)
+            self._prune()
+
+    def _write_sidecar(self, record: dict) -> None:
+        tmp = self.meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+        os.replace(tmp, self.meta_path)
+
+    def _write_manifest(self, slot: str, epoch: int,
+                        meta: Optional[dict]) -> None:
+        """Per-slot sha256 manifest, written post-commit. A stand-in
+        checkpointer that materializes no slot dir (tests) skips it —
+        there is nothing to hash and nothing verify() could protect."""
+        if not os.path.isdir(slot):
+            return
+        files = {}
+        total = 0
+        for root, _, names in os.walk(slot):
+            for name in sorted(names):
+                path = os.path.join(root, name)
+                rel = os.path.relpath(path, slot)
+                h = hashlib.sha256()
+                with open(path, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+                nbytes = os.path.getsize(path)
+                files[rel] = {"sha256": h.hexdigest(), "bytes": nbytes}
+                total += nbytes
+        record = {
+            "slot": os.path.basename(slot),
+            "epoch": int(epoch),
+            "n_files": len(files),
+            "total_bytes": total,
+            "files": files,
+        }
+        path = self._manifest_path(slot)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+        os.replace(tmp, path)
+
+    def _prune(self) -> None:
+        """Drop slots beyond the `keep` newest (and their manifests)."""
+        for _, path in self.slots()[self.keep:]:
+            shutil.rmtree(path, ignore_errors=True)
+            try:
+                os.remove(self._manifest_path(path))
+            except OSError:
+                pass
+
+    # -- verification ------------------------------------------------------
+
+    def _read_manifest(self, slot: str) -> Optional[dict]:
+        try:
+            with open(self._manifest_path(slot)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def verify(self, slot: Optional[str] = None) -> Tuple[bool, str]:
+        """Re-hash one slot against its manifest; (ok, detail). A slot
+        without a readable manifest is accepted as 'unverified' — it is
+        complete (Orbax's rename is the commit point), there is just no
+        integrity record to check it against (legacy slot, or a crash
+        between slot rename and manifest write)."""
+        if slot is None:
+            existing = self.slots()
+            if not existing:
+                return False, "no checkpoint slots exist"
+            slot = existing[0][1]
+        if not os.path.isdir(slot):
+            return False, f"slot {os.path.basename(slot)} does not exist"
+        manifest = self._read_manifest(slot)
+        if manifest is None:
+            return True, "unverified (no manifest)"
+        files = manifest.get("files", {})
+        for rel, info in sorted(files.items()):
+            path = os.path.join(slot, rel)
+            if not os.path.isfile(path):
+                return False, f"missing file {rel}"
+            h = hashlib.sha256()
+            try:
+                with open(path, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+            except OSError as e:
+                return False, f"unreadable file {rel} ({e})"
+            if h.hexdigest() != info.get("sha256"):
+                return False, f"sha256 mismatch in {rel}"
+        return True, (f"verified ({len(files)} files, "
+                      f"{manifest.get('total_bytes', 0)} bytes)")
+
+    # -- restore -----------------------------------------------------------
 
     def read_meta(self) -> dict:
-        """The sidecar metadata ({} when absent/unreadable)."""
+        """The sidecar metadata ({} when absent/unreadable after the
+        retry budget — a persistent read failure degrades to 'no
+        metadata', never to a crashed resume)."""
         try:
-            with open(self.meta_path) as f:
-                return json.load(f)
+            return retry_call(self._read_sidecar, site="ckpt_meta_read",
+                              policy=self.retry_policy,
+                              telemetry=self.telemetry)
         except (OSError, ValueError):
             return {}
 
-    def exists(self) -> bool:
-        return os.path.isdir(self.slot)
+    def _read_sidecar(self) -> dict:
+        with open(self.meta_path) as f:
+            return json.load(f)
 
     def restore(
         self, template: CycleGANState, partial: bool = False
     ) -> Tuple[CycleGANState, int]:
-        """Restore into the template's structure/shardings; returns
-        (state, next_epoch).
+        """Restore from the newest VERIFIED slot into the template's
+        structure/shardings; returns (state, next_epoch) — next_epoch
+        follows the restored slot's epoch, which under a fallback is
+        OLDER than the sidecar's (exactly the rollback rewind).
 
         partial=True is the analog of the reference's `expect_partial`
         load option (main.py:165-169): leaves whose path AND shape/dtype
@@ -98,16 +329,48 @@ class Checkpointer:
         template's (freshly initialized) value — so a checkpoint survives
         architecture tweaks instead of hard-failing.
         """
-        if partial:
-            state = self._restore_partial(template)
-        else:
-            abstract = jax.tree.map(
-                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
-                template,
-            )
-            state = self._ckptr.restore(self.slot, abstract)
-        epoch = int(self.read_meta().get("epoch", -1)) + 1
-        return state, epoch
+        existing = self.slots()
+        if not existing:
+            raise FileNotFoundError(
+                f"no checkpoint slots under {self.dir}")
+        failures: List[str] = []
+        for epoch, slot in existing:
+            ok, detail = self.verify(slot)
+            if not ok:
+                failures.append(f"{os.path.basename(slot)}: {detail}")
+                continue
+            if partial:
+                state = self._restore_partial(template, slot)
+            else:
+                self._check_strict_shapes(template, slot)
+                abstract = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(
+                        x.shape, x.dtype,
+                        sharding=getattr(x, "sharding", None)),
+                    template,
+                )
+                state = retry_call(self._ckptr.restore, slot, abstract,
+                                   site="ckpt_restore", index=int(epoch),
+                                   policy=self.retry_policy,
+                                   telemetry=self.telemetry)
+                state = _rebuffer(state)
+            if failures:
+                msg = (
+                    f"checkpoint slot(s) failed verification "
+                    f"[{'; '.join(failures)}]; fell back to verified slot "
+                    f"{os.path.basename(slot)} (epoch {epoch})")
+                if jax.process_index() == 0:
+                    print(msg)
+                if self.telemetry is not None:
+                    self.telemetry.event(
+                        "ckpt_fallback",
+                        failed=failures,
+                        slot=os.path.basename(slot),
+                        epoch=int(epoch))
+            return state, int(epoch) + 1
+        raise RuntimeError(
+            f"every checkpoint slot failed verification: "
+            f"{'; '.join(failures)} — no slot is safe to restore")
 
     @staticmethod
     def _path_key(path) -> str:
@@ -125,10 +388,53 @@ class Checkpointer:
                 parts.append(str(e))
         return "/".join(parts)
 
-    def _restore_partial(self, template: CycleGANState) -> CycleGANState:
-        import numpy as np
+    def _check_strict_shapes(self, template: CycleGANState,
+                             slot: str) -> None:
+        """Strict restore must refuse shape/dtype drift. Orbax's
+        StandardRestore does NOT: a target array wider than the saved one
+        reads back silently zero-filled (observed: (4,4,3,4) saved ->
+        (4,4,3,8) "restored"), which would hand training a half-garbage
+        network. Compare the template against the slot's array metadata
+        before touching any data."""
+        try:
+            md = self._ckptr.metadata(slot)
+        except Exception:
+            return  # no readable metadata: let orbax's own errors surface
+        saved = {
+            self._path_key(p): leaf
+            for p, leaf in jax.tree_util.tree_flatten_with_path(md)[0]
+        }
+        bad = []
+        for p, leaf in jax.tree_util.tree_flatten_with_path(template)[0]:
+            key = self._path_key(p)
+            got = saved.get(key)
+            if got is None:
+                # A path absent from the metadata tree is a STRUCTURE
+                # difference — orbax's own restore raises a clear error
+                # on those. Only same-path shape/dtype drift reads back
+                # silently zero-filled, so that is all we refuse here.
+                continue
+            if (tuple(getattr(got, "shape", ())) != tuple(leaf.shape)
+                    or str(getattr(got, "dtype", "")) != str(leaf.dtype)):
+                bad.append(
+                    f"{key}: saved {tuple(got.shape)}/{got.dtype} vs "
+                    f"template {tuple(leaf.shape)}/{leaf.dtype}")
+        if bad:
+            shown = "; ".join(bad[:5])
+            more = len(bad) - 5
+            raise ValueError(
+                f"strict restore refused: {len(bad)} leaves mismatch "
+                f"{os.path.basename(slot)} [{shown}"
+                + (f"; +{more} more]" if more > 0 else "]")
+                + " — use partial restore to graft matching leaves")
 
-        raw = self._ckptr.restore(self.slot)  # as-saved (no target tree)
+    def _restore_partial(self, template: CycleGANState,
+                         slot: Optional[str] = None) -> CycleGANState:
+        slot = self.slot if slot is None else slot
+        raw = retry_call(self._ckptr.restore, slot,  # as-saved (no target)
+                         site="ckpt_restore",
+                         policy=self.retry_policy,
+                         telemetry=self.telemetry)
         saved = {
             self._path_key(path): leaf
             for path, leaf in jax.tree_util.tree_flatten_with_path(raw)[0]
@@ -164,7 +470,7 @@ class Checkpointer:
         if grafted_arrays < max(1, total_arrays // 10):
             raise ValueError(
                 f"partial restore matched only {grafted_arrays}/{total_arrays} "
-                f"parameter arrays in {self.slot}; wrong checkpoint for this "
+                f"parameter arrays in {slot}; wrong checkpoint for this "
                 "model?"
             )
         if skipped and jax.process_index() == 0:
@@ -172,12 +478,16 @@ class Checkpointer:
                 f"partial restore: {grafted} leaves restored, "
                 f"{skipped} kept from init"
             )
-        return state
+        # Grafted leaves are orbax-owned buffers — same donation hazard
+        # as the strict path (see _rebuffer).
+        return _rebuffer(state)
 
     def restore_if_exists(
         self, template: CycleGANState, partial: bool = False
     ) -> Tuple[CycleGANState, int, bool]:
-        """Auto-resume gate (reference main.py:162-170, call at 383)."""
+        """Auto-resume gate (reference main.py:162-170, call at 383):
+        slot integrity is verified before restoring (restore() walks
+        newest-first and names any corrupted slot it skipped)."""
         if self.exists():
             state, epoch = self.restore(template, partial=partial)
             return state, epoch, True
@@ -189,13 +499,17 @@ class Checkpointer:
         """restore_if_exists with the inference-CLI error policy shared
         by translate.py and eval/evaluate.py: a failed restore exits with
         the underlying error AND the likeliest cause (legacy sidecars
-        without recorded architecture need the training flags repeated)."""
+        without recorded architecture need the training flags repeated;
+        sha256-corrupted slots name the slot and the fallback chain)."""
         try:
             return self.restore_if_exists(template)
         except Exception as e:  # orbax raises various structure/shape errors
             raise SystemExit(
                 f"checkpoint restore failed: {type(e).__name__}: {e}\n"
-                "If the error is a parameter structure/shape mismatch, the "
+                "If the error lists slots that failed verification, every "
+                "ring slot's sha256 manifest mismatched — the checkpoint "
+                "directory is corrupt; re-fetch it or retrain. If the "
+                "error is a parameter structure/shape mismatch, the "
                 "likeliest cause is a legacy checkpoint (saved before "
                 "meta.json recorded the architecture) — repeat the training "
                 "flags: --filters/--residual_blocks/--scan_blocks."

@@ -66,9 +66,21 @@ def main(args: argparse.Namespace) -> None:
     # architecture recording. The template uses the source layout; the
     # rewritten sidecar records the TARGET layout so translate/evaluate
     # keep auto-detecting correctly.
+    import os
+
     ckpt = Checkpointer(args.output_dir)
     if not ckpt.exists():
         raise SystemExit(f"no checkpoint under {args.output_dir}/checkpoints")
+    # Match the on-disk slot layout: training's default is a RING of
+    # checkpoint-e<epoch> slots, and a keep=1 checkpointer here would
+    # write the converted state under the legacy name and then prune
+    # it away as the oldest slot. With ring naming + a wide-enough
+    # keep, the converted save overwrites the source slot in place and
+    # the prune touches nothing.
+    existing = ckpt.slots()
+    if any(os.path.basename(s) != "checkpoint" for _, s in existing):
+        ckpt.close()
+        ckpt = Checkpointer(args.output_dir, keep=max(2, len(existing)))
     src_scanned = args.to == "unrolled"
     meta = ckpt.read_meta()
     model_cfg = Config.model_from_cli_and_meta(

@@ -106,6 +106,7 @@ def main(args: argparse.Namespace) -> None:
             prefetch_batches=args.prefetch_batches,
             grad_accum=args.grad_accum,
             grad_impl=args.grad_impl,
+            ckpt_keep=args.ckpt_keep,
         ),
         obs=ObsConfig(
             enabled=not args.no_obs,
@@ -116,6 +117,7 @@ def main(args: argparse.Namespace) -> None:
             stall_multiple=args.obs_stall_multiple,
             health=not args.no_health,
             on_nan=args.on_nan,
+            max_rollbacks=args.max_rollbacks,
             divergence_multiple=args.health_divergence_multiple,
             collapse_eps=args.health_collapse_eps,
             collapse_patience=args.health_collapse_patience,
@@ -175,6 +177,14 @@ def main(args: argparse.Namespace) -> None:
     # (detections are deterministic on replicated scalars, so an
     # on_nan=halt exit is process-synchronous); only the primary echoes.
     health = make_health_monitor(config.obs, tele, primary)
+    # Deterministic fault injection (--inject, resil/faults.py): None
+    # when the spec is empty, so the no-fault path costs one `is not
+    # None` check per site and never constructs an injector at all.
+    from cyclegan_tpu.resil import FaultInjector
+
+    injector = FaultInjector.from_spec(args.inject, telemetry=tele)
+    if injector is not None and primary:
+        print(f"fault injection armed: {injector!r}")
     # Test/FID forwards have no microbatching, so they run at the real
     # per-dispatch batch (the training microbatch) — under --grad_accum
     # the effective train batch would OOM exactly the configs
@@ -205,8 +215,10 @@ def main(args: argparse.Namespace) -> None:
 
     state = create_state(config, jax.random.PRNGKey(config.train.seed))
 
-    # Auto-resume from the single checkpoint slot (reference main.py:383).
-    ckpt = Checkpointer(config.train.output_dir)
+    # Auto-resume from the newest verified slot of the checkpoint ring
+    # (reference main.py:383 kept a single slot; see utils/checkpoint.py).
+    ckpt = Checkpointer(config.train.output_dir, keep=config.train.ckpt_keep,
+                        telemetry=tele, injector=injector)
     state, start_epoch, resumed = ckpt.restore_if_exists(
         state, partial=args.expect_partial
     )
@@ -279,124 +291,65 @@ def main(args: argparse.Namespace) -> None:
             if primary:
                 print(f"{key}: {value:.4f}")
 
+    # --on_nan rollback: a HealthFault restores the newest verified ring
+    # slot, rewinds the epoch counter, and re-seeds the data pipeline —
+    # up to --max_rollbacks consecutive faults (resil/rollback.py).
+    rollback = None
+    if config.obs.on_nan == "rollback":
+        from cyclegan_tpu.resil import RollbackController
+
+        rollback = RollbackController(
+            ckpt, data=data, telemetry=tele,
+            max_rollbacks=config.obs.max_rollbacks,
+            echo=print if primary else None,
+        )
+
     run_status = "failed"  # until the epoch loop exits cleanly
     try:
-        for epoch in range(start_epoch, config.train.epochs):
+        epoch = start_epoch
+        while epoch < config.train.epochs:
             if primary:
                 print(f"Epoch {epoch + 1:03d}/{config.train.epochs:03d}")
-            start = time()
-            state = loop.train_epoch(
-                config, data, plan, train_step, state, summary, epoch,
-                tracer=tracer, multi_step_fn=multi_step, obs=tele,
-                health=health,
-            )
-            train_elapse = time() - start
-            results = loop.test_epoch(
-                config, data, plan, test_step, state, summary, epoch,
-                obs=tele,
-            )
-            # One `health` event per epoch (grad-norm envelopes,
-            # D-balance, anomaly counts); the flat dict feeds the
-            # console line below.
-            health_rollup = (
-                health.epoch_rollup(epoch) if health is not None else None
-            )
-            elapse = time() - start
-            summary.scalar("elapse", elapse, step=epoch)
-            ips = loop.images_per_sec(2 * data.n_train, elapse)
-            summary.scalar("images_per_sec", ips, step=epoch)
-            # Train-only throughput next to the whole-epoch number: the
-            # epoch window includes the test pass, so `images_per_sec`
-            # under-reads the training rate (the "two-phase mush") —
-            # perf/* utilization derives from the train-only elapse.
-            train_ips = loop.images_per_sec(2 * data.n_train, train_elapse)
-            summary.scalar("perf/train_images_per_sec", train_ips, step=epoch)
-            # Absolute utilization next to raw throughput: analytic step
-            # FLOPs (utils/flops.py) x achieved TRAIN rate, plus MFU when
-            # the chip's bf16 peak is known.
-            tflops = train_ips * flops_per_image / 1e12
-            mfu = tflops / peak_tflops if peak_tflops else None
-            summary.scalar("perf/tflops_per_sec", tflops, step=epoch)
-            if mfu is not None:
-                summary.scalar("perf/mfu", mfu, step=epoch)
-            # Live utilization in the telemetry stream (mfu is null when
-            # the chip's peak is unknown, e.g. on CPU) + epoch-boundary
-            # HBM watermark sample.
-            tele.epoch(
-                epoch,
-                elapse_s=round(elapse, 4),
-                train_elapse_s=round(train_elapse, 4),
-                images_per_sec=round(ips, 4),
-                train_images_per_sec=round(train_ips, 4),
-                tflops_per_sec=round(tflops, 6),
-                mfu=round(mfu, 6) if mfu is not None else None,
-                test_metrics={key: float(v) for key, v in results.items()},
-            )
-            if (config.obs.memory_sample_every > 0
-                    and epoch % config.obs.memory_sample_every == 0):
-                tele.memory(epoch)
-            if primary:
-                loop.print_epoch_summary(results, elapse,
-                                         health=health_rollup)
-
-            preempted = guard.should_stop()
-            last = epoch == config.train.epochs - 1
-            # Skip FID when preempted: the SIGTERM grace window belongs to
-            # the checkpoint save, not a test-split sweep.
-            if fid_eval is not None and not preempted and (
-                last or (epoch + 1) % args.fid_every == 0
-            ):
-                if async_fid:
-                    # Snapshot the generator params (device-side copy, no
-                    # sync): the next epoch's first train step donates
-                    # `state`'s buffers, and FID's device work must
-                    # interleave with — not read from under — it.
-                    import types
-
-                    import jax.numpy as jnp
-
-                    snap = types.SimpleNamespace(
-                        g_params=jax.tree.map(jnp.copy, state.g_params),
-                        f_params=jax.tree.map(jnp.copy, state.f_params),
-                    )
-                    services.submit(f"fid:e{epoch}", run_fid, snap, epoch)
-                else:
-                    run_fid(state, epoch)
-                    # The FID sweep takes minutes at full size — a SIGTERM
-                    # landing during it must still checkpoint below.
-                    preempted = preempted or guard.should_stop()
-            if preempted or last or epoch % config.train.checkpoint_every == 0:
-                # Async save: Orbax fetches the state before returning
-                # (safe against the next step's donation); commit barrier
-                # + sidecar land on the services thread.
-                ckpt.save(state, epoch, meta=config.model_meta(),
-                          services=services)
-                if primary:
-                    print(f"saving checkpoint to {ckpt.slot} "
-                          f"(commit off the dispatch path)")
-                # Every host must run the jitted cycle inference (state is
-                # a global array); only host 0's summary writes anything.
-                # Panel rendering rides the services thread too.
-                plot_cycle(data.plot_pairs(), cycle_step, state, summary,
-                           epoch, services=services)
+            try:
+                state, preempted = _run_one_epoch(
+                    args, config, data, plan, train_step, test_step,
+                    multi_step, cycle_step, state, summary, epoch, tracer,
+                    tele, health, injector, guard, fid_eval, run_fid,
+                    async_fid, ckpt, services, primary, flops_per_image,
+                    peak_tflops, plot_cycle,
+                )
+            except HealthFault as fault:
+                if rollback is None:
+                    raise
+                # recover() re-raises the fault when the consecutive
+                # budget is spent or no verified slot exists — the outer
+                # handler below then halts with exit 3.
+                state, epoch = rollback.recover(
+                    state, fault, epoch, services=services)
+                continue
+            if rollback is not None:
+                rollback.note_clean_epoch()
             if preempted:
-                # The one mid-run barrier: the grace window belongs to the
-                # checkpoint commit, so block until it (and any queued
-                # plot/FID work) lands before exiting.
+                # The one mid-run barrier: the grace window belongs to
+                # the checkpoint commit, so block until it (and any
+                # queued plot/FID work) lands before exiting.
                 services.barrier()
                 if primary:
-                    print("preemption requested: checkpointed, exiting cleanly")
+                    print("preemption requested: checkpointed, "
+                          "exiting cleanly")
                 run_status = "preempted"
                 tele.event("preempted", epoch=epoch)
                 break
+            epoch += 1
         else:
             run_status = "completed"
     except HealthFault as fault:
-        # The non-finite tripwire under --on_nan halt: the monitor
-        # already wrote the health_fault event and flushed the stream.
-        # No checkpoint save happens on this path, so the last-good slot
-        # survives for a resume from pre-NaN weights; exit nonzero so
-        # sweep drivers see the run died of numerics, not preemption.
+        # The non-finite tripwire under --on_nan halt (or a rollback
+        # budget spent): the monitor already wrote the health_fault
+        # event and flushed the stream. No checkpoint save happens on
+        # this path, so the last-good slot survives for a resume from
+        # pre-NaN weights; exit nonzero so sweep drivers see the run
+        # died of numerics, not preemption.
         run_status = "health_fault"
         services.barrier()
         if primary:
@@ -417,6 +370,117 @@ def main(args: argparse.Namespace) -> None:
                   f"job(s) failed: " + "; ".join(services.errors[:3]))
         summary.close()
         tele.close(status=run_status)
+
+
+def _run_one_epoch(args, config, data, plan, train_step, test_step,
+                   multi_step, cycle_step, state, summary, epoch, tracer,
+                   tele, health, injector, guard, fid_eval, run_fid,
+                   async_fid, ckpt, services, primary, flops_per_image,
+                   peak_tflops, plot_cycle):
+    """One full epoch body (train + test + rollups + FID + checkpoint),
+    split out of main() so the rollback policy can wrap exactly this
+    unit in its HealthFault handler. Returns (state, preempted)."""
+    from time import time
+
+    from cyclegan_tpu.train import loop
+
+    start = time()
+    state = loop.train_epoch(
+        config, data, plan, train_step, state, summary, epoch,
+        tracer=tracer, multi_step_fn=multi_step, obs=tele,
+        health=health, injector=injector,
+    )
+    train_elapse = time() - start
+    results = loop.test_epoch(
+        config, data, plan, test_step, state, summary, epoch,
+        obs=tele,
+    )
+    # One `health` event per epoch (grad-norm envelopes,
+    # D-balance, anomaly counts); the flat dict feeds the
+    # console line below.
+    health_rollup = (
+        health.epoch_rollup(epoch) if health is not None else None
+    )
+    elapse = time() - start
+    summary.scalar("elapse", elapse, step=epoch)
+    ips = loop.images_per_sec(2 * data.n_train, elapse)
+    summary.scalar("images_per_sec", ips, step=epoch)
+    # Train-only throughput next to the whole-epoch number: the
+    # epoch window includes the test pass, so `images_per_sec`
+    # under-reads the training rate (the "two-phase mush") —
+    # perf/* utilization derives from the train-only elapse.
+    train_ips = loop.images_per_sec(2 * data.n_train, train_elapse)
+    summary.scalar("perf/train_images_per_sec", train_ips, step=epoch)
+    # Absolute utilization next to raw throughput: analytic step
+    # FLOPs (utils/flops.py) x achieved TRAIN rate, plus MFU when
+    # the chip's bf16 peak is known.
+    tflops = train_ips * flops_per_image / 1e12
+    mfu = tflops / peak_tflops if peak_tflops else None
+    summary.scalar("perf/tflops_per_sec", tflops, step=epoch)
+    if mfu is not None:
+        summary.scalar("perf/mfu", mfu, step=epoch)
+    # Live utilization in the telemetry stream (mfu is null when
+    # the chip's peak is unknown, e.g. on CPU) + epoch-boundary
+    # HBM watermark sample.
+    tele.epoch(
+        epoch,
+        elapse_s=round(elapse, 4),
+        train_elapse_s=round(train_elapse, 4),
+        images_per_sec=round(ips, 4),
+        train_images_per_sec=round(train_ips, 4),
+        tflops_per_sec=round(tflops, 6),
+        mfu=round(mfu, 6) if mfu is not None else None,
+        test_metrics={key: float(v) for key, v in results.items()},
+    )
+    if (config.obs.memory_sample_every > 0
+            and epoch % config.obs.memory_sample_every == 0):
+        tele.memory(epoch)
+    if primary:
+        loop.print_epoch_summary(results, elapse,
+                                 health=health_rollup)
+
+    preempted = guard.should_stop()
+    last = epoch == config.train.epochs - 1
+    # Skip FID when preempted: the SIGTERM grace window belongs to
+    # the checkpoint save, not a test-split sweep.
+    if fid_eval is not None and not preempted and (
+        last or (epoch + 1) % args.fid_every == 0
+    ):
+        if async_fid:
+            # Snapshot the generator params (device-side copy, no
+            # sync): the next epoch's first train step donates
+            # `state`'s buffers, and FID's device work must
+            # interleave with — not read from under — it.
+            import types
+
+            import jax
+            import jax.numpy as jnp
+
+            snap = types.SimpleNamespace(
+                g_params=jax.tree.map(jnp.copy, state.g_params),
+                f_params=jax.tree.map(jnp.copy, state.f_params),
+            )
+            services.submit(f"fid:e{epoch}", run_fid, snap, epoch)
+        else:
+            run_fid(state, epoch)
+            # The FID sweep takes minutes at full size — a SIGTERM
+            # landing during it must still checkpoint below.
+            preempted = preempted or guard.should_stop()
+    if preempted or last or epoch % config.train.checkpoint_every == 0:
+        # Async save: Orbax fetches the state before returning
+        # (safe against the next step's donation); commit barrier
+        # + sidecar land on the services thread.
+        ckpt.save(state, epoch, meta=config.model_meta(),
+                  services=services)
+        if primary:
+            print(f"saving checkpoint to {ckpt.slot} "
+                  f"(commit off the dispatch path)")
+        # Every host must run the jitted cycle inference (state is
+        # a global array); only host 0's summary writes anything.
+        # Panel rendering rides the services thread too.
+        plot_cycle(data.plot_pairs(), cycle_step, state, summary,
+                   epoch, services=services)
+    return state, preempted
 
 
 if __name__ == "__main__":
@@ -600,13 +664,36 @@ if __name__ == "__main__":
                              "metrics dict — no extra dispatches) and the "
                              "host-side anomaly detectors")
     parser.add_argument("--on_nan", default="warn",
-                        choices=["warn", "halt"],
+                        choices=["warn", "halt", "rollback"],
                         help="non-finite gradient policy: 'warn' records a "
                              "health_fault event and keeps training; 'halt' "
                              "flushes telemetry, keeps the last-good "
                              "checkpoint, and exits nonzero — detection "
                              "lands within one deferred-fetch horizon of "
-                             "the poisoned step")
+                             "the poisoned step; 'rollback' restores the "
+                             "newest VERIFIED checkpoint-ring slot, rewinds "
+                             "the epoch counter, re-seeds the data "
+                             "pipeline, and keeps training (halting only "
+                             "after --max_rollbacks consecutive faults)")
+    parser.add_argument("--max_rollbacks", default=2, type=int, metavar="N",
+                        help="consecutive HealthFaults tolerated under "
+                             "--on_nan rollback before the run halts with "
+                             "exit 3; a clean epoch resets the count")
+    parser.add_argument("--ckpt_keep", default=3, type=int, metavar="K",
+                        help="checkpoint-ring depth: 1 = the single "
+                             "overwritten slot; K > 1 keeps the K newest "
+                             "epoch slots, each with a sha256 manifest "
+                             "verified before restore")
+    parser.add_argument("--inject", default="", metavar="SPEC",
+                        help="deterministic fault injection (resil/"
+                             "faults.py): comma-separated kind@key=N[xM] "
+                             "entries, e.g. 'nan_grads@step=6' or "
+                             "'ckpt_io_error@epoch=0x2,sigterm@step=40'. "
+                             "Kinds: nan_grads@step, sigterm@step, "
+                             "data_stall@step, ckpt_io_error@epoch, "
+                             "replica_crash@flush (serving). All "
+                             "injection is host-side — the jitted step "
+                             "is never modified")
     parser.add_argument("--health_divergence_multiple", default=4.0,
                         type=float, metavar="X",
                         help="warn when loss_G/total or loss_F/total "

@@ -291,3 +291,190 @@ def test_model_from_cli_and_meta_field_precedence():
     got = Config.model_from_cli_and_meta(recorded, filters=8)
     assert got.generator.filters == 8 and got.discriminator.filters == 8
     assert got.generator.num_residual_blocks == 6  # NOT reset to 9
+
+
+# -- checkpoint ring (keep > 1): slot naming, pruning, verify, fallback ----
+
+
+def _np_state(tag: float):
+    return {"w": np.full((8,), tag, np.float32),
+            "b": np.arange(4, dtype=np.float32) * tag}
+
+
+def _np_template():
+    return {"w": np.zeros((8,), np.float32),
+            "b": np.zeros((4,), np.float32)}
+
+
+def _tamper_one_array_file(slot):
+    """Flip bytes in one payload file inside a committed slot."""
+    import os
+
+    for root, _, files in os.walk(slot):
+        for name in files:
+            if name.endswith((".json", ".txt")) or "manifest" in name:
+                continue
+            p = os.path.join(root, name)
+            if os.path.getsize(p) > 0:
+                with open(p, "r+b") as f:
+                    data = f.read()
+                    f.seek(0)
+                    f.write(bytes(b ^ 0xFF for b in data[:64]) + data[64:])
+                return p
+    raise AssertionError(f"no payload file to tamper in {slot}")
+
+
+def test_ring_keeps_k_slots_prunes_oldest(tmp_path):
+    import os
+
+    ckpt = Checkpointer(str(tmp_path), keep=3)
+    for e in range(5):
+        ckpt.save(_np_state(float(e)), epoch=e)
+    assert [e for e, _ in ckpt.slots()] == [4, 3, 2]  # newest first
+    names = sorted(os.listdir(ckpt.dir))
+    assert "checkpoint-e00004" in names
+    assert "checkpoint-e00000" not in names  # pruned with its manifest
+    assert not [n for n in names if "e00000" in n or "e00001" in n]
+    restored, next_epoch = ckpt.restore(_np_template())
+    assert next_epoch == 5
+    assert np.array_equal(np.asarray(restored["w"]), _np_state(4.0)["w"])
+
+
+def test_legacy_keep1_slot_name_unchanged(tiny_config, tmp_path):
+    """keep=1 must stay byte-compatible with every pre-ring run: the
+    single slot is still named `checkpoint` (no epoch suffix)."""
+    import os
+
+    state = create_state(tiny_config, jax.random.PRNGKey(0))
+    ckpt = Checkpointer(str(tmp_path))  # keep defaults to 1
+    ckpt.save(state, epoch=7)
+    assert os.path.isdir(os.path.join(ckpt.dir, "checkpoint"))
+    assert not [n for n in os.listdir(ckpt.dir)
+                if n.startswith("checkpoint-e")]
+
+
+def test_ring_verify_detects_tampering_and_restore_falls_back(tmp_path):
+    """The acceptance path for a corrupted newest slot: verify() fails
+    on the sha256 manifest, restore() names it and falls back to the
+    newest slot that still verifies, rewinding the resume epoch."""
+    class Rec:
+        def __init__(self):
+            self.events = []
+
+        def event(self, kind, /, **f):
+            self.events.append(dict(f, event=kind))
+
+    rec = Rec()
+    ckpt = Checkpointer(str(tmp_path), keep=2, telemetry=rec)
+    ckpt.save(_np_state(1.0), epoch=1)
+    ckpt.save(_np_state(2.0), epoch=2)
+    (_, newest), (_, older) = ckpt.slots()[0], ckpt.slots()[1]
+    assert ckpt.verify(newest)[0] and ckpt.verify(older)[0]
+
+    _tamper_one_array_file(newest)
+    ok, detail = ckpt.verify(newest)
+    assert not ok and "sha256" in detail
+
+    restored, next_epoch = ckpt.restore(_np_template())
+    assert next_epoch == 2  # slot e1: rewound past the corrupt e2
+    assert np.array_equal(np.asarray(restored["w"]), _np_state(1.0)["w"])
+    (ev,) = [e for e in rec.events if e["event"] == "ckpt_fallback"]
+    assert ev["slot"] == "checkpoint-e00001"
+    assert any("checkpoint-e00002" in f for f in ev["failed"])
+
+
+def test_ring_every_slot_corrupt_raises_naming_slots(tmp_path):
+    import pytest
+
+    ckpt = Checkpointer(str(tmp_path), keep=2)
+    ckpt.save(_np_state(1.0), epoch=1)
+    ckpt.save(_np_state(2.0), epoch=2)
+    for _, slot in ckpt.slots():
+        _tamper_one_array_file(slot)
+    with pytest.raises(RuntimeError, match="failed verification") as e:
+        ckpt.restore(_np_template())
+    assert "checkpoint-e00001" in str(e.value)
+    assert "checkpoint-e00002" in str(e.value)
+
+
+def test_restore_for_cli_corrupt_ring_exits_with_guidance(tmp_path):
+    import pytest
+
+    ckpt = Checkpointer(str(tmp_path), keep=1)
+    ckpt.save(_np_state(3.0), epoch=0)
+    _tamper_one_array_file(ckpt.slot)
+    with pytest.raises(SystemExit) as e:
+        ckpt.restore_for_cli(_np_template())
+    msg = str(e.value)
+    assert "checkpoint restore failed" in msg
+    assert "sha256" in msg  # the corruption guidance, not just orbax noise
+
+
+def test_slot_without_manifest_is_accepted_unverified(tmp_path):
+    """A crash between Orbax's commit rename and the manifest write
+    leaves a complete slot with no manifest: restore must accept it
+    (the rename IS the commit point) rather than strand the run."""
+    import os
+
+    ckpt = Checkpointer(str(tmp_path), keep=2)
+    ckpt.save(_np_state(5.0), epoch=5)
+    manifest = [os.path.join(ckpt.dir, n) for n in os.listdir(ckpt.dir)
+                if "manifest" in n]
+    for m in manifest:
+        os.remove(m)
+    ok, detail = ckpt.verify()
+    assert ok and "unverified" in detail
+    restored, next_epoch = ckpt.restore(_np_template())
+    assert next_epoch == 6
+    assert np.array_equal(np.asarray(restored["w"]), _np_state(5.0)["w"])
+
+
+def test_save_with_injected_io_error_retries_and_verifies(tmp_path):
+    """--inject ckpt_io_error@epoch=N: the save's first attempt raises
+    inside the retry wrapper, the bounded backoff absorbs it (a `retry`
+    event lands in the stream), and the committed slot verifies."""
+    from cyclegan_tpu.resil import FaultInjector
+
+    class Rec:
+        def __init__(self):
+            self.events = []
+
+        def event(self, kind, /, **f):
+            self.events.append(dict(f, event=kind))
+
+    rec = Rec()
+    inj = FaultInjector.from_spec("ckpt_io_error@epoch=2", telemetry=rec)
+    ckpt = Checkpointer(str(tmp_path), keep=2, telemetry=rec, injector=inj)
+    ckpt.save(_np_state(2.0), epoch=2)
+    retries = [e for e in rec.events
+               if e["event"] == "retry" and e["site"] == "ckpt"]
+    assert len(retries) == 1 and retries[0]["attempt"] == 1
+    assert inj.pending() == []
+    assert ckpt.verify()[0]
+    restored, next_epoch = ckpt.restore(_np_template())
+    assert next_epoch == 3
+    assert np.array_equal(np.asarray(restored["w"]), _np_state(2.0)["w"])
+
+
+def test_restored_state_survives_donation_roundtrip(tiny_config, tmp_path):
+    """Restored arrays must be XLA-owned buffers. The train step DONATES
+    its state argument; before restore() rebuffered its output, donating
+    an orbax/tensorstore-backed array let XLA scribble on memory it did
+    not own — resumed runs wrote NaN-riddled checkpoints and
+    intermittently died with glibc heap-corruption aborts. Pin the safe
+    path: restore, donate every leaf through a jitted step, save the
+    result, and roundtrip it exactly."""
+    state = create_state(tiny_config, jax.random.PRNGKey(0))
+    ckpt = Checkpointer(str(tmp_path), keep=2)
+    ckpt.save(state, epoch=0)
+    template = create_state(tiny_config, jax.random.PRNGKey(1))
+    restored, _ = ckpt.restore(template)
+
+    donate = jax.jit(lambda s: jax.tree.map(lambda x: x + 0, s),
+                     donate_argnums=0)
+    out = donate(restored)
+    jax.block_until_ready(out)
+    ckpt.save(out, epoch=1)
+    back, next_epoch = ckpt.restore(template)
+    assert next_epoch == 2
+    assert _tree_equal(back, out)

@@ -56,7 +56,8 @@ def test_queue_order_and_budgets():
     # sweep, trace, e2e run.
     assert names == ["diag", "bench_cold", "bench_warm", "pad_sweep",
                      "epilogue_sweep", "grad_sweep", "accum512",
-                     "scan512", "serve_sweep", "trace", "timed_main"]
+                     "scan512", "serve_sweep", "trace", "chaos_drill",
+                     "timed_main"]
     by = {s.name: s for s in q}
     assert by["diag"].abort_queue_on_fail  # diag failing = relay sick
     # cold run gets the cache-warming budget; warm run is the record

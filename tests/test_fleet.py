@@ -28,6 +28,7 @@ from cyclegan_tpu.serve.fleet import (  # noqa: E402
     DeadlineExceeded,
     FleetConfig,
     FleetExecutor,
+    ReplicaCrashed,
     ShedError,
     class_map,
 )
@@ -532,3 +533,141 @@ def test_no_sync_check_covers_fleet_directory():
                 "__init__"):
         assert entries.get(f"cyclegan_tpu/serve/fleet/{mod}.py") is True
     assert run_check() == []
+
+
+# -- self-healing (crash detection, re-enqueue, respawn, circuit) ----------
+
+class _Recorder:
+    """Thread-safe logger double (replica + monitor threads emit)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []
+
+    def event(self, kind, /, **fields):
+        with self._lock:
+            self.events.append(dict(fields, event=kind))
+
+    def kinds(self):
+        with self._lock:
+            return [e["event"] for e in self.events]
+
+    def of(self, kind):
+        with self._lock:
+            return [e for e in self.events if e["event"] == kind]
+
+
+def _wait_for(pred, timeout=15.0):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def test_replica_close_reports_wedged_thread():
+    """Satellite contract: close() must never silently succeed on a
+    thread that is still running — a wedged replica returns False so
+    callers can tell a hung shutdown from a clean one."""
+    from cyclegan_tpu.serve.fleet.replica import ReplicaWorker
+
+    eng = FakeEngine()
+    eng.gate = threading.Event()  # run() blocks until released
+    freed = []
+    worker = ReplicaWorker(0, eng, on_free=freed.append)
+    req = FleetRequest(np.zeros((32, 32, 3), np.float32), 32, "base", BATCH)
+    worker.dispatch([req], "test")
+    assert eng.entered.wait(timeout=10)
+    assert worker.close(timeout=0.3) is False  # wedged in the engine
+    assert worker.alive()
+    eng.gate.set()  # release; the thread drains the flush and the _STOP
+    assert _wait_for(lambda: not worker.alive())
+    assert req.future.result(timeout=5)["fake"].shape == (32, 32, 3)
+
+
+def test_fleet_recovers_from_injected_replica_crash():
+    """replica_crash mid-flush: the monitor detects the dead thread,
+    re-enqueues its in-flight requests, respawns the worker, and every
+    future still resolves — no hung callers, no lost slots."""
+    from cyclegan_tpu.resil import FaultInjector
+
+    eng = FakeEngine(buckets=(1,))
+    rec = _Recorder()
+    inj = FaultInjector.from_spec("replica_crash@flush=1", telemetry=rec)
+    fleet = FleetExecutor(
+        eng,
+        FleetConfig(n_replicas=1, max_batch=1, max_wait_ms=0.0,
+                    health_poll_s=0.01),
+        logger=rec, injector=inj)
+    img = np.zeros((32, 32, 3), np.float32)
+    futs = [fleet.submit(img, klass="batch") for _ in range(4)]
+    for f in futs:
+        assert f.result(timeout=30)["fake"].shape == (32, 32, 3)
+    assert _wait_for(lambda: "fleet_recovery" in rec.kinds())
+    summary = fleet.close()
+    (down,) = rec.of("fleet_replica_down")
+    assert down["reason"] == "crash" and down["inflight"] == 1
+    (recov,) = rec.of("fleet_recovery")
+    assert recov["respawned"] is True and recov["requeued"] == 1
+    assert summary["recoveries"] == 1
+    assert summary["requeued_requests"] == 1
+    assert summary["crash_failed_requests"] == 0
+    assert summary["circuits_open"] == 0
+    assert summary["unjoined_replicas"] == []
+    assert inj.pending() == []
+
+
+def test_crash_loop_burns_attempts_then_fails_future_typed():
+    """A poison batch that kills its replica every time must not crash-
+    loop forever: after max_request_attempts dispatches the request
+    fails with ReplicaCrashed (typed, catchable) instead of hanging."""
+    from cyclegan_tpu.resil import FaultInjector
+
+    eng = FakeEngine(buckets=(1,))
+    rec = _Recorder()
+    inj = FaultInjector.from_spec("replica_crash@flush=0x10", telemetry=rec)
+    fleet = FleetExecutor(
+        eng,
+        FleetConfig(n_replicas=1, max_batch=1, max_wait_ms=0.0,
+                    health_poll_s=0.01, max_request_attempts=2,
+                    max_replica_failures=5),
+        logger=rec, injector=inj)
+    fut = fleet.submit(np.zeros((32, 32, 3), np.float32), klass="batch")
+    with pytest.raises(ReplicaCrashed):
+        fut.result(timeout=30)
+    assert _wait_for(lambda: fleet.stats()["crash_failed_requests"] >= 1)
+    summary = fleet.close()
+    assert summary["crash_failed_requests"] == 1
+    assert summary["recoveries"] >= 2  # one per burned dispatch
+
+
+def test_circuit_breaker_opens_and_close_drains_stranded_queue():
+    """A replica dying on consecutive flushes is circuit-broken out of
+    the fleet; with every circuit open, close() fails whatever is still
+    queued with ReplicaCrashed instead of hanging the dispatcher."""
+    from cyclegan_tpu.resil import FaultInjector
+
+    eng = FakeEngine(buckets=(2,))
+    rec = _Recorder()
+    inj = FaultInjector.from_spec("replica_crash@flush=0x20", telemetry=rec)
+    fleet = FleetExecutor(
+        eng,
+        FleetConfig(n_replicas=1, max_batch=2, max_wait_ms=0.0,
+                    health_poll_s=0.01, max_replica_failures=2,
+                    max_request_attempts=8),
+        logger=rec, injector=inj)
+    img = np.zeros((32, 32, 3), np.float32)
+    futs = [fleet.submit(img, klass="batch") for _ in range(2)]
+    assert _wait_for(
+        lambda: any(e.get("circuit_open") for e in rec.of("fleet_recovery")))
+    summary = fleet.close()
+    for f in futs:
+        with pytest.raises(ReplicaCrashed):
+            f.result(timeout=5)
+    assert summary["circuits_open"] == 1
+    # Two recovery passes: the first respawned, the second hit the
+    # consecutive-failure limit and opened the circuit instead.
+    assert summary["recoveries"] == 2
+    assert [e["respawned"] for e in rec.of("fleet_recovery")] == [True, False]
+    assert fleet.stats()["circuits_open"] == 1

@@ -313,6 +313,36 @@ def test_loop_feeds_monitor_without_extra_dispatches(tiny_config, devices,
     assert not [e for e in evs if e["event"] == "health_fault"]
 
 
+def test_rollback_policy_no_fault_path_adds_no_dispatches(
+        tiny_config, devices, tmp_path):
+    """The resilience stack must be free when nothing fails: with
+    on_nan='rollback' AND an armed injector whose fault never fires,
+    the StepClock dispatch count stays EXACTLY the step count — same
+    pin as the health layer's, extended over the rollback path (the
+    no-sync half is tools/check_no_sync.py scanning resil/)."""
+    from cyclegan_tpu.resil import FaultInjector
+    from cyclegan_tpu.utils.summary import NullSummary
+
+    loop, plan, data, state, step = _loop_setup(tiny_config, devices)
+    path = str(tmp_path / "t.jsonl")
+    tele = make_telemetry(ObsConfig(jsonl_path=path), str(tmp_path))
+    mon = HealthMonitor(telemetry=tele, on_nan="rollback")
+    injector = FaultInjector.from_spec("nan_grads@step=100000",
+                                       telemetry=tele)
+    mon.begin_epoch(0)
+    loop.train_epoch(tiny_config, data, plan, step, state, NullSummary(),
+                     epoch=0, obs=tele, health=mon, injector=injector)
+    mon.epoch_rollup(0)
+    tele.close()
+
+    evs = [json.loads(l) for l in open(path) if l.strip()]
+    agg = [e for e in evs if e["event"] == "epoch_steps"][0]
+    assert agg["n_dispatches"] == data.train_steps
+    assert not [e for e in evs if e["event"] == "health_fault"]
+    assert not [e for e in evs if e["event"] == "fault_injected"]
+    assert not [e for e in evs if e["event"] == "retry"]
+
+
 def test_loop_nan_injection_halts_within_fetch_horizon(tiny_config, devices,
                                                        tmp_path):
     """Poisoned params under on_nan='halt': train_epoch raises

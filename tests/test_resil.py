@@ -1,0 +1,368 @@
+"""Tests for cyclegan_tpu/resil: the fault-injection registry, bounded
+backoff retry, the rollback controller, and the end-to-end chaos drill.
+
+Determinism is the load-bearing property throughout: a fault spec must
+fire at exactly the index it names (so a drill replays identically),
+and backoff jitter must be a pure function of (site, salt, attempt)
+(so two runs of the same drill log the same delays)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cyclegan_tpu.resil import (  # noqa: E402
+    DEFAULT_RETRY_POLICY,
+    Fault,
+    FaultInjector,
+    InjectedCrash,
+    InjectedIOError,
+    RetryingIterator,
+    RetryPolicy,
+    RollbackController,
+    backoff_delay,
+    retry_call,
+)
+from cyclegan_tpu.resil.faults import parse_spec  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class Recorder:
+    def __init__(self):
+        self.events = []
+
+    def event(self, kind, /, **fields):
+        self.events.append(dict(fields, event=kind))
+
+    def of(self, kind):
+        return [e for e in self.events if e["event"] == kind]
+
+    def flush(self):
+        pass
+
+
+# ------------------------------------------------------------- spec parsing
+
+
+def test_parse_spec_entries_and_defaults():
+    faults = parse_spec("nan_grads@step=6, ckpt_io_error@epoch=0x2")
+    assert [repr(f) for f in faults] == ["nan_grads@step=6",
+                                        "ckpt_io_error@epoch=0x2"]
+    assert faults[0].times == 1 and faults[1].times == 2
+    assert parse_spec("") == [] and parse_spec(None) == []
+
+
+@pytest.mark.parametrize("bad", [
+    "nan_grads",                 # no index
+    "nan_grads@step=x",          # non-numeric
+    "warp_core_breach@step=1",   # unknown kind
+    "nan_grads@epoch=1",         # wrong index key for the kind
+    "nan_grads@step=1y2",        # bad repeat suffix
+])
+def test_parse_spec_rejects_bad_entries(bad):
+    with pytest.raises(ValueError):
+        parse_spec(bad)
+
+
+def test_fault_validates_bounds():
+    with pytest.raises(ValueError):
+        Fault("nan_grads", at=-1)
+    with pytest.raises(ValueError):
+        Fault("nan_grads", at=0, times=0)
+    with pytest.raises(ValueError):
+        Fault("not_a_kind", at=0)
+
+
+def test_from_spec_empty_returns_none():
+    """The zero-cost contract: a disabled run never constructs an
+    injector, so every site's guard is a single `is not None`."""
+    assert FaultInjector.from_spec("") is None
+    assert FaultInjector.from_spec(None) is None
+    assert FaultInjector.from_spec("nan_grads@step=1") is not None
+
+
+# ------------------------------------------------------------ fire windows
+
+
+def test_fire_exact_counter_match():
+    inj = FaultInjector.from_spec("nan_grads@step=2")
+    assert inj.fire("step") == []            # covers [0, 1)
+    assert inj.fire("step") == []            # [1, 2)
+    fired = inj.fire("step")                 # [2, 3)
+    assert [f.kind for f in fired] == ["nan_grads"]
+    assert inj.fire("step") == []            # exhausted
+    assert inj.pending() == []
+
+
+def test_fire_window_covers_fused_multi_step_advance():
+    """A fused K-step dispatch advances the counter by K; a fault whose
+    index lands anywhere inside the window fires on that dispatch."""
+    inj = FaultInjector.from_spec("nan_grads@step=6")
+    assert inj.fire("step", advance=4) == []       # [0, 4)
+    fired = inj.fire("step", advance=4)            # [4, 8) covers 6
+    assert [f.kind for f in fired] == ["nan_grads"]
+
+
+def test_fire_stuck_fault_outlasts_counter():
+    """An xM fault that has started firing keeps firing on later checks
+    until exhausted — this is what lets data_stall@step=KxM outlast a
+    retry loop whose re-checks pass advance=0."""
+    inj = FaultInjector.from_spec("data_stall@step=1x3")
+    assert inj.fire("data") == []
+    assert len(inj.fire("data")) == 1       # at=1 fires
+    assert len(inj.fire("data", advance=0)) == 1  # stuck re-fire
+    assert len(inj.fire("data", advance=0)) == 1  # third and last
+    assert inj.fire("data", advance=0) == []
+    assert inj.pending() == []
+
+
+def test_fire_explicit_index_leaves_counter_alone():
+    inj = FaultInjector.from_spec("ckpt_io_error@epoch=3")
+    assert inj.fire("ckpt", index=0) == []
+    assert inj.fire("ckpt", index=2) == []
+    assert len(inj.fire("ckpt", index=3)) == 1
+    assert inj.fire("ckpt", index=3) == []  # times=1 consumed
+
+
+def test_fire_emits_fault_injected_event():
+    rec = Recorder()
+    inj = FaultInjector.from_spec("replica_crash@flush=0", telemetry=rec)
+    inj.fire("flush")
+    (ev,) = rec.of("fault_injected")
+    assert ev["kind"] == "replica_crash" and ev["site"] == "flush"
+    assert ev["spec"] == "replica_crash@flush=0"
+
+
+def test_maybe_raise_raises_io_error_for_io_kinds():
+    inj = FaultInjector.from_spec("ckpt_io_error@epoch=1")
+    inj.maybe_raise("ckpt", index=0)  # no match, no raise
+    with pytest.raises(InjectedIOError):
+        inj.maybe_raise("ckpt", index=1)
+
+
+def test_injected_crash_escapes_plain_exception_handler():
+    """InjectedCrash subclasses BaseException so a replica's
+    fail-the-flush `except Exception` cannot absorb it."""
+    assert not issubclass(InjectedCrash, Exception)
+    with pytest.raises(InjectedCrash):
+        try:
+            raise InjectedCrash("boom")
+        except Exception:  # noqa: BLE001 — the point of the test
+            pytest.fail("InjectedCrash must not be caught as Exception")
+
+
+# ------------------------------------------------------------------ retry
+
+
+def test_backoff_delay_deterministic_capped_and_jittered():
+    p = RetryPolicy(base_delay_s=0.1, multiplier=2.0, max_delay_s=0.3,
+                    jitter=0.25)
+    d0 = backoff_delay(p, 0, site="ckpt", salt=7)
+    assert d0 == backoff_delay(p, 0, site="ckpt", salt=7)  # pure function
+    assert backoff_delay(p, 0, site="ckpt", salt=8) != d0  # salt decorrelates
+    # Jitter only shaves: (1-jitter)*base <= d <= base, and the cap holds
+    # even where the exponent would exceed it.
+    assert 0.075 <= d0 <= 0.1
+    assert backoff_delay(p, 10, site="x") <= 0.3
+    assert backoff_delay(RetryPolicy(jitter=0.0), 0) == 0.05
+
+
+def test_retry_policy_validates():
+    with pytest.raises(ValueError):
+        RetryPolicy(attempts=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(jitter=1.0)
+
+
+def test_retry_call_absorbs_transients_with_events():
+    rec = Recorder()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    slept = []
+    assert retry_call(flaky, site="ckpt", telemetry=rec,
+                      sleep=slept.append) == "ok"
+    assert calls["n"] == 3 and len(slept) == 2
+    evs = rec.of("retry")
+    assert [e["attempt"] for e in evs] == [1, 2]
+    assert all(e["site"] == "ckpt" and "OSError" in e["error"] for e in evs)
+
+
+def test_retry_call_budget_exhaustion_reraises():
+    def always_fails():
+        raise OSError("persistent")
+
+    with pytest.raises(OSError, match="persistent"):
+        retry_call(always_fails, site="ckpt",
+                   policy=RetryPolicy(attempts=2), sleep=lambda s: None)
+
+
+def test_retry_call_non_retryable_propagates_immediately():
+    calls = {"n": 0}
+
+    def buggy():
+        calls["n"] += 1
+        raise ValueError("a bug, not weather")
+
+    with pytest.raises(ValueError):
+        retry_call(buggy, site="ckpt", sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_retry_call_absorbs_injected_ckpt_io_error():
+    rec = Recorder()
+    inj = FaultInjector.from_spec("ckpt_io_error@epoch=5", telemetry=rec)
+    out = retry_call(lambda: "saved", site="ckpt", index=5, injector=inj,
+                     telemetry=rec, sleep=lambda s: None)
+    assert out == "saved"
+    assert len(rec.of("fault_injected")) == 1
+    assert len(rec.of("retry")) == 1
+    assert inj.pending() == []
+
+
+def test_retrying_iterator_passthrough_and_stop():
+    it = RetryingIterator(iter([1, 2, 3]))
+    assert list(it) == [1, 2, 3]
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_retrying_iterator_absorbs_injected_stall():
+    rec = Recorder()
+    inj = FaultInjector.from_spec("data_stall@step=1", telemetry=rec)
+    it = RetryingIterator(iter("abc"), telemetry=rec, injector=inj,
+                          sleep=lambda s: None)
+    assert list(it) == ["a", "b", "c"]
+    assert len(rec.of("retry")) == 1
+    assert inj.pending() == []
+
+
+def test_retrying_iterator_persistent_stall_exhausts_budget():
+    """x4 stall against a 3-try budget: the wrapper re-raises on the
+    final attempt instead of looping forever (bounded by design)."""
+    inj = FaultInjector.from_spec("data_stall@step=0x4")
+    it = RetryingIterator(iter("ab"), injector=inj,
+                          policy=RetryPolicy(attempts=3),
+                          sleep=lambda s: None)
+    with pytest.raises(InjectedIOError):
+        next(it)
+
+
+# --------------------------------------------------------------- rollback
+
+
+class FakeCkpt:
+    def __init__(self, state="good", fail=None, have=True):
+        self._state = state
+        self._fail = fail
+        self._have = have
+        self.slot = "/ckpts/checkpoint-e00004"
+        self.n_restores = 0
+
+    def exists(self):
+        return self._have
+
+    def restore(self, template, partial=False):
+        self.n_restores += 1
+        if self._fail is not None:
+            raise self._fail
+        return self._state, 5
+
+
+class FakeFault(Exception):
+    kind = "nonfinite"
+
+
+class FakeData:
+    def __init__(self):
+        self.salts = []
+
+    def reseed(self, salt):
+        self.salts.append(salt)
+
+
+def test_rollback_restores_reseeds_and_counts():
+    rec = Recorder()
+    ckpt, data = FakeCkpt(), FakeData()
+    rb = RollbackController(ckpt, data=data, telemetry=rec,
+                            max_rollbacks=2)
+    state, nxt = rb.recover("template", FakeFault(), epoch=7)
+    assert (state, nxt) == ("good", 5)
+    assert data.salts == [1]
+    assert rb.consecutive == 1 and rb.total == 1
+    (ev,) = rec.of("health_recovery")
+    assert ev["fault_kind"] == "nonfinite"
+    assert ev["epoch_faulted"] == 7 and ev["resume_epoch"] == 5
+    assert ev["slot"] == ckpt.slot
+
+    rb.note_clean_epoch()
+    assert rb.consecutive == 0
+    state, _ = rb.recover("template", FakeFault(), epoch=9)
+    assert data.salts == [1, 2]  # salt advances with total, not consecutive
+
+
+def test_rollback_budget_exhaustion_reraises_original_fault():
+    rb = RollbackController(FakeCkpt(), max_rollbacks=1)
+    rb.recover("t", FakeFault(), epoch=3)
+    fault = FakeFault()
+    with pytest.raises(FakeFault) as e:
+        rb.recover("t", fault, epoch=4)
+    assert e.value is fault
+
+
+def test_rollback_zero_budget_never_restores():
+    ckpt = FakeCkpt()
+    rb = RollbackController(ckpt, max_rollbacks=0)
+    with pytest.raises(FakeFault):
+        rb.recover("t", FakeFault(), epoch=0)
+    assert ckpt.n_restores == 0
+
+
+def test_rollback_without_slots_or_on_restore_failure_halts():
+    with pytest.raises(FakeFault):
+        RollbackController(FakeCkpt(have=False),
+                           max_rollbacks=2).recover("t", FakeFault(), 0)
+    broken = FakeCkpt(fail=RuntimeError("every slot failed"))
+    with pytest.raises(FakeFault):
+        RollbackController(broken, max_rollbacks=2).recover(
+            "t", FakeFault(), 0)
+    assert broken.n_restores == 1
+
+
+def test_rollback_validates_budget():
+    with pytest.raises(ValueError):
+        RollbackController(FakeCkpt(), max_rollbacks=-1)
+
+
+# ------------------------------------------------------------ chaos drill
+
+
+def test_chaos_drill_fast_passes_end_to_end(tmp_path):
+    """The acceptance drill: `python tools/chaos_drill.py --fast` on CPU
+    must pass all three scripted drills — NaN rollback through the
+    verified ring (a real main.py run), replica-crash self-healing, and
+    retried checkpoint I/O — and emit one parseable JSON line."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, "tools/chaos_drill.py", "--fast",
+         "--workdir", str(tmp_path)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=580)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    report = json.loads(r.stdout.strip().splitlines()[-1])
+    assert report["metric"] == "cyclegan_chaos_drill"
+    assert report["pass"] is True
+    assert set(report["drills"]) == {"nan_rollback", "fleet_crash",
+                                     "ckpt_retry"}
+    for name, drill in report["drills"].items():
+        assert drill["pass"], (name, drill)

@@ -78,6 +78,11 @@ HOT_PATH_DIRS: List[Tuple[str, bool]] = [
     ("cyclegan_tpu/ops/pallas", False),
     ("cyclegan_tpu/serve", True),
     ("cyclegan_tpu/serve/fleet", True),
+    # resil (no sanctioned sites): fault injection, retry, and rollback
+    # are pure host-side orchestration at dispatch/IO boundaries — a
+    # device sync here would put a stall INSIDE the recovery machinery
+    # that exists to keep the loop async under failure.
+    ("cyclegan_tpu/resil", False),
 ]
 
 

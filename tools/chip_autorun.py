@@ -222,6 +222,15 @@ def build_queue(mode: str, round_tag: str = ROUND_TAG) -> list:
              3600.0, env=env,
              collect=[("/tmp/chip_autorun_trace/traces",
                        os.path.join(LOG_DIR_REL, "trace_run", "traces"))]),
+        # Chaos drill on chip (resil acceptance): the same scripted
+        # fault drills tier-1 runs on CPU — NaN rollback through the
+        # verified ring, replica-crash self-healing, retried ckpt I/O —
+        # executed against the real accelerator path. One JSON line,
+        # exit nonzero if any recovery invariant fails.
+        Step("chaos_drill", [py, "tools/chaos_drill.py"], 3600.0,
+             env=env,
+             stdout_to=os.path.join(
+                 "docs", f"chaos_drill_{round_tag}.json")),
         # End-to-end timed training run — the direct analog of the
         # reference's only perf signal (main.py:388-392 epoch timing);
         # numbers print to the step log. Output dir is OUTSIDE the

@@ -78,6 +78,14 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
         "fleet_flushes": [],    # per-flush fleet dispatcher events
         "fleet_sheds": [],      # admission-control shed decisions
         "fleet_summary": None,  # FleetExecutor close() rollup
+        # Resilience stream (cyclegan_tpu/resil): injected faults, I/O
+        # retries, rollback recoveries, fleet self-healing.
+        "fault_injections": [],
+        "retries": [],
+        "recoveries": [],        # health_recovery (NaN rollback)
+        "ckpt_fallbacks": [],    # restore skipped a corrupt ring slot
+        "fleet_downs": [],       # fleet_replica_down detections
+        "fleet_recoveries": [],  # respawn/re-enqueue outcomes
         "end": None,
     }
     for ev in events:
@@ -130,6 +138,18 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             report["fleet_sheds"].append(ev)
         elif kind == "fleet_summary":
             report["fleet_summary"] = ev
+        elif kind == "fault_injected":
+            report["fault_injections"].append(ev)
+        elif kind == "retry":
+            report["retries"].append(ev)
+        elif kind == "health_recovery":
+            report["recoveries"].append(ev)
+        elif kind == "ckpt_fallback":
+            report["ckpt_fallbacks"].append(ev)
+        elif kind == "fleet_replica_down":
+            report["fleet_downs"].append(ev)
+        elif kind == "fleet_recovery":
+            report["fleet_recoveries"].append(ev)
         elif kind == "end":
             report["end"] = ev
         # unknown events: ignored by design
@@ -418,6 +438,51 @@ def render(report: dict) -> str:
               + (f" {detail}" if detail else ""))
         if len(report["health_faults"]) > 10:
             w(f"... {len(report['health_faults']) - 10} more")
+
+    # Resilience: what failed (or was injected), and what the recovery
+    # machinery did about it. Silent absence is the healthy case.
+    resil_any = (report["fault_injections"] or report["retries"]
+                 or report["recoveries"] or report["ckpt_fallbacks"]
+                 or report["fleet_downs"] or report["fleet_recoveries"])
+    if resil_any:
+        w("-- resilience --")
+        if report["fault_injections"]:
+            by_kind: Dict[str, int] = {}
+            for ev in report["fault_injections"]:
+                k = str(ev.get("kind", "?"))
+                by_kind[k] = by_kind.get(k, 0) + 1
+            w("injected faults: " + ", ".join(
+                f"{k} x{n}" for k, n in sorted(by_kind.items())))
+        if report["retries"]:
+            by_site: Dict[str, List[float]] = {}
+            for ev in report["retries"]:
+                by_site.setdefault(str(ev.get("site", "?")), []).append(
+                    float(ev.get("delay_s", 0.0)))
+            for site, delays in sorted(by_site.items()):
+                w(f"retries[{site}]: {len(delays)} "
+                  f"(backoff total {sum(delays):.2f}s, "
+                  f"max {max(delays):.2f}s)")
+        for ev in report["recoveries"]:
+            w(f"ROLLBACK: {ev.get('fault_kind', '?')} at epoch "
+              f"{ev.get('epoch_faulted', '?')} -> restored "
+              f"{ev.get('slot', '?')}, resumed epoch "
+              f"{ev.get('resume_epoch', '?')} "
+              f"({ev.get('consecutive', '?')}/{ev.get('max_rollbacks', '?')} "
+              f"consecutive, {ev.get('total', '?')} total)")
+        for ev in report["ckpt_fallbacks"]:
+            failed = ev.get("failed") or []
+            w(f"CKPT FALLBACK: restored {ev.get('slot', '?')} after "
+              f"{len(failed)} unverifiable slot(s): "
+              + "; ".join(str(f) for f in failed))
+        for ev in report["fleet_downs"]:
+            w(f"replica {ev.get('replica', '?')} DOWN ({ev.get('reason', '?')}, "
+              f"{ev.get('inflight', 0)} in flight, "
+              f"{ev.get('consecutive_failures', '?')} consecutive)")
+        for ev in report["fleet_recoveries"]:
+            w(f"fleet recovery: replica {ev.get('replica', '?')} "
+              f"respawned={ev.get('respawned', '?')} "
+              f"requeued={ev.get('requeued', 0)} failed={ev.get('failed', 0)}"
+              + ("  CIRCUIT OPEN" if ev.get("circuit_open") else ""))
 
     if report["stalls"]:
         w(f"-- stalls: {len(report['stalls'])} --")

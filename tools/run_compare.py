@@ -182,7 +182,20 @@ def stream_profile(events: List[dict], skipped: int = 0, name: str = "?") -> dic
     for ev in faults:
         kind = str(ev.get("kind", "?"))
         fault_kinds[kind] = fault_kinds.get(kind, 0) + 1
+    # Recovery profile (cyclegan_tpu/resil): how often the run had to
+    # save itself, and whether a fault actually halted it. A fault the
+    # rollback policy absorbed is NOT halting; one that propagated
+    # (policy halt, or rollback budget exhausted -> end status
+    # health_fault) is.
+    n_rollbacks = sum(1 for e in events
+                      if e.get("event") == "health_recovery")
+    n_fleet_recoveries = sum(1 for e in events
+                             if e.get("event") == "fleet_recovery")
+    n_retries = sum(1 for e in events if e.get("event") == "retry")
     end = next((e for e in events if e.get("event") == "end"), None)
+    halting = sum(1 for e in faults if e.get("policy") == "halt")
+    if end is not None and end.get("status") == "health_fault":
+        halting = max(halting, 1)
     return {
         "kind": "stream",
         "name": name,
@@ -195,6 +208,10 @@ def stream_profile(events: List[dict], skipped: int = 0, name: str = "?") -> dic
         "faults": fault_kinds,
         "n_faults": sum(fault_kinds.values()),
         "n_stalls": stalls,
+        "n_rollbacks": n_rollbacks,
+        "n_halting_faults": halting,
+        "n_fleet_recoveries": n_fleet_recoveries,
+        "n_retries": n_retries,
         "end_status": end.get("status") if end else None,
     }
 
@@ -355,6 +372,31 @@ def _compare_streams(base: dict, cand: dict, th) -> List[Check]:
     checks.append((INFO, "stalls",
                    f"watchdog/loop stalls {base['n_stalls']} -> "
                    f"{cand['n_stalls']} (reported, not gated)"))
+
+    # Recovery axis: a candidate that newly HALTS on a fault, or leans
+    # harder on the rollback machinery than its base, regressed even if
+    # every epoch it finished looks healthy.
+    b_halt = base.get("n_halting_faults", 0)
+    c_halt = cand.get("n_halting_faults", 0)
+    status = FAIL if c_halt > b_halt else PASS
+    checks.append((status, "recovery halting-faults",
+                   f"halting faults {b_halt} -> {c_halt} "
+                   f"(any increase fails)"))
+    b_roll = base.get("n_rollbacks", 0)
+    c_roll = cand.get("n_rollbacks", 0)
+    status = FAIL if c_roll > b_roll else PASS
+    checks.append((status, "recovery rollbacks",
+                   f"NaN rollbacks {b_roll} -> {c_roll} "
+                   f"(any increase fails)"))
+    if base.get("n_retries", 0) or cand.get("n_retries", 0) \
+            or base.get("n_fleet_recoveries", 0) \
+            or cand.get("n_fleet_recoveries", 0):
+        checks.append((INFO, "recovery churn",
+                       f"I/O retries {base.get('n_retries', 0)} -> "
+                       f"{cand.get('n_retries', 0)}, fleet recoveries "
+                       f"{base.get('n_fleet_recoveries', 0)} -> "
+                       f"{cand.get('n_fleet_recoveries', 0)} "
+                       f"(reported, not gated)"))
     return checks
 
 
