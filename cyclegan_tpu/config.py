@@ -265,6 +265,15 @@ class TrainConfig:
     #               the saving is one disc forward + one activation
     #               backward per fake (utils/flops.py: 14d vs 16d).
     grad_impl: str = "combined"  # "combined" | "fusedprop"
+    # Preemption grace budget in seconds (resil/elastic.py). 0 = the
+    # historical protocol: a SIGTERM finishes the in-flight EPOCH, then
+    # checkpoints. > 0 arms mid-epoch emergency saves: the dispatch loop
+    # polls the guard once per dispatch and, on SIGTERM, writes a
+    # step-granular slot (epoch, step, data seed) within this budget —
+    # size it to the platform's grace window (TPU preemption: 30s) minus
+    # a safety margin. Mid-epoch saves are single-process only;
+    # multi-host runs keep the epoch-boundary protocol regardless.
+    preempt_deadline_s: float = 0.0
 
     def __post_init__(self):
         # A typo like "fused" would silently fall back nowhere — fail at
@@ -278,6 +287,10 @@ class TrainConfig:
                 f"train.grad_impl must be 'combined' or 'fusedprop', got "
                 f"{self.grad_impl!r}"
             )
+        if self.preempt_deadline_s < 0:
+            raise ValueError(
+                f"train.preempt_deadline_s must be >= 0, got "
+                f"{self.preempt_deadline_s}")
 
 
 @dataclasses.dataclass(frozen=True)

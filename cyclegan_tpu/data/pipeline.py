@@ -113,6 +113,22 @@ class CycleGANData:
                 self._prep_train("trainB", epoch=0),
             )
 
+    def restore_seed(self, seed: int) -> None:
+        """Set the EXACT effective seed a checkpoint recorded — the
+        elastic-resume counterpart of reseed(): a mid-epoch emergency
+        slot persists (epoch, step, data_seed) and the restored process
+        must replay the identical permutation/augmentation stream even
+        if rollbacks had reseeded the original run before the save."""
+        seed = int(seed) % (1 << 32)
+        if seed == self.seed:
+            return
+        self.seed = seed
+        if self._train_cache is not None:
+            self._train_cache = (
+                self._prep_train("trainA", epoch=0),
+                self._prep_train("trainB", epoch=0),
+            )
+
     # -- preprocessing ---------------------------------------------------
 
     def _prep_test(self, split: str) -> List[np.ndarray]:
@@ -233,7 +249,9 @@ class CycleGANData:
             assert x.shape[1:] == (crop, crop, ch)
             yield x, y, wlocal
 
-    def train_epoch(self, epoch: int, prefetch: bool = True) -> Iterator[Batch]:
+    def train_epoch(
+        self, epoch: int, prefetch: bool = True, start_step: int = 0,
+    ) -> Iterator[Batch]:
         if self._train_cache is not None:
             items_a, items_b = self._train_cache
             get_a = items_a.__getitem__
@@ -244,12 +262,19 @@ class CycleGANData:
             # without stalling the device).
             get_a = lambda i: self._augment_one("trainA", epoch, i)
             get_b = lambda i: self._augment_one("trainB", epoch, i)
-        it = self._batches(
-            get_a,
-            get_b,
-            self._epoch_order(epoch, 0, self.n_train),
-            self._epoch_order(epoch, 1, self.n_train),
-        )
+        order_a = self._epoch_order(epoch, 0, self.n_train)
+        order_b = self._epoch_order(epoch, 1, self.n_train)
+        if start_step:
+            # Mid-epoch resume (resil/elastic.py): _batches strides the
+            # order arrays in global_batch_size chunks, so dropping the
+            # first start_step*gbs indices yields EXACTLY batches
+            # start_step.. of the full epoch — no sample skipped or
+            # repeated across the preemption seam, on any topology whose
+            # batch x grad_accum decomposition preserves gbs.
+            skip = int(start_step) * self.global_batch_size
+            order_a = order_a[skip:]
+            order_b = order_b[skip:]
+        it = self._batches(get_a, get_b, order_a, order_b)
         return prefetch_iter(it, depth=2) if prefetch else it
 
     def test_epoch(self, prefetch: bool = True) -> Iterator[Batch]:

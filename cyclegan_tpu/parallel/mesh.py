@@ -53,6 +53,18 @@ class MeshPlan:
         """Per-sample weights: [N] over "data"."""
         return P(self.data_axis)
 
+    def describe(self) -> dict:
+        """JSON-safe mesh facts for checkpoint manifests and telemetry
+        (resil/elastic.py) — the fields a restore on a DIFFERENT mesh
+        needs to detect drift and recompute the batch decomposition."""
+        return {
+            "n_devices": self.n_devices,
+            "n_data": self.n_data,
+            "n_spatial": self.n_spatial,
+            "data_axis": self.data_axis,
+            "spatial_axis": self.spatial_axis,
+        }
+
 
 def make_mesh_plan(
     config: Optional[ParallelConfig] = None,

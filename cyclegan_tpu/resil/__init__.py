@@ -1,8 +1,8 @@
 """Resilience layer: deterministic fault injection, bounded-backoff
-retries, and in-run rollback — detection (obs/health.py) turned into
-recovery.
+retries, in-run rollback, and elastic topology recovery — detection
+(obs/health.py) turned into recovery.
 
-Three modules, one per recovery mechanism:
+Four modules, one per recovery mechanism:
 
 - faults.py   — the seeded fault-injection registry behind ``--inject``:
                 every recovery path in this repo is exercised on CPU by
@@ -25,11 +25,35 @@ Three modules, one per recovery mechanism:
                 data pipeline, and a ``health_recovery`` event — the
                 run halts only after ``--max_rollbacks`` consecutive
                 failures.
+- elastic.py  — topology-elastic restore and bounded mid-epoch
+                preemption saves: checkpoint slots carry their writing
+                mesh + batch decomposition, restores reshard onto the
+                CURRENT mesh (preserving the global batch exactly or
+                refusing with guidance), and ``--preempt_deadline_s``
+                turns a SIGTERM into a step-granular emergency slot the
+                data pipeline resumes from mid-permutation.
 
-tools/check_no_sync.py scans this package as hot-path with ZERO
-sanctioned sites: resilience must never add a device sync to the loop.
+tools/check_no_sync.py scans this package as hot-path. faults/retry/
+rollback have ZERO sanctioned sites — resilience must never add a
+device sync to the loop. elastic.py's single sanctioned fetch is the
+restore-time gather in ``reshard_to_plan``, which by construction runs
+before any dispatch exists to serialize.
 """
 
+from cyclegan_tpu.resil.elastic import (
+    ElasticResume,
+    ElasticTopologyError,
+    MidEpochBreaker,
+    arm_preempt_kill_timer,
+    elastic_restore_if_exists,
+    emergency_save,
+    preflight_elastic,
+    reshard_to_plan,
+    resolve_batch_decomposition,
+    save_meta,
+    topology_matches,
+    topology_record,
+)
 from cyclegan_tpu.resil.faults import (
     FAULT_KINDS,
     Fault,
@@ -48,14 +72,26 @@ from cyclegan_tpu.resil.rollback import RollbackController
 
 __all__ = [
     "DEFAULT_RETRY_POLICY",
+    "ElasticResume",
+    "ElasticTopologyError",
     "FAULT_KINDS",
     "Fault",
     "FaultInjector",
     "InjectedCrash",
     "InjectedIOError",
+    "MidEpochBreaker",
     "RetryPolicy",
     "RetryingIterator",
     "RollbackController",
+    "arm_preempt_kill_timer",
     "backoff_delay",
+    "elastic_restore_if_exists",
+    "emergency_save",
+    "preflight_elastic",
+    "reshard_to_plan",
+    "resolve_batch_decomposition",
     "retry_call",
+    "save_meta",
+    "topology_matches",
+    "topology_record",
 ]

@@ -1,0 +1,454 @@
+"""Elastic topology recovery: any verified slot restores onto any mesh.
+
+PR 8 made a run survive faults on ONE topology; this module removes the
+weld between a checkpoint and the mesh that wrote it, and between a
+SIGTERM and the epoch boundary. Three cooperating pieces:
+
+- **Topology-aware slots.** Every save records the MeshPlan
+  (dp x spatial), the per-leaf sharding specs, and the global-batch
+  decomposition (n_data x batch_size x grad_accum) — `topology_record`
+  builds the dict, `save_meta` threads it into the slot manifest and
+  the meta.json sidecar (utils/checkpoint.py copies it verbatim).
+
+- **Reshard-on-restore.** `preflight_elastic` runs BEFORE the data
+  pipeline and step programs are built: when the sidecar's topology
+  differs from the current mesh it recomputes batch_size x grad_accum
+  so the GLOBAL batch is preserved exactly (the optimization trajectory
+  depends on it), or refuses with CLI guidance when the old global
+  batch is unreachable on the new chip count.
+  `elastic_restore_if_exists` then restores through the verified-ring
+  walk and, on topology drift, gathers every leaf to a host-consistent
+  array and `device_put`s it under the CURRENT mesh's NamedShardings
+  (logged as `elastic_reshard` telemetry). Strict mode still refuses
+  shape/dtype drift — replicated weights have topology-independent
+  shapes, so only a genuinely different model trips it. The resharded
+  leaves are routed through `jnp.copy` so the donation hazard that
+  motivated checkpoint._rebuffer (on CPU the host hop can be zero-copy
+  in BOTH directions, so donating the placed buffer corrupts the heap)
+  cannot reach the resharded state either.
+
+- **Mid-epoch emergency saves.** With ``--preempt_deadline_s S`` the
+  dispatch loop polls the PreemptionGuard once per dispatch
+  (`MidEpochBreaker`) and, on SIGTERM, breaks out mid-epoch;
+  `emergency_save` writes a step-granular slot whose sidecar persists
+  (epoch, step, data seed), drops queued cosmetic service jobs so the
+  grace budget belongs to the checkpoint commit, and barriers within
+  the remaining deadline. On resume the deterministic per-epoch
+  permutation (data/pipeline.py) fast-forwards to the exact sample
+  position — at most the in-flight dispatches are lost, never the
+  epoch. Mid-epoch saves are single-process only: the per-dispatch
+  poll reads the host-local flag (a cross-host sync per dispatch would
+  serialize the loop); multi-host runs keep the epoch-boundary
+  protocol.
+
+The whole module is host-side orchestration at restore/preemption
+boundaries; its ONE device fetch (the restore-time gather in
+`reshard_to_plan`) is marked `sanctioned-fetch` and the file is on
+tools/check_no_sync.py's hot-path list so nothing else sneaks in.
+Drilled end-to-end by ``tools/chaos_drill.py elastic_resume``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional, Tuple
+
+import jax
+
+# Keys of topology_record compared by topology_matches / echoed in the
+# elastic_reshard event (leaf_specs is recorded but too big to echo).
+_TOPOLOGY_KEYS = ("n_devices", "n_data", "n_spatial", "data_axis",
+                  "spatial_axis", "batch_size", "grad_accum",
+                  "global_batch_size", "steps_per_dispatch")
+
+
+class ElasticTopologyError(RuntimeError):
+    """The saved run's global batch cannot be reproduced on the current
+    mesh — restoring anyway would silently change the optimization
+    trajectory. The message carries the CLI guidance."""
+
+
+# ------------------------------------------------------------- recording
+
+
+def _path_key(path) -> str:
+    """Flatten a jax key path to 'a/b/c' (same scheme as
+    utils/checkpoint.py so specs line up with manifest/restore paths)."""
+    parts = []
+    for e in path:
+        for attr in ("name", "key", "idx"):
+            if hasattr(e, attr):
+                parts.append(str(getattr(e, attr)))
+                break
+        else:
+            parts.append(str(e))
+    return "/".join(parts)
+
+
+def leaf_sharding_specs(state) -> dict:
+    """Per-leaf sharding-spec strings for the slot manifest. Host-side
+    metadata reads only (no device sync); non-jax leaves (numpy test
+    states) record as 'host'."""
+    specs = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        specs[_path_key(path)] = str(spec) if spec is not None else "host"
+    return specs
+
+
+def topology_record(plan, config, state=None) -> dict:
+    """The topology facts a slot must carry to be restorable anywhere:
+    mesh shape, axis names, and the global-batch decomposition."""
+    rec = dict(plan.describe())
+    rec["batch_size"] = int(config.train.batch_size)
+    rec["grad_accum"] = int(config.train.grad_accum)
+    rec["steps_per_dispatch"] = int(config.train.steps_per_dispatch)
+    rec["global_batch_size"] = (
+        plan.n_data * config.train.batch_size * config.train.grad_accum
+    )
+    if state is not None:
+        rec["leaf_specs"] = leaf_sharding_specs(state)
+    return rec
+
+
+def save_meta(config, plan, state=None, mid_epoch: Optional[dict] = None,
+              data_seed: Optional[int] = None) -> dict:
+    """The checkpoint meta dict: model architecture (as before) plus the
+    topology record; `mid_epoch` marks a step-granular emergency slot
+    with its resume position {"epoch", "step", "data_seed"}."""
+    meta = dict(config.model_meta())
+    meta["topology"] = topology_record(plan, config, state=state)
+    if data_seed is not None:
+        meta["data_seed"] = int(data_seed)
+    if mid_epoch is not None:
+        meta["mid_epoch"] = {k: int(v) for k, v in mid_epoch.items()}
+    return meta
+
+
+def topology_matches(saved: Optional[dict], plan) -> bool:
+    """True when the saved mesh shape equals the current plan's (axis
+    names may differ cosmetically; the shape is what placement and the
+    batch decomposition depend on). No record means a pre-elastic slot:
+    treated as matching — there is nothing to reshard against."""
+    if not isinstance(saved, dict):
+        return True
+    for key, cur in (("n_data", plan.n_data), ("n_spatial", plan.n_spatial)):
+        if key in saved and int(saved[key]) != int(cur):
+            return False
+    return True
+
+
+# ----------------------------------------------------------- preflight
+
+
+def read_sidecar_topology(output_dir: str) -> Optional[dict]:
+    """The topology record of the newest save, straight from the
+    meta.json sidecar — readable before a Checkpointer (and the
+    telemetry it wants) exists. Unreadable/absent degrades to None."""
+    path = os.path.join(output_dir, "checkpoints", "meta.json")
+    try:
+        with open(path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        return None
+    topo = meta.get("topology")
+    return topo if isinstance(topo, dict) else None
+
+
+def resolve_batch_decomposition(saved: dict, plan, config) -> Tuple[int, int]:
+    """(batch_size, grad_accum) reproducing the SAVED global batch on
+    the current mesh. Preference order: keep the configured pair when it
+    already lands on the saved global batch; keep grad_accum (memory
+    contract) and rescale batch_size; keep batch_size and rescale
+    grad_accum; finally microbatch at 1. Raises ElasticTopologyError
+    with CLI guidance when the saved global batch is not divisible by
+    the current data-shard count."""
+    try:
+        gbs = int(saved["global_batch_size"])
+    except (KeyError, TypeError, ValueError):
+        gbs = (int(saved.get("n_data", plan.n_data))
+               * int(saved.get("batch_size", config.train.batch_size))
+               * int(saved.get("grad_accum", 1)))
+    n_data = plan.n_data
+    if gbs % n_data != 0:
+        raise ElasticTopologyError(
+            f"elastic restore refused: the checkpoint was written with "
+            f"global batch {gbs} (n_data={saved.get('n_data')} x "
+            f"batch_size={saved.get('batch_size')} x "
+            f"grad_accum={saved.get('grad_accum')}), which no "
+            f"batch_size x grad_accum can reproduce on {n_data} data "
+            f"shards ({gbs} % {n_data} != 0). Rerun on a device/"
+            f"spatial split whose data-shard count divides {gbs} "
+            f"(e.g. adjust --spatial_parallelism), or retrain with "
+            f"--clear_output_dir to accept a new global batch.")
+    per = gbs // n_data
+    old_b, old_a = config.train.batch_size, config.train.grad_accum
+    if old_b * old_a == per:
+        return old_b, old_a
+    if per % old_a == 0:
+        return per // old_a, old_a
+    if config.train.steps_per_dispatch == 1:
+        if per % old_b == 0:
+            return old_b, per // old_b
+        return 1, per
+    raise ElasticTopologyError(
+        f"elastic restore refused: reproducing global batch {gbs} on "
+        f"{n_data} data shards needs grad_accum > 1 (per-shard batch "
+        f"{per} does not divide by grad_accum={old_a}), which is "
+        f"mutually exclusive with --steps_per_dispatch "
+        f"{config.train.steps_per_dispatch}. Drop --steps_per_dispatch "
+        f"or pick a split whose per-shard batch is reachable.")
+
+
+def preflight_elastic(config, plan, echo=None):
+    """Run between mesh construction and data/step building: when the
+    newest save's topology differs from the current plan, rewrite
+    train.batch_size/grad_accum so the global batch is preserved
+    exactly. Same-topology resumes pass through untouched (a user's
+    deliberate batch change on the same mesh stays their call).
+
+    Returns (config, info) — info is None when nothing applied, else
+    {"saved": <topology record>, "batch_size", "grad_accum",
+    "old_batch_size", "old_grad_accum", "changed": bool} for telemetry
+    once the stream exists."""
+    saved = read_sidecar_topology(config.train.output_dir)
+    if saved is None or topology_matches(saved, plan):
+        return config, None
+    batch, accum = resolve_batch_decomposition(saved, plan, config)
+    info = {
+        "saved": {k: saved.get(k) for k in _TOPOLOGY_KEYS},
+        "batch_size": batch,
+        "grad_accum": accum,
+        "old_batch_size": config.train.batch_size,
+        "old_grad_accum": config.train.grad_accum,
+        "changed": (batch, accum) != (config.train.batch_size,
+                                      config.train.grad_accum),
+    }
+    if info["changed"]:
+        config = dataclasses.replace(
+            config,
+            train=dataclasses.replace(
+                config.train, batch_size=batch, grad_accum=accum),
+        )
+        if echo is not None:
+            echo(f"elastic restore: topology changed "
+                 f"({saved.get('n_data')}x{saved.get('n_spatial')} -> "
+                 f"{plan.n_data}x{plan.n_spatial}); recomputed "
+                 f"batch_size={batch} grad_accum={accum} to preserve "
+                 f"global batch {saved.get('global_batch_size')}")
+    return config, info
+
+
+# -------------------------------------------------------------- restore
+
+
+def reshard_to_plan(state, plan, template=None):
+    """Gather every leaf to a host-consistent array and place it under
+    the CURRENT mesh's sharding (the template's where given, replicated
+    otherwise). The host hop makes the result independent of how the
+    WRITING mesh laid the arrays out (including across process counts).
+
+    The trailing `jnp.copy` is load-bearing, not belt-and-braces: on
+    CPU both `device_get` and `device_put` can be ZERO-copy, so the
+    placed array may alias the restored buffer — and the train step
+    DONATES its state argument. Donating an aliased buffer is the
+    exact failure checkpoint._rebuffer documents (intermittent glibc
+    heap corruption, garbage in post-resume saves). Routing through an
+    XLA computation yields a genuinely XLA-owned buffer with the same
+    sharding."""
+    import jax.numpy as jnp
+
+    from cyclegan_tpu.parallel.mesh import replicated
+
+    fallback = replicated(plan)
+    t_leaves = None
+    if template is not None:
+        t_leaves = jax.tree_util.tree_leaves(template)
+
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    out = []
+    for i, leaf in enumerate(leaves):
+        if not isinstance(leaf, jax.Array):
+            out.append(leaf)
+            continue
+        sharding = None
+        if t_leaves is not None and i < len(t_leaves):
+            sharding = getattr(t_leaves[i], "sharding", None)
+        host = jax.device_get(leaf)  # sanctioned-fetch: restore-time gather, off the dispatch path by construction
+        placed = jax.device_put(host, sharding or fallback)
+        out.append(jnp.copy(placed))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+@dataclasses.dataclass
+class ElasticResume:
+    state: object
+    start_epoch: int
+    resumed: bool
+    resume_step: int = 0          # pipeline-yield index within start_epoch
+    data_seed: Optional[int] = None
+    resharded: bool = False
+
+
+def elastic_restore_if_exists(ckpt, template, plan, config,
+                              telemetry=None, partial=False,
+                              echo=None) -> ElasticResume:
+    """Checkpointer.restore_if_exists plus the elastic layer: detect
+    topology drift via the sidecar, reshard under the current mesh, and
+    surface the mid-epoch resume position of an emergency slot. The
+    mid-epoch record only applies when the restored slot IS the
+    sidecar's slot (a ring fallback to an older slot resumes at its
+    epoch boundary as before)."""
+    state, next_epoch, resumed = ckpt.restore_if_exists(
+        template, partial=partial)
+    if not resumed:
+        return ElasticResume(state, 0, False)
+    meta = ckpt.read_meta()
+    meta = meta if isinstance(meta, dict) else {}
+    saved = meta.get("topology")
+    out = ElasticResume(state, next_epoch, True)
+    if isinstance(saved, dict) and not topology_matches(saved, plan):
+        out.state = reshard_to_plan(state, plan, template=template)
+        out.resharded = True
+        n_leaves = len(jax.tree_util.tree_leaves(template))
+        if telemetry is not None:
+            telemetry.event(
+                "elastic_reshard",
+                epoch=int(next_epoch) - 1,
+                n_leaves=n_leaves,
+                from_topology={k: saved.get(k) for k in _TOPOLOGY_KEYS},
+                to_topology={
+                    k: topology_record(plan, config).get(k)
+                    for k in _TOPOLOGY_KEYS},
+            )
+        if echo is not None:
+            echo(f"elastic restore: resharded {n_leaves} leaves from "
+                 f"{saved.get('n_data')}x{saved.get('n_spatial')} onto "
+                 f"{plan.n_data}x{plan.n_spatial}")
+    mid = meta.get("mid_epoch")
+    if (isinstance(mid, dict)
+            and int(meta.get("epoch", -1)) == next_epoch - 1
+            and int(mid.get("epoch", -1)) == next_epoch - 1):
+        out.start_epoch = next_epoch - 1
+        out.resume_step = max(0, int(mid.get("step", 0)))
+        if mid.get("data_seed") is not None:
+            out.data_seed = int(mid["data_seed"])
+        if echo is not None and out.resume_step:
+            echo(f"mid-epoch resume: epoch {out.start_epoch} continues "
+                 f"at step {out.resume_step}")
+    return out
+
+
+# ------------------------------------------- mid-epoch preemption saves
+
+
+class MidEpochBreaker:
+    """Per-dispatch preemption poll for the training loop. Reads the
+    PreemptionGuard's HOST-LOCAL flag (no collective, no sync — the
+    whole point of checking inside the dispatch loop); `note()` counts
+    DISPATCHED pipeline yields so the emergency slot records the exact
+    sample position. Prefetched-but-undispatched batches are deliberately
+    uncounted: they were never trained, so resume re-yields them."""
+
+    def __init__(self, guard):
+        self.guard = guard
+        self.batches_done = 0
+        self.fired = False
+
+    def note(self, n: int = 1) -> None:
+        self.batches_done += int(n)
+
+    def should_break(self) -> bool:
+        if not self.fired and self.guard is not None \
+                and self.guard.requested_locally:
+            self.fired = True
+        return self.fired
+
+
+# Cosmetic service jobs an expiring grace window may shed: the deadline
+# budget belongs to the checkpoint commit, not panel renders/FID.
+_SHEDDABLE_JOB_PREFIXES = ("plot_cycle:", "fid:")
+
+
+def emergency_save(ckpt, state, config, plan, data, epoch, step, guard,
+                   services=None, telemetry=None, echo=None) -> bool:
+    """Write the step-granular emergency slot within the
+    --preempt_deadline_s budget. The deadline clock starts at the
+    SIGTERM (guard.requested_at), not here — in-flight dispatch drain
+    already spent part of the grace window. Queued cosmetic jobs are
+    shed so the single-worker services queue reaches the checkpoint
+    commit first; the barrier then waits out the remaining budget.
+    Returns True when the commit landed inside the deadline."""
+    deadline = float(getattr(config.train, "preempt_deadline_s", 0.0) or 0.0)
+    now = time.monotonic()
+    signal_at = getattr(guard, "requested_at", None) or now
+    meta = save_meta(
+        config, plan, state=state,
+        mid_epoch={"epoch": int(epoch), "step": int(step),
+                   "data_seed": int(data.seed)})
+    shed = 0
+    if services is not None:
+        shed = services.drop_pending(
+            lambda name: name.startswith(_SHEDDABLE_JOB_PREFIXES))
+    ckpt.save(state, epoch, meta=meta, services=services)
+    committed = True
+    if services is not None:
+        budget = None
+        if deadline > 0:
+            budget = max(0.05, deadline - (time.monotonic() - signal_at))
+        committed = services.barrier(timeout=budget)
+    elapsed = time.monotonic() - signal_at
+    margin = (deadline - elapsed) if deadline > 0 else None
+    if telemetry is not None:
+        telemetry.event(
+            "emergency_save", epoch=int(epoch), step=int(step),
+            deadline_s=deadline, elapsed_s=round(elapsed, 4),
+            margin_s=round(margin, 4) if margin is not None else None,
+            shed_jobs=shed, committed=bool(committed))
+    if echo is not None:
+        echo(f"emergency save: epoch {epoch} step {step} -> "
+             f"{os.path.basename(ckpt.slot)} "
+             f"({elapsed:.2f}s of {deadline:.2f}s budget"
+             + (f", {shed} queued job(s) shed" if shed else "") + ")")
+    return bool(committed)
+
+
+# One timer per process: the injected `preempt` fault may re-fire, but
+# the platform delivers exactly one kill deadline per preemption notice.
+_kill_timer_lock = threading.Lock()
+_kill_timer: Optional[threading.Timer] = None
+
+
+def arm_preempt_kill_timer(deadline_s: float, exit_code: int = 124):
+    """The hard half of the injected ``preempt`` fault: a daemon timer
+    that SIGKILL-surrogates (os._exit) the process `deadline_s` after
+    the simulated preemption notice, exactly as a cloud platform
+    enforces its grace window. Makes the deadline-OVERRUN path testable:
+    an emergency save slower than the budget dies with exit 124 instead
+    of pretending the grace window was infinite. No-op when the
+    deadline is unset (<= 0)."""
+    global _kill_timer
+    if deadline_s is None or deadline_s <= 0:
+        return None
+    with _kill_timer_lock:
+        if _kill_timer is not None:
+            return _kill_timer
+
+        def _kill():
+            sys.stderr.write(
+                f"preempt kill-deadline ({deadline_s}s) expired — "
+                f"hard exit {exit_code}\n")
+            sys.stderr.flush()
+            os._exit(exit_code)
+
+        t = threading.Timer(float(deadline_s), _kill)
+        t.daemon = True
+        t.start()
+        _kill_timer = t
+        return t

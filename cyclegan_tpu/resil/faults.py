@@ -26,6 +26,13 @@ Kinds and where they fire (ALL host-side — see docs/DESIGN.md):
 - ``sigterm@step=K``        — the process signals ITSELF with SIGTERM
   at the K-th dispatched step, driving the PreemptionGuard's
   finish-epoch/checkpoint/exit path.
+- ``preempt@step=K``        — a full simulated platform preemption: the
+  SIGTERM of ``sigterm`` PLUS a hard kill-deadline timer
+  (resil/elastic.arm_preempt_kill_timer) that ``os._exit(124)``s the
+  process ``--preempt_deadline_s`` after the notice, exactly as a cloud
+  grace window expires. Makes the BOUNDED mid-epoch emergency-save path
+  injectable — including the overrun case where the save loses the
+  race.
 
 Determinism: firing is a pure function of the spec and the per-site
 counters the run advances (no clocks, no RNG), so a drill replays
@@ -47,6 +54,7 @@ from typing import Dict, List, Optional
 FAULT_KINDS: Dict[str, tuple] = {
     "nan_grads": ("step", "step"),
     "sigterm": ("step", "step"),
+    "preempt": ("step", "step"),
     "data_stall": ("step", "data"),
     "ckpt_io_error": ("epoch", "ckpt"),
     "replica_crash": ("flush", "flush"),

@@ -224,10 +224,30 @@ class InferenceEngine:
     arrays + the valid count. No fetch, no sync, no compile."""
 
     def __init__(self, model_cfg, fwd_params, bwd_params=None, *,
-                 serve_cfg: ServeConfig = ServeConfig(), logger=None):
+                 serve_cfg: ServeConfig = ServeConfig(), logger=None,
+                 device=None):
         if serve_cfg.with_cycle and bwd_params is None:
             raise ValueError("with_cycle=True needs the cycle generator's "
                              "params (bwd_params)")
+        import contextlib
+
+        import jax
+
+        # Per-device binding (fleet replicas): compile every program
+        # under the target device AND commit the params there — an AOT
+        # executable bakes its device assignment in at compile time, and
+        # a committed-param mismatch raises rather than silently running
+        # on device 0 (verified behavior, tests/test_fleet.py). None
+        # keeps the historical default-device placement.
+        self.device = device
+        # Factory, not a context instance: jax.default_device returns a
+        # single-use context manager and we enter one per compile loop.
+        place = (contextlib.nullcontext if device is None
+                 else (lambda: jax.default_device(device)))
+        if device is not None:
+            fwd_params = jax.device_put(fwd_params, device)
+            if bwd_params is not None:
+                bwd_params = jax.device_put(bwd_params, device)
         # Serving dtype overrides the checkpoint's recorded compute
         # dtype; the param tree is dtype-independent (flax casts at
         # apply time), so the same weights serve both paths.
@@ -243,18 +263,21 @@ class InferenceEngine:
         # the serving loop never mutates this dict, so every later
         # request is a dict hit on an already-compiled program.
         self.programs: Dict[Tuple[int, int], Any] = {}
-        for size in self._sizes:
-            for batch in self._batch_buckets:
-                t0 = time.perf_counter()
-                self.programs[(size, batch)] = lower_forward(
-                    self.model_cfg, fwd_params, bwd_params, batch, size,
-                    serve_cfg.with_cycle,
-                ).compile()
-                self._event(
-                    "serve_compile", size=size, batch=batch,
-                    dtype=serve_cfg.dtype, with_cycle=serve_cfg.with_cycle,
-                    seconds=round(time.perf_counter() - t0, 3),
-                )
+        with place():
+            for size in self._sizes:
+                for batch in self._batch_buckets:
+                    t0 = time.perf_counter()
+                    self.programs[(size, batch)] = lower_forward(
+                        self.model_cfg, fwd_params, bwd_params, batch, size,
+                        serve_cfg.with_cycle,
+                    ).compile()
+                    self._event(
+                        "serve_compile", size=size, batch=batch,
+                        dtype=serve_cfg.dtype,
+                        with_cycle=serve_cfg.with_cycle,
+                        device=str(device) if device is not None else None,
+                        seconds=round(time.perf_counter() - t0, 3),
+                    )
         # The int8 tier: a parallel program set over the SAME grammar,
         # fed by the startup-quantized param tree. Kept in its own dict
         # so the base-tier contract (`self.programs`, one program per
@@ -268,19 +291,22 @@ class InferenceEngine:
             # tier's dtype.
             int8_cfg = dataclasses.replace(self.model_cfg,
                                            compute_dtype="float32")
-            self._fwd_params_int8 = quantize_params_int8(fwd_params)
-            for size in self._sizes:
-                for batch in self._batch_buckets:
-                    t0 = time.perf_counter()
-                    self.programs_int8[(size, batch)] = lower_forward(
-                        int8_cfg, self._fwd_params_int8, None, batch,
-                        size, False, quantized=True,
-                    ).compile()
-                    self._event(
-                        "serve_compile", size=size, batch=batch,
-                        dtype="int8", tier="int8", with_cycle=False,
-                        seconds=round(time.perf_counter() - t0, 3),
-                    )
+            with place():
+                self._fwd_params_int8 = quantize_params_int8(fwd_params)
+                for size in self._sizes:
+                    for batch in self._batch_buckets:
+                        t0 = time.perf_counter()
+                        self.programs_int8[(size, batch)] = lower_forward(
+                            int8_cfg, self._fwd_params_int8, None, batch,
+                            size, False, quantized=True,
+                        ).compile()
+                        self._event(
+                            "serve_compile", size=size, batch=batch,
+                            dtype="int8", tier="int8", with_cycle=False,
+                            device=(str(device) if device is not None
+                                    else None),
+                            seconds=round(time.perf_counter() - t0, 3),
+                        )
 
     def _event(self, kind: str, **fields) -> None:
         if self._logger is not None:

@@ -127,11 +127,28 @@ class FleetExecutor:
 
     def __init__(self, engine: InferenceEngine,
                  cfg: Optional[FleetConfig] = None, *, logger=None,
-                 injector=None):
+                 injector=None, engines=None):
         self.engine = engine
         self.cfg = cfg or FleetConfig()
         self._logger = logger
         self._injector = injector
+        # Per-device replica binding (ROADMAP item-2 leftover): with
+        # `engines` given, replica slot i runs engines[i % len(engines)]
+        # — each engine is compiled against (and its params committed
+        # to) a distinct local device, so N replicas genuinely occupy N
+        # chips instead of time-slicing device 0. `engine` stays the
+        # grammar/tier authority (and serves slots beyond the list).
+        # Every engine must speak the same bucket grammar: the
+        # dispatcher batches against ONE grammar, and a flush landing on
+        # a replica whose engine lacks the bucket would crash it.
+        self.engines = list(engines) if engines else [engine]
+        for i, eng in enumerate(self.engines):
+            if (set(eng.programs) != set(engine.programs)
+                    or eng.tiers != engine.tiers):
+                raise ValueError(
+                    f"engines[{i}] bucket grammar/tiers differ from the "
+                    f"primary engine — all fleet engines must be built "
+                    f"from the same ServeConfig")
         self._classes = class_map(self.cfg.classes)
         max_batch = (engine.max_batch if self.cfg.max_batch is None
                      else self.cfg.max_batch)
@@ -149,7 +166,8 @@ class FleetExecutor:
                                              logger=logger)
         self._free: "queue.Queue" = queue.Queue()
         self.replicas = [
-            ReplicaWorker(i, engine, on_free=self._free.put,
+            ReplicaWorker(i, self._engine_for_slot(i),
+                          on_free=self._free.put,
                           on_done=self._on_done, injector=injector)
             for i in range(self.cfg.n_replicas)
         ]
@@ -182,6 +200,12 @@ class FleetExecutor:
             target=self._monitor_loop, daemon=True,
             name="fleet-monitor")
         self._monitor.start()
+
+    def _engine_for_slot(self, slot: int) -> InferenceEngine:
+        """Round-robin slot -> engine binding. Stable across respawns:
+        a recovered slot rebinds to the SAME engine/device its crashed
+        predecessor ran on (the device is fine; the thread died)."""
+        return self.engines[slot % len(self.engines)]
 
     # -- submission --------------------------------------------------------
     def submit_raw(self, img: np.ndarray, klass: Optional[str] = None,
@@ -323,7 +347,8 @@ class FleetExecutor:
                 self._circuit_open[slot] = True
         else:
             self.replicas[slot] = ReplicaWorker(
-                replica.replica_id, self.engine, on_free=self._free.put,
+                replica.replica_id, self._engine_for_slot(slot),
+                on_free=self._free.put,
                 on_done=self._on_done, injector=self._injector)
             self._free.put(self.replicas[slot])
             respawned = True
@@ -408,6 +433,9 @@ class FleetExecutor:
             }
         snap.update({
             "n_replicas": len(self.replicas),
+            "replica_devices": [
+                str(getattr(self._engine_for_slot(i), "device", None))
+                for i in range(len(self.replicas))],
             "replicas_busy": busy,
             "admission": self.admission.stats(),
             "classes": per_class,

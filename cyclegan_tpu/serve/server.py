@@ -296,12 +296,28 @@ def main(argv: Optional[list] = None) -> None:
     if args.fleet > 0:
         from cyclegan_tpu.serve.fleet import FleetConfig, FleetExecutor
 
+        # Bind replicas round-robin to distinct local devices: one
+        # engine per device actually used (min(fleet, devices) — extra
+        # replicas share via round-robin). Each extra engine recompiles
+        # the program set for its device (warm cache makes that cheap)
+        # and commits its own param copy there; self-healing respawns
+        # rebind slot -> engine, so a recovered replica lands back on
+        # the device its predecessor owned.
+        devices = jax.local_devices()
+        engines = [engine]
+        for dev in devices[1:min(args.fleet, len(devices))]:
+            engines.append(InferenceEngine(
+                model_cfg, fwd_params, bwd_params,
+                serve_cfg=serve_cfg, logger=logger, device=dev))
+        if len(engines) > 1:
+            print(f"fleet replicas bound round-robin over "
+                  f"{len(engines)} local devices", flush=True)
         executor = FleetExecutor(
             engine,
             FleetConfig(n_replicas=args.fleet, capacity=args.capacity,
                         max_wait_ms=args.max_wait_ms,
                         default_class=args.default_class),
-            logger=logger)
+            logger=logger, engines=engines)
     else:
         executor = PipelinedExecutor(engine, max_wait_ms=args.max_wait_ms,
                                      logger=logger)

@@ -55,7 +55,7 @@ def _progress(it, total: int, desc: str, verbose: int):
 
 
 def _staged_batches(config: Config, data: CycleGANData, plan: MeshPlan,
-                    epoch: int, multi: bool):
+                    epoch: int, multi: bool, start_step: int = 0):
     """Yield dispatch-ready device batches: ("multi"|"accum"|"single",
     sharded arrays).
 
@@ -73,7 +73,8 @@ def _staged_batches(config: Config, data: CycleGANData, plan: MeshPlan,
     # double-buffering every batch) — the worker IS the background thread.
     host_prefetch = config.train.prefetch_batches == 0
     buf = []
-    for x, y, w in data.train_epoch(epoch, prefetch=host_prefetch):
+    for x, y, w in data.train_epoch(epoch, prefetch=host_prefetch,
+                                    start_step=start_step):
         if multi and k > 1:
             buf.append((x, y, w))
             if len(buf) == k:
@@ -113,6 +114,8 @@ def train_epoch(
     obs=None,
     health=None,
     injector=None,
+    breaker=None,
+    start_step: int = 0,
 ) -> CycleGANState:
     """One training pass (reference main.py:332-341). `tracer` is an
     optional utils.profiler.TraceCapture stepped once per train step.
@@ -139,6 +142,14 @@ def train_epoch(
     so per-device memory tracks the microbatch while the update sees the
     whole thing. One update per effective batch — exactly the
     big-batch update (tests/test_accum.py).
+
+    `breaker` (resil/elastic.MidEpochBreaker) is the mid-epoch
+    preemption poll: after every dispatch it is told how many pipeline
+    batches were consumed and asked whether to break out of the epoch —
+    a host-local flag read, no sync, no cost when None. `start_step`
+    (pipeline-yield units) resumes a preempted epoch mid-stream: the
+    data pipeline fast-forwards its deterministic permutation and this
+    loop runs only the remaining dispatches.
     """
     k = config.train.steps_per_dispatch
     accum = config.train.grad_accum
@@ -183,7 +194,8 @@ def train_epoch(
                 health.observe(got[0], steps=got[1])
 
     multi = multi_step_fn is not None and k > 1
-    staged = _staged_batches(config, data, plan, epoch, multi)
+    staged = _staged_batches(config, data, plan, epoch, multi,
+                             start_step=start_step)
     if injector is not None:
         # Fault-path only (the no-fault cost of --inject is the `is not
         # None` checks in this function): staged fetches gain the
@@ -203,9 +215,10 @@ def train_epoch(
         from cyclegan_tpu.data.prefetch import prefetch_iter
 
         staged = prefetch_iter(staged, depth)
+    remaining = max(0, data.train_steps - start_step)
     n_dispatch = (
-        data.train_steps // k + data.train_steps % k if multi
-        else data.train_steps
+        remaining // k + remaining % k if multi
+        else remaining
     )
     it = iter(_progress(staged, n_dispatch, "Train", config.train.verbose))
 
@@ -238,7 +251,17 @@ def train_epoch(
                     "step", advance=k if kind == "multi" else 1):
                 if fault.kind == "nan_grads":
                     xs, ys = steps_mod.poison_batch_for_fault(xs, ys)
-                elif fault.kind == "sigterm":
+                elif fault.kind in ("sigterm", "preempt"):
+                    if fault.kind == "preempt":
+                        # Full platform-preemption simulation: the
+                        # grace window is ENFORCED — a timer hard-exits
+                        # the process --preempt_deadline_s after the
+                        # notice, so an emergency save slower than the
+                        # budget visibly loses the race (exit 124).
+                        from cyclegan_tpu.resil import elastic
+
+                        elastic.arm_preempt_kill_timer(
+                            config.train.preempt_deadline_s)
                     os.kill(os.getpid(), signal.SIGTERM)
         if tracer is not None and depth > 0:
             tracer.step()
@@ -246,14 +269,25 @@ def train_epoch(
             state, metrics = multi_step_fn(state, xs, ys, ws)
             clock.dispatched(steps=k, kind="multi")
             append_metrics(metrics, steps=k)
+            batches = k
         elif kind == "accum":
             state, metrics = step_fn(state, xs, ys, ws)
             clock.dispatched(steps=1, pinned=accum, kind="accum")
             append_metrics(metrics, pinned=accum)
+            batches = 1
         else:
             state, metrics = step_fn(state, xs, ys, ws)
             clock.dispatched(kind="single")
             append_metrics(metrics)
+            batches = 1
+        if breaker is not None:
+            # Host-local preemption poll, once per dispatch: a SIGTERM
+            # that landed during this dispatch breaks the epoch HERE,
+            # leaving the remaining permutation untouched for resume.
+            # No device sync — reads a flag the signal handler set.
+            breaker.note(batches)
+            if breaker.should_break():
+                break
 
     t_drain = perf_counter()
     tail = jax.device_get(pending)  # sanctioned-fetch: end-of-epoch drain
@@ -271,6 +305,18 @@ def train_epoch(
                 append_dict(results, {key: v[i] for key, v in metrics.items()})
     for key, value in mean_dict(results).items():
         summary.scalar(key, value, step=epoch, training=True)
+    if obs is not None and results:
+        # Per-step loss series, in dispatch order (FIFO fetch + ordered
+        # drain/unroll above). Host copies the loop already fetched —
+        # zero added sync. This is the seam the elastic drill pins: a
+        # preempt-on-mesh-A + resume-on-mesh-B pair must reproduce the
+        # control run's series exactly across the save/restore boundary.
+        losses = {key: [float(v) for v in vals]
+                  for key, vals in results.items()
+                  if key.startswith("loss_")}
+        if losses:
+            obs.event("step_losses", epoch=epoch, start_step=start_step,
+                      n_steps=len(next(iter(losses.values()))), **losses)
     clock.finish()
     return state
 

@@ -241,6 +241,13 @@ class Checkpointer:
             "total_bytes": total,
             "files": files,
         }
+        # Elastic-recovery fields (resil/elastic.py): the writing mesh's
+        # topology + per-leaf sharding specs make the slot restorable on
+        # a DIFFERENT mesh; a mid_epoch record marks a step-granular
+        # emergency slot with its exact resume position.
+        for key in ("topology", "mid_epoch"):
+            if meta and key in meta:
+                record[key] = meta[key]
         path = self._manifest_path(slot)
         tmp = path + ".tmp"
         with open(tmp, "w") as f:

@@ -17,6 +17,7 @@ schedule identical across hosts.
 from __future__ import annotations
 
 import signal
+import time
 from types import FrameType
 from typing import Callable, Iterable, Optional
 
@@ -45,6 +46,7 @@ class PreemptionGuard:
         on_signal: Iterable[Callable[[], None]] = (),
     ):
         self._requested = False
+        self._requested_at: Optional[float] = None
         self._prev = {}
         self._callbacks = list(on_signal)
         if install:
@@ -57,6 +59,8 @@ class PreemptionGuard:
 
     def _handle(self, signum: int, frame: Optional[FrameType]) -> None:
         self._requested = True
+        if self._requested_at is None:
+            self._requested_at = time.monotonic()
         for fn in self._callbacks:
             try:
                 fn()
@@ -66,10 +70,19 @@ class PreemptionGuard:
     def request_stop(self) -> None:
         """Programmatic stop request (used by tests and host callers)."""
         self._requested = True
+        if self._requested_at is None:
+            self._requested_at = time.monotonic()
 
     @property
     def requested_locally(self) -> bool:
         return self._requested
+
+    @property
+    def requested_at(self) -> Optional[float]:
+        """time.monotonic() of the FIRST stop request — the start of the
+        platform's grace window. Deadline accounting (elastic emergency
+        saves) budgets from here, not from when the loop noticed."""
+        return self._requested_at
 
     def should_stop(self) -> bool:
         """Cross-host agreement: True iff any host was signalled. Call at

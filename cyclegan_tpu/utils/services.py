@@ -98,6 +98,34 @@ class EpochServices:
                     self._pending -= 1
                     self._cv.notify_all()
 
+    def drop_pending(self, should_drop: Callable[[str], bool]) -> int:
+        """Discard QUEUED (not yet running) jobs whose name matches the
+        predicate; keep the rest in submission order. Used by the
+        preemption emergency-save path to shed cosmetic work (cycle
+        panels, FID) so the grace-window budget reaches the checkpoint
+        commit. Returns the number of jobs dropped. The in-flight job,
+        if any, is never touched."""
+        kept, dropped = [], 0
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:  # worker shutdown sentinel — must survive
+                kept.append(item)
+                continue
+            if should_drop(item[0]):
+                dropped += 1
+            else:
+                kept.append(item)
+        for item in kept:
+            self._q.put(item)
+        if dropped:
+            with self._cv:
+                self._pending -= dropped
+                self._cv.notify_all()
+        return dropped
+
     def barrier(self, timeout: Optional[float] = None) -> bool:
         """Wait until all submitted jobs completed. Returns False on
         timeout (jobs still pending), True otherwise."""

@@ -107,6 +107,7 @@ def main(args: argparse.Namespace) -> None:
             grad_accum=args.grad_accum,
             grad_impl=args.grad_impl,
             ckpt_keep=args.ckpt_keep,
+            preempt_deadline_s=args.preempt_deadline_s,
         ),
         obs=ObsConfig(
             enabled=not args.no_obs,
@@ -142,6 +143,19 @@ def main(args: argparse.Namespace) -> None:
     # pipeline yields effective batches, losses scale by the effective
     # size, and the accum step sees [A, micro] stacks (loop.py).
     plan = make_mesh_plan(config.parallel)
+    # Elastic preflight (resil/elastic.py): when the newest checkpoint
+    # was written on a DIFFERENT mesh shape, rewrite batch_size x
+    # grad_accum so the global batch — and with it the data pipeline's
+    # step grid and the optimization trajectory — is preserved exactly;
+    # refuse with CLI guidance when it is unreachable. Must run before
+    # the pipeline and step programs are built from these numbers.
+    from cyclegan_tpu.resil import elastic
+
+    try:
+        config, elastic_info = elastic.preflight_elastic(
+            config, plan, echo=print if primary else None)
+    except elastic.ElasticTopologyError as e:
+        raise SystemExit(str(e))
     global_batch_size = (
         plan.n_data * config.train.batch_size * config.train.grad_accum
     )
@@ -171,6 +185,10 @@ def main(args: argparse.Namespace) -> None:
     from cyclegan_tpu.obs import HealthFault, make_health_monitor, make_telemetry
 
     tele = make_telemetry(config.obs, config.train.output_dir, primary)
+    if elastic_info is not None and elastic_info.get("changed"):
+        # The preflight ran before the stream existed; record the
+        # recomputed decomposition now so obs_report/run_compare see it.
+        tele.event("elastic_preflight", **elastic_info)
     # Model-health flight recorder (obs/health.py): in-step numerics
     # stats ride the train-step metrics dict; this monitor runs the
     # host-side detectors on the fetched rows. Every host gets one
@@ -219,11 +237,24 @@ def main(args: argparse.Namespace) -> None:
     # (reference main.py:383 kept a single slot; see utils/checkpoint.py).
     ckpt = Checkpointer(config.train.output_dir, keep=config.train.ckpt_keep,
                         telemetry=tele, injector=injector)
-    state, start_epoch, resumed = ckpt.restore_if_exists(
-        state, partial=args.expect_partial
+    resume = elastic.elastic_restore_if_exists(
+        ckpt, state, plan, config, telemetry=tele,
+        partial=args.expect_partial, echo=print if primary else None,
     )
+    state, start_epoch, resumed = resume.state, resume.start_epoch, resume.resumed
+    resume_step = resume.resume_step
+    if resume.data_seed is not None:
+        # The emergency slot recorded the EFFECTIVE data seed (rollbacks
+        # may have reseeded the original run) — replay its exact stream.
+        data.restore_seed(resume.data_seed)
+    if resume_step >= data.train_steps:
+        # The preempted epoch had actually finished dispatching when the
+        # emergency save landed — nothing mid-epoch left to run.
+        start_epoch += 1
+        resume_step = 0
     if resumed and primary:
-        print(f"Resumed from {ckpt.slot} at epoch {start_epoch}")
+        print(f"Resumed from {ckpt.slot} at epoch {start_epoch}"
+              + (f", step {resume_step}" if resume_step else ""))
 
     multi_step = None
     if config.train.grad_accum > 1:
@@ -310,13 +341,17 @@ def main(args: argparse.Namespace) -> None:
         while epoch < config.train.epochs:
             if primary:
                 print(f"Epoch {epoch + 1:03d}/{config.train.epochs:03d}")
+            # A mid-epoch resume position applies to the FIRST epoch
+            # only — consumed here whether or not the epoch succeeds
+            # (a rollback rewind replays whole epochs).
+            this_start, resume_step = resume_step, 0
             try:
                 state, preempted = _run_one_epoch(
                     args, config, data, plan, train_step, test_step,
                     multi_step, cycle_step, state, summary, epoch, tracer,
                     tele, health, injector, guard, fid_eval, run_fid,
                     async_fid, ckpt, services, primary, flops_per_image,
-                    peak_tflops, plot_cycle,
+                    peak_tflops, plot_cycle, start_step=this_start,
                 )
             except HealthFault as fault:
                 if rollback is None:
@@ -376,20 +411,43 @@ def _run_one_epoch(args, config, data, plan, train_step, test_step,
                    multi_step, cycle_step, state, summary, epoch, tracer,
                    tele, health, injector, guard, fid_eval, run_fid,
                    async_fid, ckpt, services, primary, flops_per_image,
-                   peak_tflops, plot_cycle):
+                   peak_tflops, plot_cycle, start_step=0):
     """One full epoch body (train + test + rollups + FID + checkpoint),
     split out of main() so the rollback policy can wrap exactly this
-    unit in its HealthFault handler. Returns (state, preempted)."""
+    unit in its HealthFault handler. Returns (state, preempted).
+
+    `start_step` resumes a preempted epoch mid-permutation (elastic
+    restore). With --preempt_deadline_s > 0 (single-process only — the
+    per-dispatch poll is host-local) a SIGTERM breaks the train pass at
+    the next dispatch and the emergency save replaces the whole
+    test/FID/boundary-save tail: the grace budget belongs to the
+    step-granular checkpoint."""
     from time import time
 
+    from cyclegan_tpu.resil import elastic
     from cyclegan_tpu.train import loop
+
+    breaker = None
+    if config.train.preempt_deadline_s > 0 and jax.process_count() == 1:
+        breaker = elastic.MidEpochBreaker(guard)
 
     start = time()
     state = loop.train_epoch(
         config, data, plan, train_step, state, summary, epoch,
         tracer=tracer, multi_step_fn=multi_step, obs=tele,
-        health=health, injector=injector,
+        health=health, injector=injector, breaker=breaker,
+        start_step=start_step,
     )
+    if breaker is not None and guard.requested_locally:
+        # Mid-epoch emergency save: persist the exact dispatch position
+        # (even when the pass happened to finish — the restore clamp
+        # rolls a completed epoch forward). Skips test/FID entirely.
+        elastic.emergency_save(
+            ckpt, state, config, plan, data, epoch,
+            start_step + breaker.batches_done, guard,
+            services=services, telemetry=tele,
+            echo=print if primary else None)
+        return state, True
     train_elapse = time() - start
     results = loop.test_epoch(
         config, data, plan, test_step, state, summary, epoch,
@@ -453,7 +511,6 @@ def _run_one_epoch(args, config, data, plan, train_step, test_step,
             # interleave with — not read from under — it.
             import types
 
-            import jax
             import jax.numpy as jnp
 
             snap = types.SimpleNamespace(
@@ -470,7 +527,11 @@ def _run_one_epoch(args, config, data, plan, train_step, test_step,
         # Async save: Orbax fetches the state before returning
         # (safe against the next step's donation); commit barrier
         # + sidecar land on the services thread.
-        ckpt.save(state, epoch, meta=config.model_meta(),
+        # Slots are topology-aware (resil/elastic.py): the meta carries
+        # the writing mesh + batch decomposition + per-leaf sharding
+        # specs, so this save restores onto a different mesh.
+        ckpt.save(state, epoch,
+                  meta=elastic.save_meta(config, plan, state=state),
                   services=services)
         if primary:
             print(f"saving checkpoint to {ckpt.slot} "
@@ -690,10 +751,25 @@ if __name__ == "__main__":
                              "entries, e.g. 'nan_grads@step=6' or "
                              "'ckpt_io_error@epoch=0x2,sigterm@step=40'. "
                              "Kinds: nan_grads@step, sigterm@step, "
+                             "preempt@step (SIGTERM + hard kill timer "
+                             "after --preempt_deadline_s), "
                              "data_stall@step, ckpt_io_error@epoch, "
                              "replica_crash@flush (serving). All "
                              "injection is host-side — the jitted step "
                              "is never modified")
+    parser.add_argument("--preempt_deadline_s", default=0.0, type=float,
+                        metavar="S",
+                        help="preemption grace budget (resil/elastic.py): "
+                             "0 = finish the in-flight epoch before the "
+                             "SIGTERM checkpoint (historical behavior); "
+                             "S > 0 polls once per dispatch and writes a "
+                             "step-granular emergency slot within S "
+                             "seconds of the signal — resume fast-forwards "
+                             "the data permutation to the exact sample "
+                             "position, losing at most the in-flight "
+                             "dispatches. Size to the platform grace "
+                             "window minus a safety margin "
+                             "(single-process runs only)")
     parser.add_argument("--health_divergence_multiple", default=4.0,
                         type=float, metavar="X",
                         help="warn when loss_G/total or loss_F/total "

@@ -106,6 +106,10 @@ def test_convert_cli_roundtrip(tmp_path):
         sys.executable, "main.py", "--output_dir", out, "--batch_size", "2",
         "--verbose", "0", "--data_source", "synthetic", "--image_size", "32",
         "--synthetic_train_size", "4", "--synthetic_test_size", "2",
+        # Tiny architecture: the roundtrip exercises layout conversion
+        # and resume plumbing, which are width-independent — full-size
+        # compiles dominated the whole tier-1 budget on small hosts.
+        "--filters", "4", "--residual_blocks", "1",
     ]
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     r = subprocess.run(base + ["--epochs", "1"], capture_output=True, text=True,

@@ -618,6 +618,55 @@ def test_fleet_recovers_from_injected_replica_crash():
     assert inj.pending() == []
 
 
+def test_respawned_replica_rebinds_to_its_slots_engine():
+    """Per-device fleet: with an explicit `engines` list, slot i runs
+    engines[i % len(engines)] — and a respawn after a crash rebinds the
+    slot to the SAME engine (same device), not to whichever engine is
+    convenient. The device is fine when a replica thread dies; moving
+    the slot to another chip would silently halve the fleet."""
+    from cyclegan_tpu.resil import FaultInjector
+
+    eng_a = FakeEngine(buckets=(1,))
+    eng_b = FakeEngine(buckets=(1,))
+    eng_a.device, eng_b.device = "cpu:0", "cpu:1"
+    rec = _Recorder()
+    inj = FaultInjector.from_spec("replica_crash@flush=1", telemetry=rec)
+    fleet = FleetExecutor(
+        eng_a,
+        FleetConfig(n_replicas=3, max_batch=1, max_wait_ms=0.0,
+                    health_poll_s=0.01),
+        logger=rec, injector=inj, engines=[eng_a, eng_b])
+    # Round-robin binding is visible in stats before any traffic.
+    assert fleet.stats()["replica_devices"] == ["cpu:0", "cpu:1", "cpu:0"]
+    before = list(fleet.replicas)
+    img = np.zeros((32, 32, 3), np.float32)
+    futs = [fleet.submit(img, klass="batch") for _ in range(6)]
+    for f in futs:
+        assert f.result(timeout=30)["fake"].shape == (32, 32, 3)
+    assert _wait_for(lambda: "fleet_recovery" in rec.kinds())
+    (recov,) = rec.of("fleet_recovery")
+    assert recov["respawned"] is True
+    slot = recov["replica"]
+    # New worker object in the crashed slot, same engine identity.
+    assert fleet.replicas[slot] is not before[slot]
+    for i, worker in enumerate(fleet.replicas):
+        assert worker.engine is fleet.engines[i % 2]
+    assert fleet.stats()["replica_devices"] == ["cpu:0", "cpu:1", "cpu:0"]
+    summary = fleet.close()
+    assert summary["unjoined_replicas"] == []
+
+
+def test_fleet_engines_must_share_bucket_grammar():
+    """A replica whose engine lacks a bucket the dispatcher batches
+    against would crash on its first flush — reject the mismatched
+    engines list at construction instead."""
+    eng = FakeEngine(buckets=(1,))
+    other = FakeEngine(buckets=(1, 4))
+    with pytest.raises(ValueError, match="bucket grammar"):
+        FleetExecutor(eng, FleetConfig(n_replicas=2),
+                      engines=[eng, other])
+
+
 def test_crash_loop_burns_attempts_then_fails_future_typed():
     """A poison batch that kills its replica every time must not crash-
     loop forever: after max_request_attempts dispatches the request

@@ -349,14 +349,18 @@ def test_rollback_validates_budget():
 
 def test_chaos_drill_fast_passes_end_to_end(tmp_path):
     """The acceptance drill: `python tools/chaos_drill.py --fast` on CPU
-    must pass all three scripted drills — NaN rollback through the
-    verified ring (a real main.py run), replica-crash self-healing, and
-    retried checkpoint I/O — and emit one parseable JSON line."""
+    must pass the three single-topology drills — NaN rollback through
+    the verified ring (a real main.py run), replica-crash self-healing,
+    and retried checkpoint I/O — and emit one parseable JSON line. The
+    fourth drill (elastic_resume, three main.py runs) is budgeted
+    separately in tests/test_elastic.py so each subprocess stays inside
+    its own timeout."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     env.pop("XLA_FLAGS", None)
     r = subprocess.run(
         [sys.executable, "tools/chaos_drill.py", "--fast",
-         "--workdir", str(tmp_path)],
+         "--only", "nan_rollback", "--only", "fleet_crash",
+         "--only", "ckpt_retry", "--workdir", str(tmp_path)],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=580)
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     report = json.loads(r.stdout.strip().splitlines()[-1])

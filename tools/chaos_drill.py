@@ -4,7 +4,7 @@
     python tools/chaos_drill.py             # full drill set
 
 Fault injection (``--inject``) makes failure deterministic; this tool
-makes RECOVERY an asserted invariant instead of a hope. Three drills,
+makes RECOVERY an asserted invariant instead of a hope. Four drills,
 one per recovery subsystem:
 
 - **nan_rollback** — a real `python main.py` training run on synthetic
@@ -25,6 +25,16 @@ one per recovery subsystem:
   the bounded-backoff retry (``retry`` events in the stream), the slot
   must verify against its sha256 manifest, and restore must round-trip
   the state bit-exactly while the ring prunes to ``keep`` slots.
+- **elastic_resume** — the cross-mesh equivalence drill: a run on an
+  8-way data mesh is preempted MID-epoch (``preempt@step=K`` +
+  ``--preempt_deadline_s``), must land its emergency save inside the
+  deadline budget, then resume in the same output dir on a different
+  4x2 data-by-spatial mesh. The resumed run's per-step losses must
+  match an uninterrupted control run across the preemption seam
+  (<= 1e-5 elementwise, f32), with zero samples skipped or repeated
+  and final test metrics equal to the control's. The full set adds the
+  deadline-overrun edge: an impossibly small budget must trip the
+  armed kill timer (exit 124) rather than hang in the save.
 
 Output: one JSON line on stdout
 (``{"metric": "cyclegan_chaos_drill", ..., "pass": bool}``), human
@@ -82,27 +92,34 @@ class _Recorder:
 
 # --------------------------------------------------------------- drill (a)
 
-def _main_argv(out: str, *, epochs: int, extra) -> list:
+def _main_argv(out: str, *, epochs: int, extra, image: int = 32,
+               filters: int = 8, batch: int = 2, train: int = 8,
+               test: int = 2) -> list:
     return [
         sys.executable, "main.py",
         "--output_dir", out,
-        "--data_source", "synthetic", "--image_size", "32",
-        "--filters", "8", "--residual_blocks", "1",
-        "--epochs", str(epochs), "--batch_size", "2",
-        "--synthetic_train_size", "8", "--synthetic_test_size", "2",
+        "--data_source", "synthetic", "--image_size", str(image),
+        "--filters", str(filters), "--residual_blocks", "1",
+        "--epochs", str(epochs), "--batch_size", str(batch),
+        "--synthetic_train_size", str(train),
+        "--synthetic_test_size", str(test),
         "--verbose", "0",
     ] + list(extra)
 
 
-def _run_main(out: str, *, epochs: int, extra, timeout: float):
+def _run_main(out: str, *, epochs: int, extra, timeout: float,
+              env_extra=None, **shape):
     env = dict(os.environ, PYTHONPATH=REPO)
     # The drill harness may run under the test suite's virtual-device
-    # XLA_FLAGS; the child is a plain single-host run.
+    # XLA_FLAGS; the child is a plain single-host run unless the drill
+    # pins its own topology via env_extra (applied after the pop).
     env.pop("XLA_FLAGS", None)
+    if env_extra:
+        env.update(env_extra)
     os.makedirs(out, exist_ok=True)
     return subprocess.run(
-        _main_argv(out, epochs=epochs, extra=extra), cwd=REPO, env=env,
-        capture_output=True, text=True, timeout=timeout)
+        _main_argv(out, epochs=epochs, extra=extra, **shape), cwd=REPO,
+        env=env, capture_output=True, text=True, timeout=timeout)
 
 
 def _read_events(out: str) -> list:
@@ -331,6 +348,153 @@ def drill_ckpt_retry(workdir: str) -> dict:
     }
 
 
+# --------------------------------------------------------------- drill (d)
+
+# Fixed topologies for the cross-mesh drill: preempt on an 8-way data
+# mesh, resume on 4 data x 2 spatial. Both run on 8 virtual CPU
+# devices so the drill is hardware-independent.
+_ELASTIC_ENV = {"JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+# image 16 / filters 4 / batch 1 / 32 train images on dp8 -> global
+# batch 8, 4 steps per epoch; the dp4xsp2 resume recomputes the
+# per-shard batch to 2 so the global batch (and data order) is
+# unchanged.
+_ELASTIC_SHAPE = dict(image=16, filters=4, batch=1, train=32, test=4)
+
+
+def _losses_of(events, epoch):
+    return [e for e in events
+            if e.get("event") == "step_losses" and e.get("epoch") == epoch]
+
+
+def drill_elastic_resume(workdir: str, fast: bool) -> dict:
+    """Mid-epoch preempt on mesh A, resume on mesh B: per-step losses
+    must match the uninterrupted control across the seam, no sample
+    skipped or repeated, emergency save inside the deadline budget."""
+    checks = {}
+    tol = 1e-5
+    common = ["--fid_every", "0"]
+    out_ctl = os.path.join(workdir, "elastic_ctl")
+    out_run = os.path.join(workdir, "elastic_run")
+
+    # Control: uninterrupted 2-epoch run on the 8-way data mesh.
+    rc = _run_main(out_ctl, epochs=2, timeout=900.0, extra=common,
+                   env_extra=_ELASTIC_ENV, **_ELASTIC_SHAPE)
+    checks["control_exit_0"] = rc.returncode == 0
+    ctl_evs = _read_events(out_ctl)
+
+    # Run 1: identical config, SIGTERM injected at dispatch 5 (epoch 1,
+    # step 1). The dispatch in flight completes, the breaker latches,
+    # and the emergency save lands at epoch 1 step 2 with the data seed
+    # in the slot manifest.
+    r1 = _run_main(out_run, epochs=2, timeout=900.0,
+                   extra=common + ["--inject", "preempt@step=5",
+                                   "--preempt_deadline_s", "30"],
+                   env_extra=_ELASTIC_ENV, **_ELASTIC_SHAPE)
+    evs1 = _read_events(out_run)
+    checks["preempt_exit_0"] = r1.returncode == 0
+    checks["fault_injected_preempt"] = any(
+        e.get("event") == "fault_injected" and e.get("kind") == "preempt"
+        for e in evs1)
+    ems = [e for e in evs1 if e.get("event") == "emergency_save"]
+    checks["emergency_save_committed"] = any(
+        e.get("committed") for e in ems)
+    checks["save_within_deadline"] = bool(ems) and all(
+        e.get("margin_s", -1.0) >= 0.0 for e in ems)
+    checks["status_preempted"] = bool(evs1) and \
+        evs1[-1].get("event") == "end" and \
+        evs1[-1].get("status") == "preempted"
+
+    # Run 2: same output dir, different topology (4 data x 2 spatial).
+    # Preflight recomputes the per-shard batch, restore reshards every
+    # leaf, and the data pipeline fast-forwards to the saved position.
+    r2 = _run_main(out_run, epochs=2, timeout=900.0,
+                   extra=common + ["--spatial_parallelism", "2"],
+                   env_extra=_ELASTIC_ENV, **_ELASTIC_SHAPE)
+    all_evs = _read_events(out_run)
+    evs2 = all_evs[len(evs1):]  # telemetry.jsonl appends across runs
+    checks["resume_exit_0"] = r2.returncode == 0
+    resh = [e for e in evs2 if e.get("event") == "elastic_reshard"]
+    checks["resharded"] = bool(resh) and resh[-1].get("n_leaves", 0) > 0
+    checks["status_completed"] = bool(evs2) and \
+        evs2[-1].get("event") == "end" and \
+        evs2[-1].get("status") == "completed"
+    checks["no_health_faults"] = not any(
+        e.get("event") == "health_fault" for e in evs2)
+
+    # The equivalence seam: control epoch-1 losses [0:k) must match run
+    # 1's partial epoch, [k:] must match run 2's resumed tail — same
+    # steps, same samples, same numbers.
+    ctl_sl = _losses_of(ctl_evs, 1)
+    pre_sl = _losses_of(evs1, 1)
+    post_sl = _losses_of(evs2, 1)
+    seam_maxdiff = None
+    if ctl_sl and pre_sl and post_sl:
+        ctl_e, pre_e, post_e = ctl_sl[0], pre_sl[0], post_sl[0]
+        k = int(pre_e["n_steps"])
+        checks["resume_at_seam"] = (
+            int(post_e["start_step"]) == k
+            and k + int(post_e["n_steps"]) == int(ctl_e["n_steps"]))
+        checks["save_step_is_seam"] = bool(ems) and \
+            int(ems[-1].get("step", -1)) == k
+        keys = [key for key in ctl_e if key.startswith("loss_")]
+        diffs = []
+        for key in keys:
+            diffs += [abs(a - b)
+                      for a, b in zip(ctl_e[key][:k], pre_e[key])]
+            diffs += [abs(a - b)
+                      for a, b in zip(ctl_e[key][k:], post_e[key])]
+        seam_maxdiff = max(diffs) if diffs else None
+        checks["losses_match_control"] = bool(diffs) and seam_maxdiff <= tol
+    else:
+        checks["resume_at_seam"] = checks["save_step_is_seam"] = False
+        checks["losses_match_control"] = False
+
+    # End state equivalence: final-epoch test metrics. These aggregate
+    # over the whole test set, and a 4x2 mesh sums partial reductions in
+    # a different order than 8x1, so the contract is isclose semantics
+    # (rtol+atol), not the per-step absolute bound.
+    def _final_metrics(events):
+        eps = [e for e in events
+               if e.get("event") == "epoch" and e.get("epoch") == 1]
+        return eps[-1].get("test_metrics") if eps else None
+
+    cm, rm = _final_metrics(ctl_evs), _final_metrics(evs2)
+    if isinstance(cm, dict) and isinstance(rm, dict):
+        checks["final_metrics_match"] = set(cm) == set(rm) and all(
+            abs(float(cm[key]) - float(rm[key]))
+            <= tol + tol * abs(float(cm[key]))
+            for key in cm)
+    else:
+        checks["final_metrics_match"] = False
+
+    detail = {
+        "checks": checks,
+        "returncodes": [rc.returncode, r1.returncode, r2.returncode],
+        "seam_maxdiff": seam_maxdiff,
+        "emergency": [{k: v for k, v in e.items() if k != "t"}
+                      for e in ems],
+        "resharded_leaves": resh[-1].get("n_leaves") if resh else None,
+    }
+    if not all(checks.values()):
+        for name, r in (("control", rc), ("preempt", r1), ("resume", r2)):
+            detail[f"{name}_stderr_tail"] = r.stderr[-1500:]
+
+    if not fast:
+        # Deadline-overrun edge: a 20ms budget cannot fit the save, so
+        # the kill timer armed at SIGTERM must fire os._exit(124)
+        # instead of letting the run overstay its preemption notice.
+        out_kill = os.path.join(workdir, "elastic_overrun")
+        rk = _run_main(out_kill, epochs=2, timeout=900.0,
+                       extra=common + ["--inject", "preempt@step=5",
+                                       "--preempt_deadline_s", "0.02"],
+                       env_extra=_ELASTIC_ENV, **_ELASTIC_SHAPE)
+        checks["overrun_killed_124"] = rk.returncode == 124
+        detail["overrun_returncode"] = rk.returncode
+
+    return {"pass": all(checks.values()), "detail": detail}
+
+
 # ------------------------------------------------------------------ driver
 
 def run_drills(workdir: str, fast: bool, only=None) -> dict:
@@ -342,6 +506,7 @@ def run_drills(workdir: str, fast: bool, only=None) -> dict:
         ("nan_rollback", lambda: drill_nan_rollback(workdir, fast)),
         ("fleet_crash", lambda: drill_fleet_crash(12 if fast else 24)),
         ("ckpt_retry", lambda: drill_ckpt_retry(workdir)),
+        ("elastic_resume", lambda: drill_elastic_resume(workdir, fast)),
     ]
     for name, fn in plan:
         if only and name not in only:
@@ -376,7 +541,8 @@ def main(argv=None) -> int:
                    help="tier-1 budget: shorter training run, smaller "
                         "fleet load, skip the rollback-budget edge case")
     p.add_argument("--only", action="append", default=None,
-                   choices=["nan_rollback", "fleet_crash", "ckpt_retry"],
+                   choices=["nan_rollback", "fleet_crash", "ckpt_retry",
+                            "elastic_resume"],
                    help="run a subset (repeatable)")
     p.add_argument("--workdir", default=None,
                    help="scratch dir (default: a fresh temp dir)")

@@ -54,6 +54,12 @@ HOT_PATH_FILES: List[Tuple[str, bool]] = [
     # path) build traced-only code; any host fetch here would run once
     # per step inside the dispatch chain. Zero sanctioned sites.
     ("cyclegan_tpu/train/steps.py", False),
+    # Elastic recovery: the module's ONE sanctioned site class is the
+    # restore-time gather in reshard_to_plan (before any dispatch
+    # exists); the breaker/emergency-save paths that run DURING the
+    # loop must stay fetch-free. Overrides the resil/ directory default
+    # below (explicit file entries win over directory expansion).
+    ("cyclegan_tpu/resil/elastic.py", True),
 ]
 
 # Directories whose EVERY .py file is hot-path. Scanned as a directory
@@ -78,28 +84,40 @@ HOT_PATH_DIRS: List[Tuple[str, bool]] = [
     ("cyclegan_tpu/ops/pallas", False),
     ("cyclegan_tpu/serve", True),
     ("cyclegan_tpu/serve/fleet", True),
-    # resil (no sanctioned sites): fault injection, retry, and rollback
-    # are pure host-side orchestration at dispatch/IO boundaries — a
-    # device sync here would put a stall INSIDE the recovery machinery
-    # that exists to keep the loop async under failure.
+    # resil (no sanctioned sites by default): fault injection, retry,
+    # and rollback are pure host-side orchestration at dispatch/IO
+    # boundaries — a device sync here would put a stall INSIDE the
+    # recovery machinery that exists to keep the loop async under
+    # failure. elastic.py alone carries an explicit file entry above
+    # (one sanctioned restore-time gather).
     ("cyclegan_tpu/resil", False),
 ]
 
 
 def hot_path_entries(repo: str = REPO) -> List[Tuple[str, bool]]:
-    """The static file list plus every .py under the hot-path dirs. A
-    missing directory is reported as a missing file entry (the check
-    must fail loudly, not silently shrink)."""
-    entries = list(HOT_PATH_FILES)
+    """The static file list plus every .py under the hot-path dirs,
+    deduplicated with explicit HOT_PATH_FILES entries taking precedence
+    over directory expansion (a file may need a different sanction
+    policy than its directory's default). A missing directory is
+    reported as a missing file entry (the check must fail loudly, not
+    silently shrink)."""
+    policy = {rel: allow for rel, allow in HOT_PATH_FILES}
+    order = [rel for rel, _ in HOT_PATH_FILES]
     for rel, allow in HOT_PATH_DIRS:
         d = os.path.join(repo, rel)
         if not os.path.isdir(d):
-            entries.append((rel, allow))
+            if rel not in policy:
+                policy[rel] = allow
+                order.append(rel)
             continue
         for name in sorted(os.listdir(d)):
-            if name.endswith(".py"):
-                entries.append((os.path.join(rel, name), allow))
-    return entries
+            if not name.endswith(".py"):
+                continue
+            sub = os.path.join(rel, name)
+            if sub not in policy:
+                policy[sub] = allow
+                order.append(sub)
+    return [(rel, policy[rel]) for rel in order]
 
 
 def _code_lines(source: str) -> dict:

@@ -224,7 +224,9 @@ def build_queue(mode: str, round_tag: str = ROUND_TAG) -> list:
                        os.path.join(LOG_DIR_REL, "trace_run", "traces"))]),
         # Chaos drill on chip (resil acceptance): the same scripted
         # fault drills tier-1 runs on CPU — NaN rollback through the
-        # verified ring, replica-crash self-healing, retried ckpt I/O —
+        # verified ring, replica-crash self-healing, retried ckpt I/O,
+        # and the elastic preempt/resume drill (full set here, including
+        # the deadline-overrun kill edge tier-1 skips in --fast mode) —
         # executed against the real accelerator path. One JSON line,
         # exit nonzero if any recovery invariant fails.
         Step("chaos_drill", [py, "tools/chaos_drill.py"], 3600.0,

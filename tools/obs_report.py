@@ -86,6 +86,12 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
         "ckpt_fallbacks": [],    # restore skipped a corrupt ring slot
         "fleet_downs": [],       # fleet_replica_down detections
         "fleet_recoveries": [],  # respawn/re-enqueue outcomes
+        # Elastic topology recovery (resil/elastic.py): startup batch
+        # re-decomposition, cross-mesh reshards, mid-epoch preemption
+        # saves with their deadline margins.
+        "elastic_preflights": [],
+        "elastic_reshards": [],
+        "emergency_saves": [],
         "end": None,
     }
     for ev in events:
@@ -150,6 +156,12 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             report["fleet_downs"].append(ev)
         elif kind == "fleet_recovery":
             report["fleet_recoveries"].append(ev)
+        elif kind == "elastic_preflight":
+            report["elastic_preflights"].append(ev)
+        elif kind == "elastic_reshard":
+            report["elastic_reshards"].append(ev)
+        elif kind == "emergency_save":
+            report["emergency_saves"].append(ev)
         elif kind == "end":
             report["end"] = ev
         # unknown events: ignored by design
@@ -483,6 +495,36 @@ def render(report: dict) -> str:
               f"respawned={ev.get('respawned', '?')} "
               f"requeued={ev.get('requeued', 0)} failed={ev.get('failed', 0)}"
               + ("  CIRCUIT OPEN" if ev.get("circuit_open") else ""))
+
+    # Elastic recovery: topology changes survived and mid-epoch saves
+    # landed. A multi-run stream (preempt + resume appending to the same
+    # file) shows the whole preemption story in one report.
+    if (report["elastic_preflights"] or report["elastic_reshards"]
+            or report["emergency_saves"]):
+        w("-- elastic recovery --")
+        for ev in report["elastic_preflights"]:
+            saved = ev.get("saved") or {}
+            w(f"preflight: saved topology "
+              f"{saved.get('n_data', '?')}x{saved.get('n_spatial', '?')} "
+              f"(global batch {saved.get('global_batch_size', '?')}) -> "
+              f"batch_size {ev.get('old_batch_size', '?')}->"
+              f"{ev.get('batch_size', '?')}, grad_accum "
+              f"{ev.get('old_grad_accum', '?')}->{ev.get('grad_accum', '?')}")
+        for ev in report["elastic_reshards"]:
+            src = ev.get("from_topology") or {}
+            dst = ev.get("to_topology") or {}
+            w(f"RESHARD e{ev.get('epoch', '?')}: {ev.get('n_leaves', '?')} "
+              f"leaves {src.get('n_data', '?')}x{src.get('n_spatial', '?')} "
+              f"-> {dst.get('n_data', '?')}x{dst.get('n_spatial', '?')}")
+        for ev in report["emergency_saves"]:
+            w(f"EMERGENCY SAVE e{ev.get('epoch', '?')} "
+              f"step {ev.get('step', '?')}: "
+              f"{_fmt(ev.get('elapsed_s'), '.2f')}s of "
+              f"{_fmt(ev.get('deadline_s'), '.2f')}s budget "
+              f"(margin {_fmt(ev.get('margin_s'), '.2f')}s"
+              + (f", shed {ev['shed_jobs']} job(s)"
+                 if ev.get("shed_jobs") else "")
+              + f"), committed={ev.get('committed', '?')}")
 
     if report["stalls"]:
         w(f"-- stalls: {len(report['stalls'])} --")

@@ -21,6 +21,14 @@ Axes for a stream pair (each gated by its own threshold flag):
   grad norms   per-network max-envelope over `health` events
   anomalies    `health_fault` count (plus watchdog/loop stalls, reported
                but not gated — they attribute speed, not health)
+  elastic      engages when the candidate resharded or emergency-saved
+               (resil/elastic.py): every emergency save must have
+               committed inside its deadline, and per-epoch `step_losses`
+               trajectories must match the base elementwise within
+               --max_elastic_loss_diff — a resumed run that diverges
+               from its uninterrupted base after the preemption seam
+               FAILS, as does one whose step counts drifted (a skipped
+               or repeated sample)
 
 For bench records the axis is per-config images/sec from the `all`
 sweep dict (intersection of configs) plus the headline value.
@@ -192,6 +200,35 @@ def stream_profile(events: List[dict], skipped: int = 0, name: str = "?") -> dic
     n_fleet_recoveries = sum(1 for e in events
                              if e.get("event") == "fleet_recovery")
     n_retries = sum(1 for e in events if e.get("event") == "retry")
+    # Elastic profile: reshard/emergency-save counts plus the per-epoch
+    # step-loss trajectories. A preempted-and-resumed stream carries the
+    # seam epoch as SEGMENTS (one step_losses event per start_step);
+    # concatenating them in start order rebuilds the full epoch so it
+    # compares 1:1 against an uninterrupted base.
+    n_reshards = sum(1 for e in events
+                     if e.get("event") == "elastic_reshard")
+    saves = [e for e in events if e.get("event") == "emergency_save"]
+    n_uncommitted = sum(
+        1 for e in saves
+        if not e.get("committed")
+        or (_float(e.get("margin_s")) is not None
+            and float(e["margin_s"]) < 0.0))
+    segments: Dict[int, Dict[int, dict]] = {}
+    for e in events:
+        if e.get("event") == "step_losses":
+            ep = int(e.get("epoch", -1))
+            # last event per (epoch, start_step) wins: a re-resumed run
+            # legally re-emits the same segment
+            segments.setdefault(ep, {})[int(e.get("start_step", 0))] = e
+    step_losses: Dict[int, Dict[str, List[float]]] = {}
+    for ep, by_start in segments.items():
+        series: Dict[str, List[float]] = {}
+        for start in sorted(by_start):
+            for k, v in by_start[start].items():
+                if str(k).startswith("loss_") and isinstance(v, list):
+                    series.setdefault(str(k), []).extend(
+                        float(x) for x in v)
+        step_losses[ep] = series
     end = next((e for e in events if e.get("event") == "end"), None)
     halting = sum(1 for e in faults if e.get("policy") == "halt")
     if end is not None and end.get("status") == "health_fault":
@@ -212,6 +249,10 @@ def stream_profile(events: List[dict], skipped: int = 0, name: str = "?") -> dic
         "n_halting_faults": halting,
         "n_fleet_recoveries": n_fleet_recoveries,
         "n_retries": n_retries,
+        "n_reshards": n_reshards,
+        "n_emergency_saves": len(saves),
+        "n_uncommitted_saves": n_uncommitted,
+        "step_losses": step_losses,
         "end_status": end.get("status") if end else None,
     }
 
@@ -397,6 +438,50 @@ def _compare_streams(base: dict, cand: dict, th) -> List[Check]:
                        f"{base.get('n_fleet_recoveries', 0)} -> "
                        f"{cand.get('n_fleet_recoveries', 0)} "
                        f"(reported, not gated)"))
+
+    # Elastic axis: engages when the candidate resharded across
+    # topologies or emergency-saved mid-epoch. The claim under gate is
+    # cross-mesh EQUIVALENCE: same per-step losses as the base, same
+    # step counts (a drifted count means a sample was skipped or
+    # repeated at the seam), and every emergency save committed inside
+    # its deadline budget.
+    if cand.get("n_reshards", 0) or cand.get("n_emergency_saves", 0):
+        n_bad = cand.get("n_uncommitted_saves", 0)
+        checks.append((
+            FAIL if n_bad else PASS, "elastic emergency-saves",
+            f"{cand.get('n_emergency_saves', 0)} save(s), "
+            f"{cand.get('n_reshards', 0)} reshard(s); "
+            f"{n_bad} missed the deadline budget (any miss fails)"))
+        common_eps = sorted(set(base.get("step_losses") or {})
+                            & set(cand.get("step_losses") or {}))
+        worst = 0.0
+        drift: List[str] = []
+        n_series = 0
+        for ep in common_eps:
+            bs = base["step_losses"][ep]
+            cs = cand["step_losses"][ep]
+            for key in sorted(set(bs) & set(cs)):
+                if len(bs[key]) != len(cs[key]):
+                    drift.append(f"e{ep} {key}: {len(bs[key])} vs "
+                                 f"{len(cs[key])} steps")
+                    continue
+                n_series += 1
+                if bs[key]:
+                    worst = max(worst, max(
+                        abs(a - b) for a, b in zip(bs[key], cs[key])))
+        if drift:
+            checks.append((FAIL, "elastic step-losses",
+                           "step-count drift (skipped/repeated sample): "
+                           + "; ".join(drift[:4])))
+        elif n_series:
+            status = FAIL if worst > th.max_elastic_loss_diff else PASS
+            checks.append((status, "elastic step-losses",
+                           f"{n_series} trajectories over epochs "
+                           f"{common_eps}: max |diff| {worst:.3g} vs "
+                           f"limit {th.max_elastic_loss_diff:.3g}"))
+        else:
+            checks.append((SKIP, "elastic step-losses",
+                           "no common step_losses trajectories to gate"))
     return checks
 
 
@@ -457,6 +542,7 @@ def make_thresholds(
     max_new_faults: int = 0,
     max_bench_drop: float = 0.10,
     max_serve_p95_increase: float = 0.50,
+    max_elastic_loss_diff: float = 1e-5,
     json: bool = False,
 ) -> argparse.Namespace:
     """Programmatic threshold bundle (bench.py's end-of-run hook)."""
@@ -467,6 +553,7 @@ def make_thresholds(
         max_new_faults=max_new_faults,
         max_bench_drop=max_bench_drop,
         max_serve_p95_increase=max_serve_p95_increase,
+        max_elastic_loss_diff=max_elastic_loss_diff,
         json=json,
     )
 
@@ -493,6 +580,10 @@ def main(argv=None) -> int:
     parser.add_argument("--max_serve_p95_increase", default=0.50, type=float,
                         help="max relative increase of any serve p95 latency "
                              "(per phase and class)")
+    parser.add_argument("--max_elastic_loss_diff", default=1e-5, type=float,
+                        help="max elementwise |diff| of per-step loss "
+                             "trajectories when the candidate resharded "
+                             "or resumed mid-epoch (f32 equivalence)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable report")
     args = parser.parse_args(argv)
