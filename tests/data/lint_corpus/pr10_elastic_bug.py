@@ -1,0 +1,23 @@
+# Lint corpus: the PR-10 elastic-reshard donation bug, pre-fix shape
+# (condensed from resil/elastic.py reshard_to_plan).
+#
+# The reshard path gathered each restored leaf to host and device_put
+# it under the new mesh's sharding. On CPU BOTH hops can be ZERO-copy,
+# so the "placed" array aliased the restored buffer — and the train
+# step donates its state. Same heap corruption as PR-8, one
+# abstraction higher. The donation-aliasing rule must flag the step
+# call below: device_put does not launder host-buffer taint.
+import jax
+
+
+def reshard_and_resume(leaves, treedef, sharding, data, train_step):
+    out = []
+    for leaf in leaves:
+        host = jax.device_get(leaf)          # host gather (zero-copy on CPU)
+        placed = jax.device_put(host, sharding)  # can alias `host`
+        out.append(placed)                   # BUG: no jnp.copy
+    state = jax.tree_util.tree_unflatten(treedef, out)
+    step = jax.jit(train_step, donate_argnums=(0,))
+    for x, y in data:
+        state, metrics = step(state, x, y)   # donates the aliased buffer
+    return state

@@ -1,0 +1,19 @@
+# Lint corpus: the PR-10 elastic pattern, post-fix — every placed leaf
+# routes through jnp.copy (an XLA computation), so the result is a
+# genuinely XLA-owned buffer with the same sharding. Must analyze
+# clean.
+import jax
+import jax.numpy as jnp
+
+
+def reshard_and_resume(leaves, treedef, sharding, data, train_step):
+    out = []
+    for leaf in leaves:
+        host = jax.device_get(leaf)
+        placed = jax.device_put(host, sharding)
+        out.append(jnp.copy(placed))  # load-bearing: defeats zero-copy alias
+    state = jax.tree_util.tree_unflatten(treedef, out)
+    step = jax.jit(train_step, donate_argnums=(0,))
+    for x, y in data:
+        state, metrics = step(state, x, y)
+    return state

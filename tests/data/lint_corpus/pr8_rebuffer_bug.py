@@ -1,0 +1,17 @@
+# Lint corpus: the PR-8 donation bug, pre-fix shape (condensed).
+#
+# utils/checkpoint.py restored state straight out of orbax and the
+# train loop's jitted step donated it (donate_argnums=(0,)) — XLA wrote
+# into buffers tensorstore still managed. Observed: every post-resume
+# save NaN-corrupt (22k-250k bad elements), intermittent
+# "malloc(): largebin double linked list corrupted" aborts.
+# graftlint's donation-aliasing rule must flag the step call below.
+import jax
+
+
+def resume_and_train(ckptr, slot, abstract, data, train_step):
+    state = ckptr.restore(slot, abstract)  # orbax-owned buffers
+    step = jax.jit(train_step, donate_argnums=(0,))
+    for x, y in data:
+        state, metrics = step(state, x, y)  # donates the orbax buffer
+    return state

@@ -1,0 +1,19 @@
+# Lint corpus: the PR-8 pattern, post-fix — restored state is
+# deep-copied into XLA-owned buffers (checkpoint._rebuffer) before the
+# donating step ever sees it. Must analyze clean.
+import jax
+import jax.numpy as jnp
+
+
+def _rebuffer(tree):
+    return jax.tree.map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, tree)
+
+
+def resume_and_train(ckptr, slot, abstract, data, train_step):
+    state = ckptr.restore(slot, abstract)
+    state = _rebuffer(state)  # XLA owns every leaf from here on
+    step = jax.jit(train_step, donate_argnums=(0,))
+    for x, y in data:
+        state, metrics = step(state, x, y)
+    return state

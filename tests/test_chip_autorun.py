@@ -51,28 +51,37 @@ def test_fake_relay_env_round_trips(monkeypatch):
 def test_queue_order_and_budgets():
     q = build_queue("remote")
     names = [s.name for s in q]
-    # Highest value first (VERDICT r4 item 1): health probe, official
-    # number cold then warm, the pad lever, 512^2 rows, the serving
-    # sweep, trace, e2e run.
-    assert names == ["diag", "bench_cold", "bench_warm", "pad_sweep",
-                     "epilogue_sweep", "grad_sweep", "accum512",
-                     "scan512", "serve_sweep", "trace", "chaos_drill",
-                     "timed_main"]
+    # Highest value first (VERDICT r4 item 1): the no-TPU static
+    # preflight, health probe, official number cold then warm, the pad
+    # lever, 512^2 rows, the serving sweep, trace, e2e run.
+    assert names == ["graftlint", "diag", "bench_cold", "bench_warm",
+                     "pad_sweep", "epilogue_sweep", "grad_sweep",
+                     "accum512", "scan512", "serve_sweep", "trace",
+                     "chaos_drill", "timed_main"]
     by = {s.name: s for s in q}
     assert by["diag"].abort_queue_on_fail  # diag failing = relay sick
+    # lint failing = known bug class in the code about to burn the
+    # window; abort before any chip work, re-check every attempt
+    assert by["graftlint"].abort_queue_on_fail
+    assert by["graftlint"].always_run
+    assert by["graftlint"].stdout_to.endswith("graftlint.json")
     # cold run gets the cache-warming budget; warm run is the record
     assert float(by["bench_cold"].env["BENCH_TIME_BUDGET_S"]) > float(
         by["bench_warm"].env["BENCH_TIME_BUDGET_S"])
     assert by["bench_cold"].stdout_to.endswith("_cold.json")
     assert by["bench_warm"].stdout_to and not (
         by["bench_warm"].stdout_to.endswith("_cold.json"))
-    # every step outlives its own worst-case compile chain
+    # every chip step outlives its own worst-case compile chain; the
+    # static preflight compiles nothing and keeps a tight budget
     for s in q:
+        if s.name == "graftlint":
+            assert s.timeout_s >= 120.0
+            continue
         assert s.timeout_s >= 1800.0, s.name
 
 
 def test_queue_pad_sweep_covers_the_lever():
-    specs = build_queue("remote")[3].argv
+    specs = {s.name: s for s in build_queue("remote")}["pad_sweep"].argv
     assert "scan:b16zero" in specs and "scan:b16fused" in specs
 
 
@@ -355,7 +364,9 @@ def test_diag_never_given_up_while_work_pends(fake_repo, monkeypatch):
 
     monkeypatch.setattr(chip_autorun, "run_queue", fake_run_queue)
     assert chip_autorun.attempt_window(fake_repo) is False
-    assert ran and ran[0][0] == "diag"  # probe still leads the attempt
+    # the probe still runs every attempt (right after the static
+    # preflight, which needs no TPU and so precedes it)
+    assert ran and ran[0][:2] == ["graftlint", "diag"]
 
 
 def test_run_queue_stops_on_mode_shift(fake_repo, monkeypatch):

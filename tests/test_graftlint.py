@@ -1,0 +1,457 @@
+"""graftlint: the static-discipline framework (tools/graftlint).
+
+Covers, per ISSUE 11's acceptance bar:
+
+- the donation-aliasing dataflow rule flags BOTH historical bug shapes
+  in tests/data/lint_corpus (the PR-8 restore-then-donate and the PR-10
+  device_put-alias variants) and passes both post-fix shapes clean;
+- the no-sync rule keeps check_no_sync.py's exact verdict semantics
+  while fixing its string-literal false-positive and aliased-import
+  false-negative classes (and the wrapper stays byte-compatible);
+- tracer-leak catches host control flow / concretization on traced
+  values, exempts static inspections and static args, and warns on
+  jit-in-loop retrace hazards and unhashable static literals;
+- the compile-site census recognizes construction sites semantically
+  (lower_forward().compile() yes, re.compile/str.lower no) and the
+  committed docs/compile_sites_r01.json matches a fresh scan on the
+  line-independent keys;
+- suppressions require a reason; the baseline grandfathers one finding
+  per entry and stale entries never fail;
+- the whole repo is ZERO unsuppressed findings under the committed
+  baseline — the self-application gate the preflight enforces.
+
+Pure stdlib + AST: no jax import, no devices, fast enough for tier-1.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+sys.path.insert(0, REPO)
+
+from graftlint import engine  # noqa: E402
+from graftlint.rules import ALL_RULES, make_rules  # noqa: E402
+from graftlint.rules.census import CompileSiteCensusRule, site_key  # noqa: E402
+from graftlint.rules.donation import DonationAliasingRule  # noqa: E402
+from graftlint.rules import nosync  # noqa: E402
+from graftlint.rules.tracer import TracerLeakRule  # noqa: E402
+
+CORPUS = os.path.join("tests", "data", "lint_corpus")
+
+
+def lint_file(rel, rules, repo=REPO, baseline=None):
+    return engine.run(repo, rules, files=[rel], baseline=baseline)
+
+
+def lint_source(tmp_path, source, rules, baseline=None):
+    (tmp_path / "mod.py").write_text(source)
+    return engine.run(str(tmp_path), rules, files=["mod.py"],
+                      baseline=baseline)
+
+
+# ------------------------------------------- donation-aliasing: the corpus
+
+
+@pytest.mark.parametrize("fixture, origin_hint", [
+    ("pr8_rebuffer_bug.py", "checkpoint restore"),
+    ("pr10_elastic_bug.py", "device_put of host gather"),
+])
+def test_corpus_bug_shapes_flagged(fixture, origin_hint):
+    """Both historical heap-corruption shapes (the PR-8 restore-then-
+    donate and the PR-10 reshard alias) are errors."""
+    res = lint_file(os.path.join(CORPUS, fixture),
+                    [DonationAliasingRule()])
+    assert len(res.findings) == 1, [f.render() for f in res.findings]
+    f = res.findings[0]
+    assert f.rule == "donation-aliasing"
+    assert f.severity == "error"
+    assert origin_hint in f.message
+    assert "donate" in f.message
+
+
+@pytest.mark.parametrize("fixture", [
+    "pr8_rebuffer_fixed.py",
+    "pr10_elastic_fixed.py",
+])
+def test_corpus_fixed_shapes_clean(fixture):
+    """The sanctioned re-buffering (checkpoint._rebuffer / jnp.copy)
+    launders the taint: post-fix shapes analyze clean."""
+    res = lint_file(os.path.join(CORPUS, fixture),
+                    [DonationAliasingRule()])
+    assert res.findings == [], [f.render() for f in res.findings]
+
+
+def test_donation_unknown_call_launders(tmp_path):
+    """Precision over recall: a value that passes through an unknown
+    call is no longer assumed aliased (no cascade of false positives)."""
+    res = lint_source(tmp_path, (
+        "import jax\n"
+        "def f(ckptr, slot, abstract, step_fn, x):\n"
+        "    state = ckptr.restore(slot, abstract)\n"
+        "    state = step_fn(state)\n"   # unknown call -> launders
+        "    step = jax.jit(step_fn, donate_argnums=(0,))\n"
+        "    return step(state, x)\n"
+    ), [DonationAliasingRule()])
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------- no-sync
+
+
+def test_nosync_aliased_import_caught():
+    """`from jax import device_get as g` — the token scanner's
+    false-negative class — is resolved and flagged at the use site."""
+    src = ("from jax import device_get as g\n"
+           "def f(x):\n"
+           "    return g(x)\n")
+    hits = nosync.scan_source(src, allow_sanctioned=True)
+    assert any(line == 3 and tok == "device_get"
+               for line, tok, _ in hits), hits
+
+
+def test_nosync_strings_and_comments_clean():
+    """The false-positive class: names inside string literals and
+    comments never violate."""
+    src = ('msg = "never call block_until_ready or jax.device_get"\n'
+           "# block_until_ready would be a sync here\n")
+    assert nosync.scan_source(src, allow_sanctioned=True) == []
+    assert nosync.scan_source(src, allow_sanctioned=False) == []
+
+
+def test_nosync_sanction_policy():
+    src = ("import jax\n"
+           "h = jax.device_get(x)  # sanctioned-fetch: drain\n")
+    assert nosync.scan_source(src, allow_sanctioned=True) == []
+    hits = nosync.scan_source(src, allow_sanctioned=False)
+    assert len(hits) == 1
+    assert "no sanctioned sites exist in obs/" in hits[0][2]
+
+
+def test_nosync_wrapper_messages_byte_compatible(tmp_path):
+    """The check_no_sync.py wrapper emits the historical message
+    formats (the strings downstream tooling and the runbook quote)."""
+    from check_no_sync import check_file
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n"
+                   "x.block_until_ready()\n"
+                   "jax.device_get(x)\n")
+    v = check_file(str(bad), allow_sanctioned=True)
+    assert v == [
+        f"{bad}:2: forbidden sync `block_until_ready` in the hot path",
+        f"{bad}:3: `device_get` outside the sanctioned fetch window "
+        f"(missing `# sanctioned-fetch` marker)",
+    ]
+
+
+def test_nosync_repo_hot_path_clean_via_rule():
+    """The rule form agrees with the wrapper: the repo's hot path is
+    clean through the graftlint engine too."""
+    res = engine.run(REPO, make_rules(["no-sync"]))
+    assert res.findings == [], [f.render() for f in res.findings]
+
+
+# ------------------------------------------------------------ tracer-leak
+
+
+def test_tracer_if_on_traced_value(tmp_path):
+    res = lint_source(tmp_path, (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+    ), [TracerLeakRule()])
+    assert len(res.findings) == 1
+    assert "host control flow" in res.findings[0].message
+    assert res.findings[0].severity == "error"
+
+
+def test_tracer_cast_and_item(tmp_path):
+    res = lint_source(tmp_path, (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    a = float(x)\n"
+        "    b = x.sum().item()\n"
+        "    return a + b\n"
+    ), [TracerLeakRule()])
+    msgs = sorted(f.message for f in res.findings)
+    assert len(msgs) == 2, msgs
+    assert any("float()" in m for m in msgs)
+    assert any(".item()" in m for m in msgs)
+
+
+def test_tracer_static_inspections_exempt(tmp_path):
+    """shape/ndim/dtype access, len(), and `is None` checks stay
+    host-side by construction — no findings."""
+    res = lint_source(tmp_path, (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x, mask=None):\n"
+        "    if x.shape[0] > 2 and x.ndim == 4:\n"
+        "        x = x * 2\n"
+        "    if mask is not None:\n"
+        "        x = x + mask\n"
+        "    n = len(x)\n"
+        "    return x / n\n"
+    ), [TracerLeakRule()])
+    assert res.findings == [], [f.render() for f in res.findings]
+
+
+def test_tracer_static_args_exempt(tmp_path):
+    """Parameters named in static_argnums are concrete at trace time —
+    branching on them is the sanctioned pattern, not a finding."""
+    res = lint_source(tmp_path, (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=(1,))\n"
+        "def f(x, n):\n"
+        "    if n > 2:\n"
+        "        return x * n\n"
+        "    return x\n"
+    ), [TracerLeakRule()])
+    assert res.findings == [], [f.render() for f in res.findings]
+
+
+def test_tracer_numpy_on_traced(tmp_path):
+    res = lint_source(tmp_path, (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.sum(x)\n"
+    ), [TracerLeakRule()])
+    assert len(res.findings) == 1
+    assert "numpy.sum" in res.findings[0].message
+
+
+def test_tracer_jit_in_loop_warns(tmp_path):
+    res = lint_source(tmp_path, (
+        "import jax\n"
+        "def build(fns, x):\n"
+        "    outs = []\n"
+        "    for fn in fns:\n"
+        "        outs.append(jax.jit(fn)(x))\n"
+        "    return outs\n"
+    ), [TracerLeakRule()])
+    assert len(res.findings) == 1
+    f = res.findings[0]
+    assert f.severity == "warning"
+    assert "inside a loop body" in f.message
+
+
+def test_tracer_unhashable_static_arg(tmp_path):
+    res = lint_source(tmp_path, (
+        "import jax\n"
+        "def run(f, x):\n"
+        "    step = jax.jit(f, static_argnums=(1,))\n"
+        "    return step(x, [1, 2, 3])\n"
+    ), [TracerLeakRule()])
+    assert len(res.findings) == 1
+    assert "unhashable" in res.findings[0].message
+
+
+# ------------------------------------------------------ compile-site census
+
+
+def test_census_counts_construction_sites(tmp_path):
+    rule = CompileSiteCensusRule()
+    res = lint_source(tmp_path, (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, donate_argnums=(0,))\n"
+        "def step(s, x):\n"
+        "    return s + x\n"
+        "def build(fn, p, x):\n"
+        "    lowered = jax.jit(fn).lower(p, x)\n"
+        "    return lowered.compile()\n"
+    ), [rule])
+    kinds = sorted(s["kind"] for s in rule.sites)
+    assert kinds == ["compile", "jit", "jit", "lower"], rule.sites
+    donated = [s for s in rule.sites if s.get("donate_argnums")]
+    assert donated and donated[0]["donate_argnums"] == [0]
+    # every non-allowlisted site is a WARNING (prospective discipline,
+    # never an immediate error)
+    assert res.findings
+    assert all(f.severity == "warning" for f in res.findings)
+
+
+def test_census_ignores_re_compile_and_str_lower(tmp_path):
+    """`.compile`/`.lower` only count when the receiver is jit-derived:
+    re.compile() and str.lower() are not compile sites."""
+    rule = CompileSiteCensusRule()
+    lint_source(tmp_path, (
+        "import re\n"
+        "def f(s):\n"
+        "    return re.compile(s.lower())\n"
+    ), [rule])
+    assert rule.sites == []
+
+
+def test_committed_census_matches_fresh_scan():
+    """docs/compile_sites_r01.json stays truthful: a fresh scan finds
+    exactly the committed construction sites, compared on the
+    line-independent keys (path::kind::enclosing#occurrence) so
+    unrelated edits don't churn this test. If you add or remove a
+    compile site, regenerate with
+    `python tools/graftlint --census-json docs/compile_sites_r01.json`."""
+    committed = json.load(
+        open(os.path.join(REPO, "docs", "compile_sites_r01.json")))
+    rule = CompileSiteCensusRule()
+    engine.run(REPO, [rule])
+    fresh = {site_key(s) for s in rule.sites}
+    recorded = {site_key(s) for s in committed["sites"]}
+    assert fresh == recorded, (
+        f"census drift: new={sorted(fresh - recorded)} "
+        f"gone={sorted(recorded - fresh)}")
+    assert committed["n_sites"] == len(committed["sites"])
+    # The serve engine's AOT path resolves through the module-local
+    # helper summary — the sites the registry (ROADMAP item 5) most
+    # needs are present by name.
+    assert "cyclegan_tpu/serve/engine.py::compile::" \
+           "InferenceEngine.__init__#1" in recorded
+    assert "cyclegan_tpu/parallel/collective.py::shard_map::" \
+           "shard_map_train_step#1" in recorded
+
+
+# ------------------------------------------- suppressions and the baseline
+
+
+def test_suppression_requires_reason(tmp_path):
+    src_no_reason = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:  # graftlint: disable=tracer-leak\n"
+        "        return x\n"
+        "    return -x\n")
+    res = lint_source(tmp_path, src_no_reason, [TracerLeakRule()])
+    rules_hit = sorted(f.rule for f in res.findings)
+    # the finding survives AND the reasonless disable is itself reported
+    assert rules_hit == ["suppression", "tracer-leak"], rules_hit
+
+    src_with_reason = src_no_reason.replace(
+        "disable=tracer-leak",
+        "disable=tracer-leak -- demo: concrete at trace time here")
+    res = lint_source(tmp_path, src_with_reason, [TracerLeakRule()])
+    assert res.findings == []
+    assert len(res.suppressed) == 1
+    assert res.ok
+
+
+def test_baseline_grandfathers_one_to_one_and_reports_stale(tmp_path):
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    return float(x)\n")
+    res = lint_source(tmp_path, src, [TracerLeakRule()])
+    assert len(res.findings) == 1
+    fp = res.findings[0].fingerprint
+    baseline = [
+        {"rule": "tracer-leak", "path": "mod.py", "fingerprint": fp,
+         "reason": "grandfathered for the test"},
+        {"rule": "tracer-leak", "path": "gone.py", "fingerprint": "x#1",
+         "reason": "stale entry"},
+    ]
+    res = lint_source(tmp_path, src, [TracerLeakRule()], baseline=baseline)
+    assert res.findings == [] and res.ok
+    assert len(res.baselined) == 1
+    assert len(res.stale_baseline) == 1  # informational, never failing
+
+
+def test_baseline_fingerprints_survive_line_shifts(tmp_path):
+    """Fingerprints exclude line numbers: prepending code to the file
+    must not invalidate the baseline entry."""
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    return float(x)\n")
+    fp = lint_source(tmp_path, src, [TracerLeakRule()]).findings[0].fingerprint
+    shifted = "import os\n\nPAD = os.sep\n\n" + src
+    fp2 = lint_source(tmp_path, shifted,
+                      [TracerLeakRule()]).findings[0].fingerprint
+    assert fp == fp2
+
+
+# --------------------------------------------------- whole-repo self-gate
+
+
+def test_repo_zero_unsuppressed_findings_under_committed_baseline():
+    """THE acceptance gate: all four rules over the whole scan set,
+    against the committed graftlint_baseline.json — zero live findings,
+    zero stale entries. A new compile site (or any regression of the
+    donation/no-sync/tracer discipline) fails here before it ever
+    reaches chip time."""
+    baseline = engine.load_baseline(
+        os.path.join(REPO, engine.BASELINE_NAME))
+    assert baseline, "committed graftlint_baseline.json missing or empty"
+    res = engine.run(REPO, make_rules(), baseline=baseline)
+    assert res.findings == [], "\n".join(f.render() for f in res.findings)
+    assert res.ok
+    assert res.stale_baseline == [], res.stale_baseline
+    # the corpus lives under tests/ and must stay OUT of the scan set
+    assert res.files_scanned > 50
+    assert all(r in res.rules_run for r in ALL_RULES)
+
+
+def test_cli_json_output_is_one_parseable_line(capsys):
+    from graftlint import cli
+
+    rc = cli.main(["--repo", REPO, "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    assert len(lines) == 1  # the repo tooling contract: ONE json line
+    rec = json.loads(lines[0])
+    assert rec["tool"] == "graftlint" and rec["ok"] is True
+    assert rec["findings"] == []
+
+
+def test_cli_exit_code_on_findings(capsys):
+    from graftlint import cli
+
+    rc = cli.main(["--repo", REPO, os.path.join(CORPUS),
+                   "--no-baseline", "--rules", "donation-aliasing"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "graftlint FAILED" in out
+    assert out.count("donation-aliasing") >= 2  # both bug fixtures
+
+
+# ----------------------------------------------------- obs_report wiring
+
+
+def test_obs_report_notes_lint_verdict(tmp_path):
+    from obs_report import fold, load_lint_verdict, render
+
+    jsonl = tmp_path / "telemetry.jsonl"
+    jsonl.write_text('{"event": "epoch", "epoch": 0, "mfu": 0.1}\n')
+    (tmp_path / "graftlint.json").write_text(json.dumps({
+        "tool": "graftlint", "ok": True, "files_scanned": 9,
+        "rules": ["donation-aliasing"], "counts": {},
+        "n_suppressed": 1, "n_baselined": 2, "findings": [],
+    }) + "\n")
+    lint = load_lint_verdict(str(jsonl))
+    assert lint is not None and lint["ok"]
+    report = fold([{"event": "epoch", "epoch": 0}], 0)
+    report["lint"] = lint
+    text = render(report)
+    assert "static discipline (graftlint preflight)" in text
+    assert "verdict: PASSED" in text
+    assert "1 suppressed, 2 baselined" in text
+
+
+def test_obs_report_without_lint_file_unchanged(tmp_path):
+    from obs_report import fold, load_lint_verdict, render
+
+    jsonl = tmp_path / "telemetry.jsonl"
+    jsonl.write_text('{"event": "epoch", "epoch": 0}\n')
+    assert load_lint_verdict(str(jsonl)) is None
+    text = render(fold([{"event": "epoch", "epoch": 0}], 0))
+    assert "graftlint" not in text

@@ -62,7 +62,7 @@ def main() -> None:
         say(f"{tag}: lowering + compiling")
         t0 = time.perf_counter()
         try:
-            compiled = jax.jit(gen.apply).lower(params, x).compile()
+            compiled = jax.jit(gen.apply).lower(params, x).compile()  # graftlint: disable=tracer-leak -- per-scheme AOT probe; a fresh program per config is the point
             out = extract_analysis(compiled)
             out["compile_seconds"] = round(time.perf_counter() - t0, 1)
             ca = out.get("cost_analysis", {})

@@ -4,9 +4,8 @@
 
 The dispatch loop's whole performance story rests on staying
 asynchronous (train/loop.py: deferred metric fetch, bounded
-backpressure). The telemetry subsystem (cyclegan_tpu/obs) instruments
-that loop and must never re-serialize it, so this check enforces two
-rules over the hot-path files:
+backpressure), so this check enforces two rules over the hot-path
+files:
 
 1. `block_until_ready` is forbidden everywhere in them. It is both a
    sync AND a lie through the remote-TPU tunnel (returns at
@@ -14,168 +13,51 @@ rules over the hot-path files:
 2. `device_get` is forbidden except on lines carrying a
    `sanctioned-fetch` marker comment — the deferred fetches the loop's
    design already requires (backpressure window, end-of-epoch drain).
-   In `cyclegan_tpu/obs/` there are no sanctioned sites at all:
-   telemetry only timestamps fetches the loop performs. Likewise every
-   kernel wrapper under `cyclegan_tpu/ops/pallas/` (scanned as a
-   directory): they run INSIDE the fused train step, where any host
-   sync would serialize the dispatch pipeline. The serving path
-   (`cyclegan_tpu/serve/`, also scanned as a directory) follows the
-   loop's rule: its one deferred D2H per flush lives on the completer
-   thread behind a `sanctioned-fetch` marker; everywhere else a fetch
-   would stall the dispatch/batching threads.
 
-Comments and docstrings are exempt (they may DISCUSS the forbidden
-calls); only code can violate. Runs in tier-1 via
+Since graftlint landed this is a thin wrapper over its AST-based
+`no-sync` rule (tools/graftlint/rules/nosync.py, which also owns the
+hot-path table) — same CLI, same exit codes, same verdict messages,
+but the scan now resolves names semantically: comments, docstrings,
+and string literals can never violate (they may DISCUSS the forbidden
+calls), aliased imports like `from jax import device_get as g` are
+caught, and unrelated identifiers merely containing a forbidden token
+no longer flag — the token scanner's known false-positive/negative
+classes. Runs in tier-1 via
 tests/test_obs.py::test_hot_path_has_no_sync.
 """
 
 from __future__ import annotations
 
-import io
 import os
 import sys
-import tokenize
-from typing import List, Tuple
+from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from graftlint.rules.nosync import (  # noqa: E402,F401  (public table re-exports)
+    FORBIDDEN_ALWAYS,
+    FORBIDDEN_UNSANCTIONED,
+    HOT_PATH_DIRS,
+    HOT_PATH_FILES,
+    SANCTION_MARKER,
+    check_file_violations,
+    hot_path_entries as _hot_path_entries,
+    run_check as _run_check,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-FORBIDDEN_ALWAYS = ("block_until_ready",)
-FORBIDDEN_UNSANCTIONED = ("device_get",)
-SANCTION_MARKER = "sanctioned-fetch"
 
-# (path, allow_sanctioned_fetches)
-HOT_PATH_FILES: List[Tuple[str, bool]] = [
-    ("cyclegan_tpu/train/loop.py", True),
-    # The epoch-services worker exists to take host I/O OFF the dispatch
-    # path; a device fetch on it would re-serialize the boundary it
-    # overlaps (callers hand it already-fetched host copies).
-    ("cyclegan_tpu/utils/services.py", False),
-    # Both gradient engines (combined jax.grad and the fusedprop vjp
-    # path) build traced-only code; any host fetch here would run once
-    # per step inside the dispatch chain. Zero sanctioned sites.
-    ("cyclegan_tpu/train/steps.py", False),
-    # Elastic recovery: the module's ONE sanctioned site class is the
-    # restore-time gather in reshard_to_plan (before any dispatch
-    # exists); the breaker/emergency-save paths that run DURING the
-    # loop must stay fetch-free. Overrides the resil/ directory default
-    # below (explicit file entries win over directory expansion).
-    ("cyclegan_tpu/resil/elastic.py", True),
-]
-
-# Directories whose EVERY .py file is hot-path. Scanned as a directory
-# (not a file list) so a new module is covered the day it lands:
-# - obs (no sanctioned sites): telemetry only timestamps fetches the
-#   loop performs, and the health layer (obs/health.py) only computes
-#   inside the jitted step / consumes already-fetched host rows — the
-#   directory scan is what keeps that promise as the package grows.
-# - ops/pallas (no sanctioned sites): kernel wrappers run INSIDE the
-#   fused train step — a host sync there would serialize every dispatch.
-# - serve (sanctioned sites allowed): the serving pipeline's whole
-#   design is deferred fetches — the completer thread's one bounded
-#   `device_get` per flush carries the marker; anything else (an
-#   engine/batcher/server sync) would re-serialize the pipeline.
-# - serve/fleet (sanctioned sites allowed): listed separately because
-#   the directory scan is deliberately non-recursive; the replica
-#   worker's one deferred fetch per flush is the package's only
-#   sanctioned sync — admission/dispatch must stay pure host-side
-#   queueing.
-HOT_PATH_DIRS: List[Tuple[str, bool]] = [
-    ("cyclegan_tpu/obs", False),
-    ("cyclegan_tpu/ops/pallas", False),
-    ("cyclegan_tpu/serve", True),
-    ("cyclegan_tpu/serve/fleet", True),
-    # resil (no sanctioned sites by default): fault injection, retry,
-    # and rollback are pure host-side orchestration at dispatch/IO
-    # boundaries — a device sync here would put a stall INSIDE the
-    # recovery machinery that exists to keep the loop async under
-    # failure. elastic.py alone carries an explicit file entry above
-    # (one sanctioned restore-time gather).
-    ("cyclegan_tpu/resil", False),
-]
-
-
-def hot_path_entries(repo: str = REPO) -> List[Tuple[str, bool]]:
-    """The static file list plus every .py under the hot-path dirs,
-    deduplicated with explicit HOT_PATH_FILES entries taking precedence
-    over directory expansion (a file may need a different sanction
-    policy than its directory's default). A missing directory is
-    reported as a missing file entry (the check must fail loudly, not
-    silently shrink)."""
-    policy = {rel: allow for rel, allow in HOT_PATH_FILES}
-    order = [rel for rel, _ in HOT_PATH_FILES]
-    for rel, allow in HOT_PATH_DIRS:
-        d = os.path.join(repo, rel)
-        if not os.path.isdir(d):
-            if rel not in policy:
-                policy[rel] = allow
-                order.append(rel)
-            continue
-        for name in sorted(os.listdir(d)):
-            if not name.endswith(".py"):
-                continue
-            sub = os.path.join(rel, name)
-            if sub not in policy:
-                policy[sub] = allow
-                order.append(sub)
-    return [(rel, policy[rel]) for rel in order]
-
-
-def _code_lines(source: str) -> dict:
-    """line number -> code-only text (comments and string literals,
-    docstrings included, stripped via the tokenizer)."""
-    lines: dict = {}
-    try:
-        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
-        for tok in tokens:
-            if tok.type in (tokenize.COMMENT, tokenize.STRING, tokenize.NL,
-                            tokenize.NEWLINE, tokenize.INDENT,
-                            tokenize.DEDENT):
-                continue
-            row = tok.start[0]
-            lines[row] = lines.get(row, "") + " " + tok.string
-    except tokenize.TokenizeError:
-        # Unparseable file: fall back to raw lines (conservative — may
-        # flag mentions inside strings, better than missing real calls).
-        for i, raw in enumerate(source.splitlines(), 1):
-            lines[i] = raw
-    return lines
+def hot_path_entries(repo: str = REPO):
+    return _hot_path_entries(repo)
 
 
 def check_file(path: str, allow_sanctioned: bool) -> List[str]:
-    violations = []
-    with open(path) as f:
-        source = f.read()
-    raw_lines = source.splitlines()
-    for row, code in sorted(_code_lines(source).items()):
-        raw = raw_lines[row - 1] if row <= len(raw_lines) else ""
-        for tok in FORBIDDEN_ALWAYS:
-            if tok in code:
-                violations.append(
-                    f"{path}:{row}: forbidden sync `{tok}` in the hot path"
-                )
-        for tok in FORBIDDEN_UNSANCTIONED:
-            if tok in code:
-                if allow_sanctioned and SANCTION_MARKER in raw:
-                    continue
-                where = ("missing `# sanctioned-fetch` marker"
-                         if allow_sanctioned
-                         else "no sanctioned sites exist in obs/")
-                violations.append(
-                    f"{path}:{row}: `{tok}` outside the sanctioned fetch "
-                    f"window ({where})"
-                )
-    return violations
+    return check_file_violations(path, allow_sanctioned)
 
 
 def run_check(repo: str = REPO) -> List[str]:
-    violations: List[str] = []
-    for rel, allow in hot_path_entries(repo):
-        path = os.path.join(repo, rel)
-        if not os.path.exists(path):
-            violations.append(f"{rel}: hot-path file missing")
-            continue
-        violations.extend(check_file(path, allow))
-    return violations
+    return _run_check(repo)
 
 
 def main() -> int:

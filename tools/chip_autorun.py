@@ -149,6 +149,19 @@ def build_queue(mode: str, round_tag: str = ROUND_TAG) -> list:
         env = {"PALLAS_AXON_POOL_IPS": "", "CYCLEGAN_AXON_LOCAL_COMPILE": "1"}
     sweeps = os.path.join("docs", "bench_sweeps.json")
     q = [
+        # Static-discipline preflight: graftlint over the whole tree
+        # (donation-aliasing, no-sync, tracer-leak, compile-site census
+        # vs the committed baseline). Runs BEFORE the diag because it
+        # needs no TPU at all — a donation-aliasing or hot-path-sync
+        # finding means the code about to occupy hours of chip time
+        # carries a known heap-corruption or serialization class, so
+        # the queue aborts without burning the window. The one-line
+        # JSON verdict lands next to the round's logs, where
+        # obs_report.py picks it up.
+        Step("graftlint", [py, "tools/graftlint", "--json"], 300.0,
+             env=env, abort_queue_on_fail=True, always_run=True,
+             stdout_to=os.path.join(
+                 "docs", "chip_logs", round_tag, "graftlint.json")),
         # Staged health probe: attributes any hang to init vs compile
         # vs execute. A failure here aborts the queue — the relay is
         # not actually healthy, and further clients would pile onto it.

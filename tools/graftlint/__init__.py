@@ -1,0 +1,35 @@
+"""graftlint: AST/dataflow static analysis for TPU discipline.
+
+Stdlib-only (`ast` + `tokenize`, no jax import — the linter must run on
+any box, including CI images and the chip_autorun daemon's parent
+process, which never imports jax). Four rules over the package:
+
+- donation-aliasing: host-owned buffers must not reach donate_argnums
+  call sites without jnp.copy/_rebuffer (the PR-8/PR-10 bug class).
+- no-sync: the hot path stays asynchronous (check_no_sync.py semantics,
+  alias-aware on the AST).
+- tracer-leak: host control flow / concretization on traced values,
+  jit-in-loop retraces, unhashable static args.
+- compile-site-census: the jit/lower/compile/shard_map inventory that
+  seeds ROADMAP item 5's AOT program registry.
+
+Run it:
+
+    python tools/graftlint                # text verdict, exit 1 on findings
+    python tools/graftlint --json         # one JSON line (tooling contract)
+    python tools/graftlint --census-json docs/compile_sites_r01.json
+"""
+
+from graftlint.engine import (  # noqa: F401
+    Finding,
+    LintResult,
+    Module,
+    Rule,
+    SCAN_TARGETS,
+    iter_scan_files,
+    load_baseline,
+    run,
+)
+from graftlint.rules import ALL_RULES, make_rules  # noqa: F401
+
+__version__ = "1.0"

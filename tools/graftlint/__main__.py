@@ -1,0 +1,18 @@
+"""`python tools/graftlint` entry point.
+
+Running a directory puts the directory ITSELF on sys.path[0]; the
+package imports (`graftlint.engine` …) need its parent (tools/) there
+instead.
+"""
+
+import os
+import sys
+
+_TOOLS = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+from graftlint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

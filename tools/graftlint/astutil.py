@@ -1,0 +1,754 @@
+"""Shared AST machinery for graftlint rules.
+
+Three layers, all stdlib-`ast`:
+
+- **Import resolution** (`ImportMap`, `resolve`): a dotted expression like
+  ``jnp.copy`` or an aliased import ``from jax import device_get as g``
+  resolves to its fully-qualified name (``jax.numpy.copy``,
+  ``jax.device_get``) so rules match *semantics*, not spellings — the
+  exact false-negative class the token-scan lint could not see.
+
+- **Jit classification** (`JitInfo`, `jit_call_info`): recognizes the
+  repo's program-construction grammar — ``jax.jit(f, donate_argnums=…)``,
+  ``partial(jax.jit, …)`` decorators, ``.lower(…)`` on a jit object,
+  ``.compile()`` on a lowered object, and ``shard_map`` in both its
+  ``jax.shard_map`` and ``jax.experimental.shard_map`` spellings
+  (including the ``_shard_map = jax.shard_map`` rebinding idiom in
+  parallel/collective.py).
+
+- **`FlowWalker`**: one intraprocedural forward pass per scope that
+  tracks (a) which names are bound to jit/lowered/compiled objects
+  (including through module-local helper functions whose return value is
+  such an object — how ``lower_forward(…).compile()`` in serve/engine.py
+  is recognized), and (b) which values are *tainted*, i.e. originate
+  from buffers XLA does not own: orbax/tensorstore restores,
+  ``np.asarray``/``np.frombuffer``, ``jax.device_get`` host gathers —
+  propagated through ``device_put``, containers, tree flatten/unflatten,
+  and method calls, and cleared only by the sanctioned re-buffering ops
+  ``jnp.copy`` / ``_rebuffer``. Rules subclass the walker and receive
+  events (compile sites, donated-call sinks, jitted defs, loop-scoped
+  jits) via the ``on_*`` hooks.
+
+The analysis is deliberately intraprocedural with module-level function
+summaries: unknown calls launder taint (precision over recall), and the
+two historical donation bugs this framework exists to catch (PR-8
+``_rebuffer``, PR-10 elastic ``jnp.copy``) are pinned as single-module
+corpus fixtures in tests/data/lint_corpus/.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import tokenize
+from typing import Dict, List, Optional, Tuple
+
+# --------------------------------------------------------------- imports
+
+
+def build_import_map(tree: ast.AST) -> Dict[str, str]:
+    """name-in-scope -> fully qualified dotted name.
+
+    ``import numpy as np`` -> {"np": "numpy"}; ``import jax`` ->
+    {"jax": "jax"}; ``from jax import device_get as g`` ->
+    {"g": "jax.device_get"}. Collected over the whole module (imports
+    inside functions included — the repo lazy-imports jax constantly).
+    """
+    imports: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    imports[a.asname] = a.name
+                else:
+                    head = a.name.split(".")[0]
+                    imports[head] = head
+        elif isinstance(node, ast.ImportFrom):
+            if node.module is None or node.level:
+                continue  # relative imports: out of scope
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                imports[a.asname or a.name] = f"{node.module}.{a.name}"
+    return imports
+
+
+def dotted_parts(node: ast.AST) -> Optional[List[str]]:
+    """["self", "_ckptr", "restore"] for self._ckptr.restore; None if the
+    expression is not a pure Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def resolve(node: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+    """Fully-qualified dotted name of an expression, through import
+    aliases. `jnp.copy` -> "jax.numpy.copy"; unknown heads stay as
+    written ("self._ckptr.restore")."""
+    parts = dotted_parts(node)
+    if not parts:
+        return None
+    head = imports.get(parts[0])
+    if head is not None:
+        return ".".join([head] + parts[1:])
+    return ".".join(parts)
+
+
+# -------------------------------------------------------------- comments
+
+
+def comment_map(source: str) -> Dict[int, str]:
+    """line -> comment text (the part after '#'), tokenizer-accurate so
+    '#' inside string literals never reads as a comment."""
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string.lstrip("#").strip()
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # suppressions unavailable on an unparseable file
+    return out
+
+
+# ------------------------------------------------------ jit classification
+
+JIT_NAMES = ("jax.jit", "jax.pjit", "jax.experimental.pjit.pjit")
+PARTIAL_NAMES = ("functools.partial", "partial")
+SHARD_MAP_NAMES = ("jax.shard_map", "jax.experimental.shard_map.shard_map")
+
+
+@dataclasses.dataclass(frozen=True)
+class JitInfo:
+    """What we know about a program-construction expression."""
+
+    kind: str  # "jit" | "lowered" | "compiled"
+    donate_argnums: Tuple[int, ...] = ()
+    donate_argnames: Tuple[str, ...] = ()
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+
+    def evolved(self, kind: str) -> "JitInfo":
+        return dataclasses.replace(self, kind=kind)
+
+    @property
+    def donates(self) -> bool:
+        return bool(self.donate_argnums or self.donate_argnames)
+
+
+class JitFactory:
+    """partial(jax.jit, …): calling it yields a jit-wrapped callable."""
+
+    def __init__(self, info: JitInfo):
+        self.info = info
+
+
+class ShardMapMarker:
+    """A name bound to shard_map (e.g. `_shard_map = jax.shard_map`)."""
+
+
+SHARD_MAP = ShardMapMarker()
+
+
+def _int_tuple(node: ast.AST) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _str_tuple(node: ast.AST) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _info_from_kwargs(call: ast.Call) -> JitInfo:
+    kw = {k.arg: k.value for k in call.keywords if k.arg}
+    return JitInfo(
+        kind="jit",
+        donate_argnums=_int_tuple(kw.get("donate_argnums", ast.Tuple(elts=[]))),
+        donate_argnames=_str_tuple(kw.get("donate_argnames", ast.Tuple(elts=[]))),
+        static_argnums=_int_tuple(kw.get("static_argnums", ast.Tuple(elts=[]))),
+        static_argnames=_str_tuple(kw.get("static_argnames", ast.Tuple(elts=[]))),
+    )
+
+
+def jit_call_info(call: ast.Call, imports: Dict[str, str]) -> Optional[JitInfo]:
+    """JitInfo for `jax.jit(…)` / `pjit(…)` call expressions, else None."""
+    name = resolve(call.func, imports)
+    if name in JIT_NAMES:
+        return _info_from_kwargs(call)
+    return None
+
+
+def partial_jit_info(call: ast.Call,
+                     imports: Dict[str, str]) -> Optional[JitInfo]:
+    """JitInfo for `partial(jax.jit, …)` factory expressions, else None."""
+    name = resolve(call.func, imports)
+    if name in PARTIAL_NAMES and call.args:
+        if resolve(call.args[0], imports) in JIT_NAMES:
+            return _info_from_kwargs(call)
+    return None
+
+
+# ---------------------------------------------------------------- taint
+
+# Fully-qualified callables whose RESULT is a buffer XLA does not own.
+SOURCE_CALLS = {
+    "jax.device_get": "host gather (jax.device_get)",
+    "numpy.asarray": "host numpy buffer (np.asarray)",
+    "numpy.frombuffer": "host numpy buffer (np.frombuffer)",
+}
+# Method names treated as checkpoint-restore calls regardless of the
+# receiver: orbax checkpointers, the repo's Checkpointer, tensorstore.
+SOURCE_METHODS = {
+    "restore": "checkpoint restore",
+    "restore_if_exists": "checkpoint restore",
+}
+# Sanctioned re-buffering ops: route the value through an XLA
+# computation, yielding an XLA-owned buffer (checkpoint._rebuffer docs).
+SANITIZER_CALLS = {"jax.numpy.copy", "jax.numpy.array"}
+SANITIZER_NAMES = {"_rebuffer"}
+TREE_MAP_NAMES = {"jax.tree.map", "jax.tree_util.tree_map", "jax.tree_map"}
+TREE_UNFLATTEN_NAMES = {"jax.tree_util.tree_unflatten", "jax.tree.unflatten"}
+TREE_FLATTEN_NAMES = {"jax.tree_util.tree_flatten", "jax.tree.flatten",
+                      "jax.tree_util.tree_leaves", "jax.tree.leaves"}
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.parent = parent
+        self.bindings: Dict[str, object] = {}  # JitInfo | JitFactory | marker
+        self.taint: Dict[str, str] = {}        # name/dotted -> origin
+
+    def lookup_binding(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s.bindings:
+                return s.bindings[name]
+            s = s.parent
+        return None
+
+    def lookup_taint(self, name: str) -> Optional[str]:
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s.taint:
+                return s.taint[name]
+            s = s.parent
+        return None
+
+
+@dataclasses.dataclass
+class EvalResult:
+    taint: Optional[str] = None   # origin description, None = clean
+    binding: object = None        # JitInfo | JitFactory | SHARD_MAP | None
+
+
+_CONTAINER_CTORS = {"tuple", "list", "dict", "set"}
+
+
+class FlowWalker:
+    """One forward pass per scope. Subclass and override the `on_*`
+    hooks; call `run()`. Loop bodies are processed twice (taint
+    introduced late in the body reaches uses at its top on the second
+    pass); event hooks deduplicate on node identity so the double pass
+    never double-reports."""
+
+    def __init__(self, tree: ast.AST, imports: Dict[str, str]):
+        self.tree = tree
+        self.imports = imports
+        self.defs_by_name: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs_by_name.setdefault(node.name, node)
+        self._seen: set = set()
+        self._loop_depth = 0
+
+    # ---- hooks -----------------------------------------------------------
+    def on_compile_site(self, kind: str, node: ast.AST, info: Optional[JitInfo],
+                        qualname: str) -> None:
+        """kind in {"jit", "lower", "compile", "shard_map"}."""
+
+    def on_jitted_def(self, funcdef, info: JitInfo, qualname: str) -> None:
+        """A module function definitely traced under jax.jit."""
+
+    def on_donated_taint(self, node: ast.AST, where: str, origin: str,
+                         qualname: str) -> None:
+        """A tainted value reached a donated argument position."""
+
+    def on_unhashable_static(self, node: ast.AST, where: str,
+                             qualname: str) -> None:
+        """A list/dict/set literal passed at a static_argnums position."""
+
+    def on_jit_in_loop(self, node: ast.AST, qualname: str) -> None:
+        """jax.jit constructed inside a loop body (retrace hazard)."""
+
+    # ---- driver ----------------------------------------------------------
+    def run(self) -> None:
+        self._walk_body(self.tree.body, Scope(), "")
+
+    def _once(self, node: ast.AST, tag: str) -> bool:
+        key = (id(node), tag)
+        if key in self._seen:
+            return False
+        self._seen.add(key)
+        return True
+
+    # ---- statements ------------------------------------------------------
+    def _walk_body(self, body, scope: Scope, qualname: str) -> None:
+        for stmt in body:
+            self._stmt(stmt, scope, qualname)
+
+    def _stmt(self, s, scope: Scope, qualname: str) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._function(s, scope, qualname)
+        elif isinstance(s, ast.ClassDef):
+            for d in s.decorator_list:
+                self._eval(d, scope, qualname)
+            self._walk_body(s.body, scope,
+                            f"{qualname}.{s.name}" if qualname else s.name)
+        elif isinstance(s, ast.Assign):
+            r = self._eval(s.value, scope, qualname)
+            for t in s.targets:
+                self._assign(t, r, scope, qualname)
+        elif isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                r = self._eval(s.value, scope, qualname)
+                self._assign(s.target, r, scope, qualname)
+        elif isinstance(s, ast.AugAssign):
+            r = self._eval(s.value, scope, qualname)
+            if r.taint is None:
+                # x += clean leaves x's taint alone; x += tainted taints.
+                return
+            self._assign(s.target, r, scope, qualname)
+        elif isinstance(s, ast.Expr):
+            self._eval(s.value, scope, qualname)
+        elif isinstance(s, ast.Return):
+            if s.value is not None:
+                r = self._eval(s.value, scope, qualname)
+                scope.bindings.setdefault("__returns__", []).append(r)  # type: ignore[union-attr]
+        elif isinstance(s, (ast.For, ast.AsyncFor)):
+            it = self._eval(s.iter, scope, qualname)
+            self._assign(s.target, EvalResult(taint=it.taint), scope, qualname)
+            self._loop_depth += 1
+            try:
+                self._walk_body(s.body, scope, qualname)
+                self._walk_body(s.body, scope, qualname)  # fixpoint lite
+            finally:
+                self._loop_depth -= 1
+            self._walk_body(s.orelse, scope, qualname)
+        elif isinstance(s, ast.While):
+            self._eval(s.test, scope, qualname)
+            self._loop_depth += 1
+            try:
+                self._walk_body(s.body, scope, qualname)
+                self._walk_body(s.body, scope, qualname)
+            finally:
+                self._loop_depth -= 1
+            self._walk_body(s.orelse, scope, qualname)
+        elif isinstance(s, ast.If):
+            self._eval(s.test, scope, qualname)
+            # Taint is union-merged across branches: either path may run.
+            before = dict(scope.taint)
+            self._walk_body(s.body, scope, qualname)
+            after_then = dict(scope.taint)
+            scope.taint = dict(before)
+            self._walk_body(s.orelse, scope, qualname)
+            for k, v in after_then.items():
+                scope.taint.setdefault(k, v)
+        elif isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                r = self._eval(item.context_expr, scope, qualname)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars, r, scope, qualname)
+            self._walk_body(s.body, scope, qualname)
+        elif isinstance(s, ast.Try):
+            self._walk_body(s.body, scope, qualname)
+            for h in s.handlers:
+                self._walk_body(h.body, scope, qualname)
+            self._walk_body(s.orelse, scope, qualname)
+            self._walk_body(s.finalbody, scope, qualname)
+        elif isinstance(s, (ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    self._eval(child, scope, qualname)
+        elif isinstance(s, ast.Delete):
+            for t in s.targets:
+                parts = dotted_parts(t)
+                if parts:
+                    scope.taint.pop(".".join(parts), None)
+        # Import/Pass/Global/Nonlocal/Break/Continue: nothing to do.
+
+    def _function(self, f, scope: Scope, qualname: str) -> None:
+        fq = f"{qualname}.{f.name}" if qualname else f.name
+        jitted: Optional[JitInfo] = None
+        for dec in f.decorator_list:
+            info = self._decorator_info(dec, scope, qualname)
+            if info is not None:
+                jitted = info
+        child = Scope(parent=scope)
+        child.bindings["__returns__"] = []
+        self._walk_body(f.body, child, fq)
+        # Module-local summary: a helper whose return value is a
+        # jit/lowered/compiled object makes its CALLERS construction-
+        # site-aware (serve/engine.py lower_forward(…).compile()).
+        returns = child.bindings.get("__returns__", [])
+        infos = [r.binding for r in returns if isinstance(r.binding, JitInfo)]
+        if infos and len(infos) == len(returns):
+            scope.bindings[f.name] = _Summary(infos[0])
+        if jitted is not None:
+            scope.bindings[f.name] = jitted
+            if self._once(f, "jitted_def"):
+                self.on_jitted_def(f, jitted, fq)
+
+    def _decorator_info(self, dec, scope: Scope,
+                        qualname: str) -> Optional[JitInfo]:
+        if isinstance(dec, ast.Call):
+            info = jit_call_info(dec, self.imports)
+            if info is None:
+                info = partial_jit_info(dec, self.imports)
+            if info is not None:
+                if self._once(dec, "site"):
+                    self.on_compile_site("jit", dec, info, qualname)
+                return info
+            self._eval(dec, scope, qualname)
+            return None
+        if resolve(dec, self.imports) in JIT_NAMES:
+            info = JitInfo(kind="jit")
+            if self._once(dec, "site"):
+                self.on_compile_site("jit", dec, info, qualname)
+            return info
+        return None
+
+    def _assign(self, target, r: EvalResult, scope: Scope,
+                qualname: str) -> None:
+        if isinstance(target, ast.Name):
+            key = target.id
+        else:
+            parts = dotted_parts(target)
+            if parts is None:
+                if isinstance(target, (ast.Tuple, ast.List)):
+                    for e in target.elts:
+                        inner = e.value if isinstance(e, ast.Starred) else e
+                        self._assign(inner, r, scope, qualname)
+                elif isinstance(target, ast.Subscript):
+                    # container[key] = tainted -> the container is tainted
+                    base = dotted_parts(target.value)
+                    if base and r.taint is not None:
+                        scope.taint[".".join(base)] = r.taint
+                return
+            key = ".".join(parts)
+        if r.taint is not None:
+            scope.taint[key] = r.taint
+        else:
+            scope.taint.pop(key, None)
+        if r.binding is not None:
+            scope.bindings[key] = r.binding
+        else:
+            scope.bindings.pop(key, None)
+
+    # ---- expressions -----------------------------------------------------
+    def _eval(self, node, scope: Scope, qualname: str) -> EvalResult:
+        if isinstance(node, ast.Call):
+            return self._call(node, scope, qualname)
+        if isinstance(node, ast.Name):
+            return EvalResult(taint=scope.lookup_taint(node.id),
+                              binding=scope.lookup_binding(node.id))
+        if isinstance(node, ast.Attribute):
+            parts = dotted_parts(node)
+            if parts:
+                key = ".".join(parts)
+                t = scope.lookup_taint(key)
+                b = scope.lookup_binding(key)
+                if t is None:
+                    t = scope.lookup_taint(parts[0])
+                if b is None and resolve(node, self.imports) in SHARD_MAP_NAMES:
+                    b = SHARD_MAP
+                return EvalResult(taint=t, binding=b)
+            base = self._eval(node.value, scope, qualname)
+            return EvalResult(taint=base.taint)
+        if isinstance(node, ast.Subscript):
+            self._eval(node.slice, scope, qualname)
+            base = self._eval(node.value, scope, qualname)
+            return EvalResult(taint=base.taint)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            taint = None
+            for e in node.elts:
+                r = self._eval(e, scope, qualname)
+                taint = taint or r.taint
+            return EvalResult(taint=taint)
+        if isinstance(node, ast.Dict):
+            taint = None
+            for k in list(node.keys) + list(node.values):
+                if k is None:
+                    continue
+                r = self._eval(k, scope, qualname)
+                taint = taint or r.taint
+            return EvalResult(taint=taint)
+        if isinstance(node, ast.Starred):
+            return self._eval(node.value, scope, qualname)
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test, scope, qualname)
+            a = self._eval(node.body, scope, qualname)
+            b = self._eval(node.orelse, scope, qualname)
+            return EvalResult(taint=a.taint or b.taint,
+                              binding=a.binding or b.binding)
+        if isinstance(node, ast.BoolOp):
+            taint = None
+            for v in node.values:
+                r = self._eval(v, scope, qualname)
+                taint = taint or r.taint
+            return EvalResult(taint=taint)
+        if isinstance(node, ast.NamedExpr):
+            r = self._eval(node.value, scope, qualname)
+            self._assign(node.target, r, scope, qualname)
+            return r
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            for gen in node.generators:
+                it = self._eval(gen.iter, scope, qualname)
+                self._assign(gen.target, EvalResult(taint=it.taint), scope,
+                             qualname)
+                for cond in gen.ifs:
+                    self._eval(cond, scope, qualname)
+            if isinstance(node, ast.DictComp):
+                self._eval(node.key, scope, qualname)
+                return EvalResult(
+                    taint=self._eval(node.value, scope, qualname).taint)
+            return EvalResult(
+                taint=self._eval(node.elt, scope, qualname).taint)
+        if isinstance(node, ast.Lambda):
+            return EvalResult()  # bodies evaluated where applied (tree.map)
+        if isinstance(node, ast.Await):
+            return self._eval(node.value, scope, qualname)
+        # Arithmetic/comparisons produce fresh XLA buffers: clean. Still
+        # recurse so nested calls are seen (sinks inside `f(x) + 1`).
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._eval(child, scope, qualname)
+        return EvalResult()
+
+    def _lambda_sanitizes(self, fn) -> bool:
+        """Does a tree.map mapping function body route through a
+        sanitizer? (checkpoint._rebuffer's `lambda x: jnp.copy(x)…`)."""
+        if isinstance(fn, ast.Lambda):
+            for sub in ast.walk(fn.body):
+                if isinstance(sub, ast.Call):
+                    if resolve(sub.func, self.imports) in SANITIZER_CALLS:
+                        return True
+                    if (isinstance(sub.func, ast.Name)
+                            and sub.func.id in SANITIZER_NAMES):
+                        return True
+        if isinstance(fn, ast.Name) and fn.id in SANITIZER_NAMES:
+            return True
+        return False
+
+    def _call(self, node: ast.Call, scope: Scope,
+              qualname: str) -> EvalResult:
+        func = node.func
+        resolved = resolve(func, self.imports)
+
+        # Evaluate the callee expression itself (chained calls like
+        # jax.jit(f).lower(x) classify through here).
+        func_binding = None
+        if isinstance(func, ast.Attribute) and func.attr in ("lower",
+                                                             "compile"):
+            recv = self._eval(func.value, scope, qualname)
+            func_binding = recv.binding
+        elif isinstance(func, (ast.Name, ast.Attribute)):
+            func_binding = self._eval(func, scope, qualname).binding
+        elif isinstance(func, ast.Call):
+            func_binding = self._call(func, scope, qualname).binding
+
+        # Argument taints (evaluated exactly once).
+        arg_results = [self._eval(a, scope, qualname) for a in node.args]
+        kw_results = {k.arg: self._eval(k.value, scope, qualname)
+                      for k in node.keywords}
+
+        # ---- construction sites ----------------------------------------
+        info = jit_call_info(node, self.imports)
+        if info is not None:
+            if self._once(node, "site"):
+                self.on_compile_site("jit", node, info, qualname)
+                if self._loop_depth:
+                    self.on_jit_in_loop(node, qualname)
+            if node.args:
+                target = node.args[0]
+                if isinstance(target, ast.Name):
+                    d = self.defs_by_name.get(target.id)
+                    if d is not None and self._once(d, "jitted_def"):
+                        self.on_jitted_def(d, info, qualname)
+                elif isinstance(target, ast.Lambda):
+                    pass  # traced lambda: nothing nameable to analyze
+            return EvalResult(binding=info)
+        pinfo = partial_jit_info(node, self.imports)
+        if pinfo is not None:
+            if self._once(node, "site"):
+                self.on_compile_site("jit", node, pinfo, qualname)
+                if self._loop_depth:
+                    self.on_jit_in_loop(node, qualname)
+            return EvalResult(binding=JitFactory(pinfo))
+        if isinstance(func_binding, JitFactory):
+            # Applying partial(jax.jit, …) to a function: the jit object.
+            if node.args and isinstance(node.args[0], ast.Name):
+                d = self.defs_by_name.get(node.args[0].id)
+                if d is not None and self._once(d, "jitted_def"):
+                    self.on_jitted_def(d, func_binding.info, qualname)
+            return EvalResult(binding=func_binding.info)
+        if (isinstance(func, ast.Attribute) and func.attr == "lower"
+                and isinstance(func_binding, JitInfo)
+                and func_binding.kind == "jit"):
+            if self._once(node, "site"):
+                self.on_compile_site("lower", node, func_binding, qualname)
+            return EvalResult(binding=func_binding.evolved("lowered"))
+        if (isinstance(func, ast.Attribute) and func.attr == "compile"
+                and isinstance(func_binding, JitInfo)
+                and func_binding.kind == "lowered"):
+            if self._once(node, "site"):
+                self.on_compile_site("compile", node, func_binding, qualname)
+            return EvalResult(binding=func_binding.evolved("compiled"))
+        if func_binding is SHARD_MAP or resolved in SHARD_MAP_NAMES:
+            if self._once(node, "site"):
+                self.on_compile_site("shard_map", node, None, qualname)
+            return EvalResult()
+        if isinstance(func_binding, _Summary):
+            # Calling a module-local helper whose return is a
+            # jit/lowered/compiled object: propagate its classification
+            # (the construction sites inside it are censused there).
+            summary = func_binding.info
+            if summary.kind in ("jit", "compiled"):
+                self._check_donated_call(node, summary, arg_results,
+                                         kw_results, scope, qualname)
+            return EvalResult(binding=summary)
+
+        # ---- execution sinks -------------------------------------------
+        if isinstance(func_binding, JitInfo):
+            if func_binding.kind in ("jit", "compiled"):
+                self._check_donated_call(node, func_binding, arg_results,
+                                         kw_results, scope, qualname)
+            return EvalResult()
+
+        # ---- taint sources / sanitizers / propagation ------------------
+        if resolved in SOURCE_CALLS:
+            return EvalResult(taint=SOURCE_CALLS[resolved])
+        if (isinstance(func, ast.Attribute)
+                and func.attr in SOURCE_METHODS
+                and resolved not in SANITIZER_CALLS):
+            return EvalResult(taint=SOURCE_METHODS[func.attr])
+        if resolved in SANITIZER_CALLS:
+            return EvalResult()
+        if isinstance(func, ast.Name) and func.id in SANITIZER_NAMES:
+            return EvalResult()
+        if isinstance(func, ast.Attribute) and func.attr in SANITIZER_NAMES:
+            return EvalResult()
+        if resolved == "jax.device_put":
+            if arg_results and arg_results[0].taint:
+                return EvalResult(
+                    taint=f"device_put of {arg_results[0].taint}")
+            return EvalResult()
+        if resolved in TREE_MAP_NAMES:
+            if node.args and self._lambda_sanitizes(node.args[0]):
+                return EvalResult()
+            taint = None
+            for r in arg_results[1:]:
+                taint = taint or r.taint
+            return EvalResult(taint=taint)
+        if resolved in TREE_UNFLATTEN_NAMES:
+            if len(arg_results) > 1:
+                return EvalResult(taint=arg_results[1].taint)
+            return EvalResult()
+        if resolved in TREE_FLATTEN_NAMES:
+            if arg_results:
+                return EvalResult(taint=arg_results[0].taint)
+            return EvalResult()
+        if resolved == "retry_call" or (isinstance(func, ast.Name)
+                                        and func.id == "retry_call"):
+            # retry.retry_call(f, *args): behaves as calling f.
+            if node.args:
+                f0 = node.args[0]
+                if isinstance(f0, ast.Lambda):
+                    body = self._eval(f0.body, scope, qualname)
+                    return EvalResult(taint=body.taint)
+                if (isinstance(f0, ast.Attribute)
+                        and f0.attr in SOURCE_METHODS):
+                    return EvalResult(taint=SOURCE_METHODS[f0.attr])
+            return EvalResult()
+        if isinstance(func, ast.Name) and func.id in _CONTAINER_CTORS:
+            taint = None
+            for r in arg_results:
+                taint = taint or r.taint
+            return EvalResult(taint=taint)
+        if isinstance(func, ast.Attribute):
+            base = self._eval(func.value, scope, qualname)
+            if func.attr in ("append", "extend", "insert", "add", "update"):
+                # container.append(tainted): the container carries it.
+                tainted_arg = next(
+                    (r.taint for r in arg_results if r.taint), None)
+                if tainted_arg is not None:
+                    parts = dotted_parts(func.value)
+                    if parts:
+                        scope.taint[".".join(parts)] = tainted_arg
+                return EvalResult()
+            if base.taint is not None:
+                # A method of a tainted object returns a derived view
+                # (state.replace(…), manifest.get(…)): stay tainted.
+                return EvalResult(taint=base.taint)
+        return EvalResult()
+
+    def _check_donated_call(self, node: ast.Call, info: JitInfo,
+                            arg_results, kw_results, scope: Scope,
+                            qualname: str) -> None:
+        for pos in info.donate_argnums:
+            if pos < len(node.args):
+                if isinstance(node.args[pos], ast.Starred):
+                    continue
+                r = arg_results[pos]
+                if r.taint and self._once(node, f"donate{pos}"):
+                    self.on_donated_taint(
+                        node, f"argument {pos}", r.taint, qualname)
+        for k in node.keywords:
+            if k.arg in info.donate_argnames:
+                r = kw_results.get(k.arg)
+                if r is not None and r.taint and self._once(
+                        node, f"donate_{k.arg}"):
+                    self.on_donated_taint(
+                        node, f"argument {k.arg!r}", r.taint, qualname)
+        for pos in info.static_argnums:
+            if pos < len(node.args) and isinstance(
+                    node.args[pos], (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.SetComp,
+                                     ast.DictComp)):
+                if self._once(node, f"static{pos}"):
+                    self.on_unhashable_static(node, f"argument {pos}",
+                                              qualname)
+        for k in node.keywords:
+            if k.arg in info.static_argnames and isinstance(
+                    k.value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                              ast.SetComp, ast.DictComp)):
+                if self._once(node, f"static_{k.arg}"):
+                    self.on_unhashable_static(node, f"argument {k.arg!r}",
+                                              qualname)
+
+
+class _Summary:
+    """Return-value classification of a module-local helper function."""
+
+    def __init__(self, info: JitInfo):
+        self.info = info

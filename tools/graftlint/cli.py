@@ -1,0 +1,155 @@
+"""graftlint CLI.
+
+    python tools/graftlint [paths…] [--json] [--census-json OUT]
+                           [--rules a,b] [--severity rule=level]
+                           [--baseline PATH | --no-baseline]
+                           [--update-baseline REASON]
+
+Exit codes: 0 clean (info-only findings included), 1 any live
+error/warning finding, 2 usage/internal error. `--json` prints ONE
+JSON line to stdout (the repo's tooling contract — bench.py,
+chaos_drill.py); text mode prints one line per finding plus a verdict
+line. The census inventory (`--census-json`) is written regardless of
+the lint verdict, so a failing run still produces the registry seed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from graftlint import engine
+from graftlint.engine import BASELINE_NAME
+from graftlint.rules import ALL_RULES, make_rules
+from graftlint.rules.census import CompileSiteCensusRule
+
+
+def _default_repo() -> str:
+    # tools/graftlint/cli.py -> repo root
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("paths", nargs="*",
+                        help="repo-relative files/dirs to scan "
+                             "(default: the full scan-target set)")
+    parser.add_argument("--repo", default=_default_repo(),
+                        help="repository root (default: auto)")
+    parser.add_argument("--json", action="store_true",
+                        help="one JSON line instead of text")
+    parser.add_argument("--rules",
+                        help=f"comma list from {sorted(ALL_RULES)}")
+    parser.add_argument("--severity", action="append", default=[],
+                        metavar="RULE=LEVEL",
+                        help="override a rule's severity "
+                             "(error|warning|info); repeatable")
+    parser.add_argument("--baseline",
+                        help=f"baseline path (default: <repo>/"
+                             f"{BASELINE_NAME})")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the committed baseline")
+    parser.add_argument("--update-baseline", metavar="REASON",
+                        help="grandfather every live finding into the "
+                             "baseline with REASON, then exit 0")
+    parser.add_argument("--census-json", metavar="OUT",
+                        help="write the compile-site inventory here "
+                             "('-' for stdout)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name, cls in sorted(ALL_RULES.items()):
+            print(f"{name:22s} [{cls.default_severity}] "
+                  f"{cls.description}")
+        return 0
+
+    severities = {}
+    for spec in args.severity:
+        if "=" not in spec:
+            print(f"--severity wants RULE=LEVEL, got {spec!r}",
+                  file=sys.stderr)
+            return 2
+        rule, level = spec.split("=", 1)
+        if level not in engine.SEVERITIES:
+            print(f"unknown severity {level!r} (want one of "
+                  f"{engine.SEVERITIES})", file=sys.stderr)
+            return 2
+        severities[rule] = level
+    rule_names = ([r.strip() for r in args.rules.split(",") if r.strip()]
+                  if args.rules else None)
+    try:
+        rules = make_rules(rule_names, severities)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    repo = os.path.abspath(args.repo)
+    files = None
+    if args.paths:
+        files = engine.iter_scan_files(repo, tuple(args.paths))
+        if not files:
+            print(f"no .py files under {args.paths}", file=sys.stderr)
+            return 2
+
+    baseline = None
+    baseline_path = args.baseline or os.path.join(repo, BASELINE_NAME)
+    if not args.no_baseline:
+        baseline = engine.load_baseline(baseline_path)
+
+    result = engine.run(repo, rules, files=files, baseline=baseline)
+
+    census = next((r for r in rules
+                   if isinstance(r, CompileSiteCensusRule)), None)
+    if args.census_json and census is not None:
+        inv = census.inventory()
+        if args.census_json == "-":
+            print(json.dumps(inv, indent=2, sort_keys=True))
+        else:
+            out = (args.census_json if os.path.isabs(args.census_json)
+                   else os.path.join(repo, args.census_json))
+            with open(out, "w") as f:
+                json.dump(inv, f, indent=2, sort_keys=True)
+                f.write("\n")
+            if not args.json:
+                print(f"census: {inv['n_sites']} compile sites -> "
+                      f"{args.census_json}")
+    elif args.census_json:
+        print("--census-json needs the compile-site-census rule enabled",
+              file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        # New baseline = entries that still match a finding (original
+        # reasons kept; stale ones dropped) + every live finding under
+        # the given reason.
+        existing = {(e["rule"], e["path"], e["fingerprint"]):
+                    e.get("reason", "") for e in (baseline or [])}
+        entries = [
+            {"rule": f.rule, "path": f.path, "fingerprint": f.fingerprint,
+             "reason": existing.get((f.rule, f.path, f.fingerprint),
+                                    args.update_baseline),
+             "severity": f.severity, "message": f.message}
+            for f in result.baselined + result.findings
+        ]
+        entries.sort(key=lambda e: (e["path"], e["rule"], e["fingerprint"]))
+        with open(baseline_path, "w") as f:
+            json.dump({"version": 1, "entries": entries}, f, indent=2)
+            f.write("\n")
+        print(f"baseline updated: {len(result.findings)} new finding(s) "
+              f"grandfathered, {len(result.baselined)} kept, "
+              f"{len(result.stale_baseline)} stale dropped -> "
+              f"{os.path.relpath(baseline_path, repo)}")
+        return 0
+
+    if args.json:
+        print(result.as_json_line())
+    else:
+        print(result.render_text())
+    return 0 if result.ok else 1

@@ -1,0 +1,341 @@
+"""graftlint rule engine: scan set, suppressions, baseline, verdicts.
+
+The contract every rule plugs into:
+
+- A rule produces `Finding`s with a **fingerprint** — a stable identity
+  that deliberately excludes line numbers (rule-specific: enclosing
+  qualname + kind + occurrence index), so the committed baseline
+  survives unrelated edits shifting lines.
+
+- `# graftlint: disable=<rule>[,<rule>…] -- <reason>` on the finding's
+  line suppresses it. The reason is REQUIRED: a disable comment without
+  one does not suppress, and is itself reported (rule `suppression`).
+  `disable=all` suppresses every rule on the line.
+
+- `graftlint_baseline.json` at the repo root grandfathers pre-existing
+  findings: entries are `{rule, path, fingerprint, reason}` (reason
+  required here too). A matched finding is demoted to "baselined"; an
+  entry matching nothing is reported as stale (informational — stale
+  entries never fail the run, so deleting dead code never breaks CI).
+
+- Exit semantics: any live (unsuppressed, unbaselined) finding of
+  severity `error` or `warning` fails; `info` findings never do.
+
+Output modes match the repo's tooling contract: human text to stdout,
+or `--json` as ONE JSON line (the bench/chaos_drill convention).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from graftlint import astutil
+
+# Scanned roots, repo-relative (ISSUE 11: every package directory plus
+# the top-level entry points). tests/ is excluded on purpose — the lint
+# corpus under tests/data/ reproduces the historical bugs and would
+# light up any scan that included it.
+SCAN_TARGETS: Tuple[str, ...] = (
+    "cyclegan_tpu",
+    "tools",
+    "bench.py",
+    "bench_scaling.py",
+    "bench_serve.py",
+    "main.py",
+    "translate.py",
+    "scaling_model.py",
+    "__graft_entry__.py",
+)
+
+SEVERITIES = ("error", "warning", "info")
+
+BASELINE_NAME = "graftlint_baseline.json"
+
+_DISABLE_RE = re.compile(
+    r"graftlint:\s*disable=([A-Za-z0-9_,\-]+)(?:\s*--\s*(\S.*))?")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str        # repo-relative
+    line: int
+    severity: str    # "error" | "warning" | "info"
+    message: str
+    fingerprint: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.severity}: "
+                f"[{self.rule}] {self.message}")
+
+
+class Module:
+    """One parsed scan unit handed to every rule."""
+
+    def __init__(self, repo: str, rel: str, source: str):
+        self.repo = repo
+        self.rel = rel
+        self.path = os.path.join(repo, rel)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)  # SyntaxError handled by caller
+        self.imports = astutil.build_import_map(self.tree)
+        self.comments = astutil.comment_map(source)
+
+    def raw_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def segment(self, node: ast.AST, limit: int = 160) -> str:
+        try:
+            text = ast.get_source_segment(self.source, node) or ""
+        except Exception:
+            text = ""
+        text = " ".join(text.split())
+        return text[:limit] + ("…" if len(text) > limit else "")
+
+
+class Rule:
+    """Base class. `name` is the id used in disable= comments and the
+    baseline; `default_severity` is what findings carry unless the rule
+    (or a CLI override) says otherwise."""
+
+    name: str = ""
+    description: str = ""
+    default_severity: str = "error"
+
+    def __init__(self, severity: Optional[str] = None):
+        self.severity = severity or self.default_severity
+
+    def check(self, module: Module) -> List[Finding]:
+        raise NotImplementedError
+
+    def finalize(self, repo: str) -> List[Finding]:
+        """Called once after every module; for whole-repo rules."""
+        return []
+
+
+# ------------------------------------------------------------ scan set
+
+
+def iter_scan_files(repo: str,
+                    targets: Sequence[str] = SCAN_TARGETS) -> List[str]:
+    """Repo-relative .py files under the scan targets, sorted, test and
+    cache dirs excluded."""
+    out: List[str] = []
+    for target in targets:
+        abs_t = os.path.join(repo, target)
+        if os.path.isfile(abs_t) and target.endswith(".py"):
+            out.append(target)
+            continue
+        if not os.path.isdir(abs_t):
+            continue
+        for root, dirs, files in os.walk(abs_t):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git", "tests"))
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    out.append(os.path.relpath(os.path.join(root, name),
+                                               repo))
+    return sorted(set(out))
+
+
+# -------------------------------------------------------- suppressions
+
+
+def parse_suppressions(
+        comments: Dict[int, str]) -> Tuple[Dict[int, set], List[Tuple[int, str]]]:
+    """-> ({line: {rule, …}}, [(line, rules-str) for reasonless disables]).
+
+    A disable without `-- <reason>` suppresses nothing and is reported.
+    """
+    active: Dict[int, set] = {}
+    bad: List[Tuple[int, str]] = []
+    for line, text in comments.items():
+        m = _DISABLE_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        if not m.group(2):
+            bad.append((line, ",".join(sorted(rules))))
+            continue
+        active.setdefault(line, set()).update(rules)
+    return active, bad
+
+
+# ------------------------------------------------------------ baseline
+
+
+def load_baseline(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    entries = data.get("entries", []) if isinstance(data, dict) else data
+    out = []
+    for e in entries:
+        if not isinstance(e, dict):
+            continue
+        if not all(k in e for k in ("rule", "path", "fingerprint")):
+            continue
+        out.append(e)
+    return out
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   reason: str) -> None:
+    """Grandfather `findings` (used by --update-baseline)."""
+    entries = [
+        {"rule": f.rule, "path": f.path, "fingerprint": f.fingerprint,
+         "reason": reason, "severity": f.severity, "message": f.message}
+        for f in sorted(findings, key=lambda f: (f.path, f.rule, f.line))
+    ]
+    with open(path, "w") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=2)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------- run
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]           # live (fail CI if error/warning)
+    suppressed: List[Finding]
+    baselined: List[Finding]
+    stale_baseline: List[dict]
+    files_scanned: int
+    rules_run: List[str]
+
+    @property
+    def ok(self) -> bool:
+        return not any(f.severity in ("error", "warning")
+                       for f in self.findings)
+
+    def as_json_line(self) -> str:
+        counts: Dict[str, int] = {}
+        for f in self.findings:
+            counts[f.severity] = counts.get(f.severity, 0) + 1
+        return json.dumps({
+            "tool": "graftlint",
+            "ok": self.ok,
+            "files_scanned": self.files_scanned,
+            "rules": self.rules_run,
+            "counts": counts,
+            "n_suppressed": len(self.suppressed),
+            "n_baselined": len(self.baselined),
+            "n_stale_baseline": len(self.stale_baseline),
+            "findings": [f.as_dict() for f in self.findings],
+        }, sort_keys=True)
+
+    def render_text(self) -> str:
+        out = []
+        for f in sorted(self.findings,
+                        key=lambda f: (f.path, f.line, f.rule)):
+            out.append(f.render())
+        n_fail = sum(1 for f in self.findings
+                     if f.severity in ("error", "warning"))
+        verdict = "PASSED" if self.ok else "FAILED"
+        out.append(
+            f"graftlint {verdict}: {self.files_scanned} files, "
+            f"{len(self.rules_run)} rules "
+            f"({', '.join(self.rules_run)}); "
+            f"{n_fail} finding(s), {len(self.suppressed)} suppressed, "
+            f"{len(self.baselined)} baselined"
+        )
+        if self.stale_baseline:
+            out.append(
+                f"  note: {len(self.stale_baseline)} stale baseline "
+                f"entr{'y' if len(self.stale_baseline) == 1 else 'ies'} "
+                f"(matched nothing — safe to drop):")
+            for e in self.stale_baseline[:20]:
+                out.append(f"    {e['path']}: [{e['rule']}] "
+                           f"{e['fingerprint']}")
+        return "\n".join(out)
+
+
+def run(repo: str, rules: Sequence[Rule],
+        files: Optional[Sequence[str]] = None,
+        baseline: Optional[Sequence[dict]] = None) -> LintResult:
+    repo = os.path.abspath(repo)
+    rels = list(files) if files is not None else iter_scan_files(repo)
+    raw: List[Finding] = []
+    suppressed: List[Finding] = []
+    per_file_suppressions: Dict[str, Dict[int, set]] = {}
+
+    for rel in rels:
+        path = os.path.join(repo, rel)
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                source = f.read()
+            module = Module(repo, rel, source)
+        except OSError as e:
+            raw.append(Finding("parse", rel, 0, "error",
+                               f"unreadable: {e}", "parse:unreadable"))
+            continue
+        except SyntaxError as e:
+            raw.append(Finding("parse", rel, e.lineno or 0, "error",
+                               f"syntax error: {e.msg}",
+                               "parse:syntax-error"))
+            continue
+        except ValueError as e:  # e.g. null bytes in source
+            raw.append(Finding("parse", rel, 0, "error",
+                               f"unparseable: {e}", "parse:unparseable"))
+            continue
+        active, bad = parse_suppressions(module.comments)
+        per_file_suppressions[rel] = active
+        for line, rules_str in bad:
+            raw.append(Finding(
+                "suppression", rel, line, "error",
+                f"graftlint disable={rules_str} without a reason — "
+                f"suppressions require `-- <reason>` and this one "
+                f"suppresses nothing",
+                f"suppression:{rules_str}#{line}"))
+        for rule in rules:
+            raw.extend(rule.check(module))
+    for rule in rules:
+        raw.extend(rule.finalize(repo))
+
+    # Apply same-line suppressions (reason already validated).
+    live: List[Finding] = []
+    for f in raw:
+        rules_here = per_file_suppressions.get(f.path, {}).get(f.line, set())
+        if f.rule != "suppression" and (
+                f.rule in rules_here or "all" in rules_here):
+            suppressed.append(f)
+        else:
+            live.append(f)
+
+    # Apply the baseline: one entry grandfathers one finding.
+    baselined: List[Finding] = []
+    stale: List[dict] = []
+    if baseline:
+        index: Dict[Tuple[str, str, str], List[dict]] = {}
+        for e in baseline:
+            index.setdefault(
+                (e["rule"], e["path"], e["fingerprint"]), []).append(e)
+        remaining: List[Finding] = []
+        for f in live:
+            bucket = index.get((f.rule, f.path, f.fingerprint))
+            if bucket:
+                bucket.pop()
+                baselined.append(f)
+            else:
+                remaining.append(f)
+        live = remaining
+        for bucket in index.values():
+            stale.extend(bucket)
+
+    return LintResult(
+        findings=live, suppressed=suppressed, baselined=baselined,
+        stale_baseline=stale, files_scanned=len(rels),
+        rules_run=[r.name for r in rules])

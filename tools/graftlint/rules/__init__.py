@@ -1,0 +1,34 @@
+"""graftlint rule registry."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+from graftlint.engine import Rule
+from graftlint.rules.census import CompileSiteCensusRule
+from graftlint.rules.donation import DonationAliasingRule
+from graftlint.rules.nosync import NoSyncRule
+from graftlint.rules.tracer import TracerLeakRule
+
+ALL_RULES: Dict[str, Type[Rule]] = {
+    r.name: r for r in (
+        DonationAliasingRule,
+        NoSyncRule,
+        TracerLeakRule,
+        CompileSiteCensusRule,
+    )
+}
+
+
+def make_rules(names: Optional[List[str]] = None,
+               severities: Optional[Dict[str, str]] = None) -> List[Rule]:
+    """Instantiate rules by name (all by default), with optional
+    per-rule severity overrides (`{"tracer-leak": "warning"}`)."""
+    severities = severities or {}
+    unknown = set(names or ()) - set(ALL_RULES)
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s) {sorted(unknown)}; "
+            f"available: {sorted(ALL_RULES)}")
+    chosen = names if names is not None else list(ALL_RULES)
+    return [ALL_RULES[n](severity=severities.get(n)) for n in chosen]

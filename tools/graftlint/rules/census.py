@@ -1,0 +1,143 @@
+"""compile-site census: every program-construction site in the tree.
+
+ROADMAP item 5 wants one AOT program registry keyed by (module, shape
+bucket, dtype, mesh, impl flags); before it can be built, someone has
+to know where programs are constructed TODAY. This rule enumerates
+every `jax.jit` / `partial(jax.jit, …)` / `.lower(…)` / `.compile()` /
+`shard_map` construction site — recognized semantically through the
+FlowWalker (so `re.compile` and `str.lower` never count, while
+`lower_forward(…).compile()` does, via the module-local helper
+summary) — and records its keying evidence: donated/static argument
+specs and the source text of the call's arguments and keywords, which
+is where the shape bucket, dtype, mesh, and impl flags live at today's
+ad-hoc sites.
+
+Two outputs:
+
+- The machine inventory (`inventory()` / `--census-json`), committed as
+  docs/compile_sites_r01.json to seed the registry.
+- One **warning** finding per site not covered by the registry
+  allowlist (tools/graftlint/registry_allowlist.json — intentionally
+  empty until the registry exists). Warnings, not errors, for now: the
+  current sites are grandfathered in graftlint_baseline.json, so the
+  effect is purely prospective — a NEW compile site fails CI until it
+  is either registered (once the registry lands) or consciously
+  baselined with a reason. That is the discipline ROADMAP item 5 needs
+  to stop the site count multiplying under it.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional
+
+from graftlint.astutil import FlowWalker, JitInfo
+from graftlint.engine import Finding, Module, Rule
+
+ALLOWLIST_REL = os.path.join("tools", "graftlint",
+                             "registry_allowlist.json")
+
+
+def load_allowlist(repo: str) -> set:
+    """Site keys (`path::kind::enclosing#occ`) the future AOT program
+    registry owns. Empty until ROADMAP item 5 builds it."""
+    path = os.path.join(repo, ALLOWLIST_REL)
+    if not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        data = json.load(f)
+    return set(data.get("sites", []))
+
+
+class _CensusWalker(FlowWalker):
+    def __init__(self, module: Module, rule: "CompileSiteCensusRule"):
+        super().__init__(module.tree, module.imports)
+        self.module = module
+        self.rule = rule
+        self.sites: List[dict] = []
+        self._occ: Dict[str, int] = {}
+
+    def on_compile_site(self, kind: str, node: ast.AST,
+                        info: Optional[JitInfo], qualname: str) -> None:
+        enclosing = qualname or "<module>"
+        okey = f"{kind}:{enclosing}"
+        occ = self._occ[okey] = self._occ.get(okey, 0) + 1
+        site = {
+            "path": self.module.rel,
+            "line": node.lineno,
+            "kind": kind,
+            "enclosing": enclosing,
+            "occurrence": occ,
+            "call": self.module.segment(node, limit=200),
+        }
+        if info is not None:
+            if info.donate_argnums:
+                site["donate_argnums"] = list(info.donate_argnums)
+            if info.donate_argnames:
+                site["donate_argnames"] = list(info.donate_argnames)
+            if info.static_argnums:
+                site["static_argnums"] = list(info.static_argnums)
+            if info.static_argnames:
+                site["static_argnames"] = list(info.static_argnames)
+        if isinstance(node, ast.Call):
+            args = [self.module.segment(a, limit=60) for a in node.args]
+            if args:
+                site["args"] = args
+            kw = {k.arg: self.module.segment(k.value, limit=60)
+                  for k in node.keywords if k.arg}
+            if kw:
+                site["keywords"] = kw
+        self.sites.append(site)
+
+
+def site_key(site: dict) -> str:
+    return (f"{site['path']}::{site['kind']}::{site['enclosing']}"
+            f"#{site['occurrence']}")
+
+
+class CompileSiteCensusRule(Rule):
+    name = "compile-site-census"
+    description = ("inventory of jit/lower/compile/shard_map construction "
+                   "sites; sites outside the AOT registry allowlist warn")
+    default_severity = "warning"
+
+    def __init__(self, severity: Optional[str] = None):
+        super().__init__(severity)
+        self.sites: List[dict] = []
+        self._allowlist: Optional[set] = None
+
+    def check(self, module: Module) -> List[Finding]:
+        if self._allowlist is None:
+            self._allowlist = load_allowlist(module.repo)
+        walker = _CensusWalker(module, self)
+        walker.run()
+        self.sites.extend(walker.sites)
+        findings = []
+        for site in walker.sites:
+            key = site_key(site)
+            if key in self._allowlist:
+                continue
+            findings.append(Finding(
+                self.name, module.rel, site["line"], self.severity,
+                f"{site['kind']} construction site in "
+                f"`{site['enclosing']}` is outside the AOT program-"
+                f"registry allowlist (ROADMAP item 5): `{site['call'][:80]}`"
+                f" — register it, or baseline with a reason",
+                fingerprint=(f"census:{site['kind']}:{site['enclosing']}"
+                             f"#{site['occurrence']}")))
+        return findings
+
+    def inventory(self) -> dict:
+        kinds: Dict[str, int] = {}
+        for s in self.sites:
+            kinds[s["kind"]] = kinds.get(s["kind"], 0) + 1
+        return {
+            "tool": "graftlint",
+            "rule": self.name,
+            "n_sites": len(self.sites),
+            "by_kind": kinds,
+            "sites": sorted(self.sites,
+                            key=lambda s: (s["path"], s["line"])),
+        }

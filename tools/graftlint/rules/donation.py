@@ -1,0 +1,77 @@
+"""donation-aliasing rule: XLA must own every buffer it is donated.
+
+The two worst bugs in this codebase's history were the same static
+pattern ("malloc(): largebin double linked list corrupted"):
+
+- PR-8: orbax/tensorstore-restored checkpoint state reached the train
+  step — which donates its state argument — without a deep copy.
+  Donating a buffer tensorstore still manages let XLA write into (and
+  free) memory it did not own: every post-resume save was NaN-corrupt
+  and the process intermittently died in glibc heap asserts. The fix is
+  checkpoint._rebuffer.
+- PR-10: the elastic reshard path did `device_get` → `device_put` and
+  handed the placed leaves to the donating step. On CPU BOTH hops can
+  be zero-copy, so the "placed" array aliased the restored buffer —
+  the identical corruption, one abstraction higher. The fix routes
+  every leaf through `jnp.copy`.
+
+This rule is the dataflow generalization: an intraprocedural pass
+(astutil.FlowWalker) tracks values originating from checkpoint
+restores, `np.asarray`/`np.frombuffer` host buffers, and
+`jax.device_get` gathers — through assignments, containers,
+tree flatten/unflatten, `device_put`, and method derivations — and
+flags any such value reaching an argument position its callee donates
+(`donate_argnums`/`donate_argnames` on `jax.jit`, through
+`.lower().compile()` chains and module-local helper summaries), unless
+it passed through a sanctioned re-buffering op (`jnp.copy` /
+`_rebuffer`), which launders the taint by construction.
+
+Both historical patterns are pinned pre-fix in
+tests/data/lint_corpus/; the post-fix shapes in the live tree analyze
+clean. Scope is intraprocedural by design — unknown calls launder
+taint (precision over recall), and cross-module flows are the chaos
+drills' job, not this rule's.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from graftlint.astutil import FlowWalker, JitInfo
+from graftlint.engine import Finding, Module, Rule
+
+
+class _DonationWalker(FlowWalker):
+    def __init__(self, module: Module, rule: "DonationAliasingRule"):
+        super().__init__(module.tree, module.imports)
+        self.module = module
+        self.rule = rule
+        self.findings: List[Finding] = []
+        self._occ: dict = {}
+
+    def on_donated_taint(self, node: ast.Call, where: str, origin: str,
+                         qualname: str) -> None:
+        callee = self.module.segment(node.func, limit=60)
+        key = f"donation:{qualname or '<module>'}:{where}"
+        k = self._occ[key] = self._occ.get(key, 0) + 1
+        self.findings.append(Finding(
+            self.rule.name, self.module.rel, node.lineno,
+            self.rule.severity,
+            f"value from {origin} reaches donated {where} of `{callee}` "
+            f"without re-buffering (route it through jnp.copy or "
+            f"checkpoint._rebuffer — donating a buffer XLA does not own "
+            f"corrupts the heap; see docs/DESIGN.md §Static discipline)",
+            fingerprint=f"{key}#{k}"))
+
+
+class DonationAliasingRule(Rule):
+    name = "donation-aliasing"
+    description = ("host-owned / possibly-aliased buffers must not reach "
+                   "donate_argnums call sites without jnp.copy/_rebuffer")
+    default_severity = "error"
+
+    def check(self, module: Module) -> List[Finding]:
+        walker = _DonationWalker(module, self)
+        walker.run()
+        return walker.findings

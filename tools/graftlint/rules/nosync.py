@@ -1,0 +1,259 @@
+"""no-sync rule: the hot path stays asynchronous — now on the AST.
+
+Semantics are tools/check_no_sync.py's (that script is a thin wrapper
+over this module since graftlint landed), with its token-scanner blind
+spots fixed:
+
+1. `block_until_ready` is forbidden everywhere in the hot-path table —
+   as a method (`x.block_until_ready()`), the top-level function
+   (`jax.block_until_ready(x)`), an aliased import
+   (`from jax import block_until_ready as wait`), or a bare reference
+   (`f = x.block_until_ready`). It is both a sync AND a lie through the
+   remote-TPU tunnel (docs/TPU_RUNBOOK.md ground rule 4).
+2. `device_get` is forbidden except on lines carrying a
+   `sanctioned-fetch` marker comment, and only in files whose table
+   entry allows sanctioned fetches at all. Aliased imports
+   (`from jax import device_get as g`) are resolved and flagged — the
+   token scanner's known false-negative class. Names inside string
+   literals and comments never flag — its false-positive class (the AST
+   has no string-literal identifiers by construction).
+
+The hot-path table (files + directories with per-entry sanction
+policy) lives here, moved verbatim from check_no_sync.py — one source
+of truth for the wrapper, this rule, and the tier-1 test.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import tokenize
+from typing import List, Optional, Tuple
+
+from graftlint import astutil
+from graftlint.engine import Finding, Module, Rule
+
+FORBIDDEN_ALWAYS = ("block_until_ready",)
+FORBIDDEN_UNSANCTIONED = ("device_get",)
+SANCTION_MARKER = "sanctioned-fetch"
+
+# (path, allow_sanctioned_fetches)
+HOT_PATH_FILES: List[Tuple[str, bool]] = [
+    ("cyclegan_tpu/train/loop.py", True),
+    # The epoch-services worker exists to take host I/O OFF the dispatch
+    # path; a device fetch on it would re-serialize the boundary it
+    # overlaps (callers hand it already-fetched host copies).
+    ("cyclegan_tpu/utils/services.py", False),
+    # Both gradient engines (combined jax.grad and the fusedprop vjp
+    # path) build traced-only code; any host fetch here would run once
+    # per step inside the dispatch chain. Zero sanctioned sites.
+    ("cyclegan_tpu/train/steps.py", False),
+    # Elastic recovery: the module's ONE sanctioned site class is the
+    # restore-time gather in reshard_to_plan (before any dispatch
+    # exists); the breaker/emergency-save paths that run DURING the
+    # loop must stay fetch-free. Overrides the resil/ directory default
+    # below (explicit file entries win over directory expansion).
+    ("cyclegan_tpu/resil/elastic.py", True),
+]
+
+# Directories whose EVERY .py file is hot-path. Scanned as a directory
+# (not a file list) so a new module is covered the day it lands:
+# - obs (no sanctioned sites): telemetry only timestamps fetches the
+#   loop performs.
+# - ops/pallas (no sanctioned sites): kernel wrappers run INSIDE the
+#   fused train step — a host sync there would serialize every dispatch.
+# - serve / serve/fleet (sanctioned sites allowed): the pipeline's one
+#   deferred D2H per flush lives on the completer/replica thread behind
+#   a marker; anything else would re-serialize the pipeline. Listed
+#   separately because directory scans are deliberately non-recursive.
+# - resil (no sanctioned sites by default): recovery machinery is pure
+#   host-side orchestration; elastic.py alone carries a file entry.
+HOT_PATH_DIRS: List[Tuple[str, bool]] = [
+    ("cyclegan_tpu/obs", False),
+    ("cyclegan_tpu/ops/pallas", False),
+    ("cyclegan_tpu/serve", True),
+    ("cyclegan_tpu/serve/fleet", True),
+    ("cyclegan_tpu/resil", False),
+]
+
+
+def hot_path_entries(repo: str) -> List[Tuple[str, bool]]:
+    """The static file list plus every .py under the hot-path dirs,
+    deduplicated with explicit HOT_PATH_FILES entries taking precedence
+    over directory expansion (a file may need a different sanction
+    policy than its directory's default). A missing directory is
+    reported as a missing file entry (the check must fail loudly, not
+    silently shrink)."""
+    policy = {rel: allow for rel, allow in HOT_PATH_FILES}
+    order = [rel for rel, _ in HOT_PATH_FILES]
+    for rel, allow in HOT_PATH_DIRS:
+        d = os.path.join(repo, rel)
+        if not os.path.isdir(d):
+            if rel not in policy:
+                policy[rel] = allow
+                order.append(rel)
+            continue
+        for name in sorted(os.listdir(d)):
+            if not name.endswith(".py"):
+                continue
+            sub = os.path.join(rel, name)
+            if sub not in policy:
+                policy[sub] = allow
+                order.append(sub)
+    return [(rel, policy[rel]) for rel in order]
+
+
+# --------------------------------------------------------------- core scan
+
+
+def _ast_hits(source: str) -> Optional[List[Tuple[int, str]]]:
+    """[(line, token)] for every real reference to a forbidden name;
+    None if the file does not parse (caller falls back to tokens).
+
+    A "reference" is an Attribute access with the forbidden name, or a
+    Name that an import alias resolves to `jax.<forbidden>` — never a
+    string literal, comment, or unrelated identifier that merely
+    contains the token as a substring.
+    """
+    try:
+        tree = ast.parse(source)
+    except (SyntaxError, ValueError):
+        return None
+    imports = astutil.build_import_map(tree)
+    watched = FORBIDDEN_ALWAYS + FORBIDDEN_UNSANCTIONED
+    hits: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in watched:
+            hits.append((node.lineno, node.attr))
+        elif isinstance(node, ast.Name):
+            resolved = imports.get(node.id)
+            if resolved and "." in resolved:
+                tail = resolved.rsplit(".", 1)[1]
+                if tail in watched and resolved.startswith("jax"):
+                    hits.append((node.lineno, tail))
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            # `from jax import device_get` puts the name in scope even
+            # unaliased; the import line itself is the first reference.
+            for a in node.names:
+                if a.name in watched and isinstance(node, ast.ImportFrom):
+                    hits.append((node.lineno, a.name))
+    return hits
+
+
+def _token_hits(source: str) -> List[Tuple[int, str]]:
+    """Fallback for unparseable files: the original token scan
+    (conservative — flags any code-token mention, still never strings
+    or comments when the tokenizer survives, raw lines otherwise)."""
+    lines: dict = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type in (tokenize.COMMENT, tokenize.STRING, tokenize.NL,
+                            tokenize.NEWLINE, tokenize.INDENT,
+                            tokenize.DEDENT):
+                continue
+            row = tok.start[0]
+            lines[row] = lines.get(row, "") + " " + tok.string
+    except (tokenize.TokenError, IndentationError):
+        for i, raw in enumerate(source.splitlines(), 1):
+            lines[i] = raw
+    hits: List[Tuple[int, str]] = []
+    for row, code in sorted(lines.items()):
+        for tok in FORBIDDEN_ALWAYS + FORBIDDEN_UNSANCTIONED:
+            if tok in code:
+                hits.append((row, tok))
+    return hits
+
+
+def scan_source(source: str, allow_sanctioned: bool) -> List[Tuple[int, str, str]]:
+    """-> [(line, token, verdict-message)] for every violation.
+
+    Deduplicated per (line, token) — the historical per-line verdict
+    granularity check_no_sync.py's callers (and its tier-1 test) pin.
+    """
+    hits = _ast_hits(source)
+    if hits is None:
+        hits = _token_hits(source)
+    raw_lines = source.splitlines()
+    seen = set()
+    out: List[Tuple[int, str, str]] = []
+    for row, tok in sorted(hits):
+        if (row, tok) in seen:
+            continue
+        seen.add((row, tok))
+        raw = raw_lines[row - 1] if row <= len(raw_lines) else ""
+        if tok in FORBIDDEN_ALWAYS:
+            out.append((row, tok, f"forbidden sync `{tok}` in the hot path"))
+            continue
+        if allow_sanctioned and SANCTION_MARKER in raw:
+            continue
+        where = ("missing `# sanctioned-fetch` marker"
+                 if allow_sanctioned
+                 else "no sanctioned sites exist in obs/")
+        out.append((row, tok,
+                    f"`{tok}` outside the sanctioned fetch window ({where})"))
+    return out
+
+
+def check_file_violations(path: str, allow_sanctioned: bool) -> List[str]:
+    """check_no_sync.py's `check_file` body: message strings with the
+    historical format, for byte-compatible wrapper output."""
+    with open(path) as f:
+        source = f.read()
+    return [f"{path}:{row}: {msg}"
+            for row, _tok, msg in scan_source(source, allow_sanctioned)]
+
+
+def run_check(repo: str) -> List[str]:
+    """check_no_sync.py's `run_check` body (historical message format)."""
+    violations: List[str] = []
+    for rel, allow in hot_path_entries(repo):
+        path = os.path.join(repo, rel)
+        if not os.path.exists(path):
+            violations.append(f"{rel}: hot-path file missing")
+            continue
+        violations.extend(check_file_violations(path, allow))
+    return violations
+
+
+# ------------------------------------------------------------- the rule
+
+
+class NoSyncRule(Rule):
+    name = "no-sync"
+    description = ("hot-path files stay asynchronous: block_until_ready "
+                   "forbidden, device_get only at sanctioned-fetch sites")
+    default_severity = "error"
+
+    def __init__(self, severity: Optional[str] = None):
+        super().__init__(severity)
+        self._policy_cache: Optional[dict] = None
+
+    def _policy(self, repo: str) -> dict:
+        if self._policy_cache is None:
+            self._policy_cache = dict(hot_path_entries(repo))
+        return self._policy_cache
+
+    def check(self, module: Module) -> List[Finding]:
+        policy = self._policy(module.repo)
+        if module.rel not in policy:
+            return []
+        allow = policy[module.rel]
+        findings = []
+        occ: dict = {}
+        for row, tok, msg in scan_source(module.source, allow):
+            k = occ[tok] = occ.get(tok, 0) + 1
+            findings.append(Finding(
+                self.name, module.rel, row, self.severity, msg,
+                fingerprint=f"no-sync:{tok}#{k}"))
+        return findings
+
+    def finalize(self, repo: str) -> List[Finding]:
+        out = []
+        for rel, _allow in hot_path_entries(repo):
+            if not os.path.exists(os.path.join(repo, rel)):
+                out.append(Finding(
+                    self.name, rel, 0, self.severity,
+                    "hot-path file missing",
+                    fingerprint="no-sync:missing"))
+        return out

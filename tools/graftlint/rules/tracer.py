@@ -1,0 +1,237 @@
+"""tracer-leak / retrace-hazard rule.
+
+Applies to functions with direct evidence of being traced — decorated
+with `@jax.jit` / `@partial(jax.jit, …)`, or passed by name to a
+`jax.jit(…)` call in the same module (the FlowWalker reports both).
+Traced parameters are the function's parameters minus
+`static_argnums`/`static_argnames`.
+
+Checks, in decreasing severity:
+
+- **tracer-leak** (error): host control flow on a traced value — an
+  `if`/`while`/`assert` test whose truthiness depends on a traced
+  parameter (`if x:` raises TracerBoolConversionError at trace time);
+  `float()`/`int()`/`bool()`/`complex()` of a traced value; `.item()` /
+  `.tolist()` on one. Static inspections are exempt: any use reaching
+  the test only through `.shape`/`.ndim`/`.dtype`/`.size`/`.aval`/
+  `.sharding`, through `len()`/`isinstance()`/`hasattr()`, or under an
+  `is`/`is not` comparison stays host-side by construction.
+- **numpy-on-tracer** (error): a `np.*` call with a traced argument —
+  NumPy either raises a ConcretizationError or silently pulls the value
+  to host, serializing the dispatch either way.
+- **retrace** (warning): `jax.jit` constructed inside a loop body (a
+  fresh jit object per iteration throws away the trace cache —
+  including the closure-capture variant, where a lambda or nested def
+  re-created per iteration bakes loop-varying Python scalars into each
+  new program), and list/dict/set literals passed at
+  `static_argnums`/`static_argnames` positions (unhashable — TypeError
+  at call time). These are flagged where the walker sees the call.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from graftlint.astutil import FlowWalker, JitInfo, resolve
+from graftlint.engine import Finding, Module, Rule
+
+# Attribute accesses on a traced value that stay host-side (static
+# metadata, not the value).
+SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+              "weak_type", "nbytes", "itemsize"}
+SAFE_CALLS = {"len", "isinstance", "hasattr", "getattr", "type", "id",
+              "repr", "str"}
+CONCRETIZING_CASTS = {"float", "int", "bool", "complex"}
+CONCRETIZING_METHODS = {"item", "tolist", "__bool__", "__float__",
+                        "__int__"}
+
+
+def _param_names(funcdef) -> List[str]:
+    a = funcdef.args
+    return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+            + [p.arg for p in a.kwonlyargs]
+            + ([a.vararg.arg] if a.vararg else [])
+            + ([a.kwarg.arg] if a.kwarg else []))
+
+
+def _traced_params(funcdef, info: JitInfo) -> Set[str]:
+    pos = [p.arg for p in funcdef.args.posonlyargs] + [
+        p.arg for p in funcdef.args.args]
+    static = set(info.static_argnames)
+    for i in info.static_argnums:
+        if i < len(pos):
+            static.add(pos[i])
+    return {p for p in _param_names(funcdef) if p not in static}
+
+
+class _TraceScan:
+    """Walk one jitted function body looking for concretizations of its
+    traced parameters."""
+
+    def __init__(self, module: Module, rule: "TracerLeakRule",
+                 funcdef, info: JitInfo, qualname: str):
+        self.module = module
+        self.rule = rule
+        self.funcdef = funcdef
+        self.qualname = qualname
+        self.traced = _traced_params(funcdef, info)
+        self.findings: List[Finding] = []
+        self._occ: dict = {}
+
+    # -- traced-value reachability ---------------------------------------
+    def _is_concretizing_use(self, node: ast.AST) -> bool:
+        """Does evaluating `node`'s truthiness/value concretize a traced
+        parameter? True iff a traced Name appears NOT protected by a
+        static-metadata access."""
+        return self._scan_expr(node)
+
+    def _scan_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.traced
+        if isinstance(node, ast.Attribute):
+            if node.attr in SAFE_ATTRS:
+                return False
+            return self._scan_expr(node.value)
+        if isinstance(node, ast.Call):
+            fname = node.func.id if isinstance(node.func, ast.Name) else None
+            if fname in SAFE_CALLS:
+                return False
+            if isinstance(node.func, ast.Attribute):
+                # x.astype(...), jnp.sum(x): traced-in, traced-out — the
+                # call RESULT is a tracer, so the truthiness hazard
+                # remains; keep scanning into receiver and args.
+                pass
+            return any(self._scan_expr(c) for c in ast.iter_child_nodes(node)
+                       if isinstance(c, ast.expr))
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False  # identity checks never concretize
+            return (self._scan_expr(node.left)
+                    or any(self._scan_expr(c) for c in node.comparators))
+        if isinstance(node, ast.Subscript):
+            # x[i] of a traced x is a tracer; shape tuples are not.
+            return self._scan_expr(node.value) or self._scan_expr(node.slice)
+        return any(self._scan_expr(c) for c in ast.iter_child_nodes(node)
+                   if isinstance(c, ast.expr))
+
+    def _emit(self, node: ast.AST, kind: str, message: str,
+              severity: Optional[str] = None) -> None:
+        key = f"tracer:{self.qualname}:{kind}"
+        k = self._occ[key] = self._occ.get(key, 0) + 1
+        self.findings.append(Finding(
+            self.rule.name, self.module.rel, node.lineno,
+            severity or self.rule.severity, message,
+            fingerprint=f"{key}#{k}"))
+
+    # -- the walk ---------------------------------------------------------
+    def run(self) -> List[Finding]:
+        shadowed = self.traced.copy()
+        for node in ast.walk(self.funcdef):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not self.funcdef:
+                # Nested defs rebinding a traced name would need scope
+                # tracking; skip their parameter names conservatively.
+                for p in (_param_names(node)
+                          if not isinstance(node, ast.Lambda)
+                          else [a.arg for a in node.args.args]):
+                    shadowed.discard(p)
+        self.traced = shadowed
+        for node in ast.walk(self.funcdef):
+            if isinstance(node, (ast.If, ast.While)):
+                if self._is_concretizing_use(node.test):
+                    self._emit(
+                        node.test, "control-flow",
+                        f"host control flow on a traced value in jitted "
+                        f"`{self.qualname}` — `"
+                        f"{self.module.segment(node.test, 60)}` forces "
+                        f"concretization at trace time (use lax.cond/"
+                        f"jnp.where, or mark the argument static)")
+            elif isinstance(node, ast.Assert):
+                if self._is_concretizing_use(node.test):
+                    self._emit(
+                        node.test, "control-flow",
+                        f"assert on a traced value in jitted "
+                        f"`{self.qualname}` (use checkify or a static "
+                        f"precondition)")
+            elif isinstance(node, ast.Call):
+                fname = (node.func.id
+                         if isinstance(node.func, ast.Name) else None)
+                if fname in CONCRETIZING_CASTS and node.args:
+                    if self._scan_expr(node.args[0]):
+                        self._emit(
+                            node, "cast",
+                            f"`{fname}()` of a traced value in jitted "
+                            f"`{self.qualname}` concretizes at trace "
+                            f"time — every step pays a host sync (keep "
+                            f"it a jnp scalar or mark the arg static)")
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in CONCRETIZING_METHODS):
+                    if self._scan_expr(node.func.value):
+                        self._emit(
+                            node, "item",
+                            f"`.{node.func.attr}()` on a traced value in "
+                            f"jitted `{self.qualname}` — host "
+                            f"concretization inside the traced program")
+                else:
+                    resolved = resolve(node.func, self.module.imports)
+                    if (resolved and resolved.split(".")[0] == "numpy"
+                            and any(self._scan_expr(a)
+                                    for a in node.args)):
+                        self._emit(
+                            node, "numpy",
+                            f"numpy call `{resolved}` on a traced value "
+                            f"in jitted `{self.qualname}` — np.* "
+                            f"concretizes tracers (use jnp.*)")
+        return self.findings
+
+
+class _TracerWalker(FlowWalker):
+    def __init__(self, module: Module, rule: "TracerLeakRule"):
+        super().__init__(module.tree, module.imports)
+        self.module = module
+        self.rule = rule
+        self.findings: List[Finding] = []
+        self._occ: dict = {}
+
+    def _emit(self, node, kind, qualname, message, severity) -> None:
+        key = f"tracer:{qualname or '<module>'}:{kind}"
+        k = self._occ[key] = self._occ.get(key, 0) + 1
+        self.findings.append(Finding(
+            self.rule.name, self.module.rel, node.lineno, severity,
+            message, fingerprint=f"{key}#{k}"))
+
+    def on_jitted_def(self, funcdef, info: JitInfo, qualname: str) -> None:
+        fq = (f"{qualname}.{funcdef.name}"
+              if qualname and not qualname.endswith(funcdef.name)
+              else (qualname or funcdef.name))
+        self.findings.extend(
+            _TraceScan(self.module, self.rule, funcdef, info, fq).run())
+
+    def on_jit_in_loop(self, node, qualname: str) -> None:
+        self._emit(
+            node, "jit-in-loop", qualname,
+            f"jax.jit constructed inside a loop body in "
+            f"`{qualname or '<module>'}` — a fresh jit object per "
+            f"iteration retraces every time (hoist the jit, or close "
+            f"over loop state explicitly)", "warning")
+
+    def on_unhashable_static(self, node, where: str, qualname: str) -> None:
+        self._emit(
+            node, "unhashable-static", qualname,
+            f"unhashable literal passed at static {where} in "
+            f"`{qualname or '<module>'}` — static args must hash "
+            f"(tuple it)", self.rule.severity)
+
+
+class TracerLeakRule(Rule):
+    name = "tracer-leak"
+    description = ("host control flow / concretization on traced values "
+                   "inside jitted functions; jit-in-loop retrace hazards; "
+                   "unhashable static args")
+    default_severity = "error"
+
+    def check(self, module: Module) -> List[Finding]:
+        walker = _TracerWalker(module, self)
+        walker.run()
+        return walker.findings

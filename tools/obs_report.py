@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
 from typing import Dict, List, Optional, Tuple
 
@@ -41,6 +42,32 @@ def load_events(path: str) -> Tuple[List[dict], int]:
             else:
                 skipped += 1
     return events, skipped
+
+
+def load_lint_verdict(jsonl_path: str) -> Optional[dict]:
+    """The graftlint verdict for this run, if the preflight left one.
+
+    chip_autorun writes graftlint's one-line JSON stdout next to the
+    run's other logs; when a `graftlint.json` sits in the telemetry
+    stream's directory, the report notes the static-discipline verdict
+    alongside the runtime sections. Absent or malformed -> None (older
+    runs predate the preflight; the report must still render)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(jsonl_path)),
+                        "graftlint.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "r", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if isinstance(rec, dict) and rec.get("tool") == "graftlint":
+                    return rec
+    except (OSError, ValueError):
+        return None
+    return None
 
 
 def _percentile(vals: List[float], q: float) -> float:
@@ -641,6 +668,21 @@ def render(report: dict) -> str:
               f"p50 {_fmt(row.get('p50_s'))}s / p95 {_fmt(row.get('p95_s'))}s"
               f"  deadline misses: {row.get('deadline_misses', 0)}")
 
+    lint = report.get("lint")
+    if lint:
+        counts = lint.get("counts") or {}
+        detail = (", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+                  or "no live findings")
+        w(f"-- static discipline (graftlint preflight) --")
+        w(f"verdict: {'PASSED' if lint.get('ok') else 'FAILED'}  "
+          f"({lint.get('files_scanned', '?')} files, "
+          f"rules: {', '.join(lint.get('rules') or ['?'])})")
+        w(f"findings: {detail}; {lint.get('n_suppressed', 0)} suppressed, "
+          f"{lint.get('n_baselined', 0)} baselined")
+        for f in (lint.get("findings") or [])[:10]:
+            w(f"  {f.get('path', '?')}:{f.get('line', '?')}: "
+              f"[{f.get('rule', '?')}] {f.get('message', '?')}")
+
     end = report["end"]
     if end:
         w(f"run end: {end.get('status', '?')} at t={_fmt(end.get('t'), '.1f')}s")
@@ -662,6 +704,9 @@ def main(argv=None) -> int:
         print(f"cannot read {args.jsonl}: {e}", file=sys.stderr)
         return 2
     report = fold(events, skipped)
+    lint = load_lint_verdict(args.jsonl)
+    if lint is not None:
+        report["lint"] = lint
     try:
         if args.json:
             print(json.dumps(report, indent=2, default=str))
