@@ -29,6 +29,16 @@ Methodology notes:
   overload point at ~1.8x capacity against a tight admission queue —
   the shed counts must land on `best_effort`/`batch` while
   `interactive` p95 stays near its bound (class-ordered shedding).
+- The autoscale phase replays overload-class traffic as a surge ->
+  sustain -> decay open-loop trace through a fleet that STARTS at one
+  replica with the autoscaler + brownout cascade on: the surge offers
+  ~2x one replica's capacity, so the fleet must grow and degrade tiers
+  before shedding. The record carries per-phase per-class p50/p95, the
+  scale-event timeline, and the brownout census; acceptance (gated by
+  run_compare.py) is interactive p95 during the surge <= the
+  fixed-fleet overload point's interactive p95 with ZERO interactive
+  sheds — the self-driving fleet must do at least as well as static
+  overprovisioning while also draining the backlog.
 
 Prints ONE JSON line to stdout (the bench.py contract); per-config
 detail goes to stderr. Emits the same JSONL obs schema as training
@@ -317,6 +327,101 @@ def bench_fleet_overload(fleet, images, rate: float) -> dict:
     return row
 
 
+class _ScaleTrace:
+    """Logger tee for the autoscale phase: forwards every event to the
+    wrapped obs logger (when one is open) and timestamps the fleet's
+    scale/brownout events against the phase clock, so the one-JSON-line
+    record carries the scale timeline alongside the latency rows."""
+
+    _KINDS = ("fleet_autoscale", "fleet_brownout")
+
+    def __init__(self, inner=None):
+        self._inner = inner
+        self._lock = threading.Lock()
+        self.t0 = time.perf_counter()
+        self.events = []
+
+    def event(self, kind, /, **fields):
+        if kind in self._KINDS:
+            with self._lock:
+                self.events.append(dict(
+                    fields, event=kind,
+                    t_s=round(time.perf_counter() - self.t0, 3)))
+        if self._inner is not None:
+            self._inner.event(kind, **fields)
+
+    def flush(self):
+        if self._inner is not None:
+            self._inner.flush()
+
+
+def bench_fleet_autoscale(fleet, images, phases) -> dict:
+    """Surge -> sustain -> decay open-loop trace through an autoscaled
+    brownout fleet. Each phase offers the overload class mix at its own
+    rate for its own duration; per-class latency and shed counts are
+    kept per phase so the record separates latency DURING the surge
+    (while the autoscaler reacts) from the scaled steady state and the
+    post-decay tail. Phases run back to back over one fleet — the
+    autoscaler's state (replica count, brownout level) carries across
+    the boundaries exactly as it would in production."""
+    from cyclegan_tpu.serve.fleet import DeadlineExceeded, ShedError
+
+    rows = {}
+    for name, rate, dur_s in phases:
+        lock = threading.Lock()
+        lat_by_class = {}
+        shed_by_class = {}
+        threads = []
+
+        def consume(fut, t_sub, klass, lats=lat_by_class,
+                    sheds=shed_by_class, lk=lock):
+            try:
+                res = fut.result(timeout=600)
+            except (ShedError, DeadlineExceeded):
+                with lk:
+                    sheds[klass] = sheds.get(klass, 0) + 1
+                return
+            _encode(res["fake"])
+            with lk:
+                lats.setdefault(klass, []).append(
+                    time.perf_counter() - t_sub)
+
+        t0 = time.perf_counter()
+        i = 0
+        while i / rate < dur_s:
+            target = t0 + i / rate
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            klass = _MIX[i % len(_MIX)]
+            t_sub = time.perf_counter()
+            try:
+                fut = fleet.submit_raw(images[i % len(images)], klass=klass)
+            except ShedError:
+                with lock:
+                    shed_by_class[klass] = shed_by_class.get(klass, 0) + 1
+                i += 1
+                continue
+            th = threading.Thread(target=consume, args=(fut, t_sub, klass),
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+            i += 1
+        for th in threads:
+            th.join(timeout=600)
+        row = {
+            "offered_rate": round(rate, 2),
+            "duration_s": dur_s,
+            "n_offered": i,
+            "shed_by_class": dict(sorted(shed_by_class.items())),
+        }
+        for klass, lats in sorted(lat_by_class.items()):
+            row[f"{klass}_p50_ms"] = round(_percentile(lats, 0.5) * 1e3, 3)
+            row[f"{klass}_p95_ms"] = round(_percentile(lats, 0.95) * 1e3, 3)
+        rows[name] = row
+    return rows
+
+
 def _emit(line: dict) -> None:
     _obs_event("bench_serve_summary", **line)
     print(json.dumps(line), flush=True)
@@ -554,6 +659,90 @@ def main(argv=None) -> int:
             say(f"{key}: overload {rate:.1f}/s -> shed "
                 f"{overload['shed_by_class']}, interactive p95 "
                 f"{overload.get('interactive_p95_ms', float('nan')):.0f} ms")
+
+        # Autoscale phase: the same class mix as a surge -> sustain ->
+        # decay trace through a fleet that STARTS at one replica with
+        # the autoscaler + brownout cascade on. The surge offers ~2x
+        # one replica's measured capacity so the fleet must grow AND
+        # degrade tiers before shedding; sustain holds above one
+        # replica's capacity (the grown fleet is comfortable); decay
+        # drops the load so scale-down retires the extra replica.
+        autoscale_line = None
+        if overload is not None and \
+                time.perf_counter() - t_start <= TIME_BUDGET_S:
+            from cyclegan_tpu.serve.fleet import (
+                AutoscaleConfig,
+                CascadeConfig,
+            )
+
+            trace = _ScaleTrace(_OBS_LOGGER)
+            drain = max(sat["images_per_sec"], 1e-6)
+            # Queue capacity must leave backlog headroom ABOVE the
+            # scale-up trigger (capacity/drain > up_backlog_s), or a
+            # saturated queue sheds while the backlog signal can never
+            # cross the threshold.
+            # Tight coalescing (2 ms) + a 60 ms interactive hedge: the
+            # fleet starts one replica short, so the surge's tail is
+            # exactly where hedged dispatch and a fast scale-up earn
+            # their keep.
+            auto_fleet = FleetExecutor(
+                engine,
+                FleetConfig(
+                    n_replicas=1, capacity=max(int(drain), 64),
+                    max_batch=args.batch, max_wait_ms=2.0,
+                    classes=bench_classes, health_poll_s=0.02,
+                    hedge_ms=60.0,
+                    autoscale=AutoscaleConfig(
+                        min_replicas=1, max_replicas=n_replicas,
+                        eval_s=0.05, hysteresis=2, cooldown_s=1.0,
+                        up_backlog_s=0.1),
+                    cascade=CascadeConfig(
+                        tiers=("base", "int8"), enter_backlog_s=0.05,
+                        exit_backlog_s=0.02, hysteresis=2,
+                        cooldown_s=0.1, shadow_fraction=0.05)),
+                logger=trace)
+            # The surge replays the fixed fleet's overload point — the
+            # SAME offered rate and class mix — so the acceptance
+            # comparison is apples-to-apples: can a fleet that starts
+            # at min_replicas serve the trace a statically-provisioned
+            # 2-replica fleet needed its overload defenses for, without
+            # shedding interactive work or losing its p95? The surge
+            # runs long enough (4 s) that the deliberate cold-start
+            # transient (scale-up takes ~0.2 s) stays below the 95th
+            # percentile instead of BEING it.
+            phase_plan = (("surge", rate, 4.0),
+                          ("sustain", 0.6 * rate, 1.5),
+                          ("decay", 0.15 * rate, 1.5))
+            auto_rows = bench_fleet_autoscale(auto_fleet, images,
+                                              phase_plan)
+            auto_summary = auto_fleet.close()
+            surge = auto_rows.get("surge", {})
+            say(f"{key}: autoscale surge -> interactive p95 "
+                f"{surge.get('interactive_p95_ms', float('nan')):.1f} ms, "
+                f"scale_ups {auto_summary.get('scale_ups')}, "
+                f"scale_downs {auto_summary.get('scale_downs')}, "
+                f"degraded {auto_summary.get('degraded_requests')}")
+            _obs_event("bench", key=key + "/autoscale",
+                       images_per_sec=round(
+                           auto_summary.get("images_per_sec") or 0.0, 4),
+                       platform=platform)
+            autoscale_line = {
+                "min_replicas": 1,
+                "max_replicas": n_replicas,
+                "brownout_enabled": True,
+                "phases": auto_rows,
+                "scale_events": trace.events,
+                "scale_ups": auto_summary.get("scale_ups"),
+                "scale_downs": auto_summary.get("scale_downs"),
+                "degraded_requests": auto_summary.get("degraded_requests"),
+                "degraded_census": auto_summary.get("degraded_census"),
+                "brownout": auto_summary.get("brownout"),
+                "shed": auto_summary.get("shed"),
+                # The acceptance reference: the fixed 2-replica fleet's
+                # interactive p95 at its own overload point above.
+                "fixed_fleet_interactive_p95_ms": overload.get(
+                    "interactive_p95_ms"),
+            }
         fleet_line = {
             "n_replicas": n_replicas,
             "images_per_sec": round(fsat["images_per_sec"], 2),
@@ -571,6 +760,8 @@ def main(argv=None) -> int:
             fleet_line["overload"] = {
                 k: (round(v, 3) if isinstance(v, float) else v)
                 for k, v in overload.items()}
+        if autoscale_line is not None:
+            fleet_line["autoscale"] = autoscale_line
     else:
         say(f"fleet tier skipped (budget {TIME_BUDGET_S:.0f}s)")
 

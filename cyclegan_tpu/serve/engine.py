@@ -32,6 +32,16 @@ what lives in HBM, so the tier trades a bounded output error
 per flush. ``run(..., tier="int8")`` selects it per flush; the fleet
 layer maps deadline classes onto tiers.
 
+The optional **perturb tier** (``ServeConfig(perturb_tier=True)``) is
+the floor of the brownout ladder: the Perturbative-GAN cheap trunk
+(trunk_impl="perturb" — fixed random masks + learned 1x1 combiners,
+~k^2 fewer trunk FLOPs). Its param tree is structurally different from
+the resnet trunk's, so the tier takes its OWN checkpoint
+(``perturb_params``, a distilled/co-trained perturb generator) rather
+than deriving from the base weights the way int8 does. The fleet's
+brownout cascade (serve/fleet/cascade.py) degrades onto it only after
+int8, and only under sustained queue pressure.
+
 No host-device synchronization lives here: ``run`` returns DEVICE
 arrays; the pipelined executor (serve/executor.py) owns the deferred
 D2H fetch. tools/check_no_sync.py scans this directory.
@@ -191,6 +201,8 @@ class ServeConfig:
     ``int8_tier`` compiles a SECOND program per bucket over int8
     weight-only-quantized params (f32 accumulate) — selected per flush
     via ``run(..., tier="int8")``.
+    ``perturb_tier`` compiles a THIRD set over the perturbative cheap
+    trunk; the engine then requires a ``perturb_params`` checkpoint.
     """
 
     batch_buckets: Tuple[int, ...] = DEFAULT_BATCH_BUCKETS
@@ -198,6 +210,7 @@ class ServeConfig:
     dtype: str = "float32"  # "float32" | "bfloat16"
     with_cycle: bool = False
     int8_tier: bool = False
+    perturb_tier: bool = False
 
     def __post_init__(self):
         if self.dtype not in ("float32", "bfloat16"):
@@ -214,6 +227,10 @@ class ServeConfig:
             # combination has no caller and would double compile time.
             raise ValueError("int8_tier with with_cycle is unsupported "
                              "(panel traffic serves from the base tier)")
+        if self.perturb_tier and self.with_cycle:
+            raise ValueError("perturb_tier with with_cycle is "
+                             "unsupported (panel traffic serves from "
+                             "the base tier)")
 
 
 class InferenceEngine:
@@ -225,10 +242,16 @@ class InferenceEngine:
 
     def __init__(self, model_cfg, fwd_params, bwd_params=None, *,
                  serve_cfg: ServeConfig = ServeConfig(), logger=None,
-                 device=None):
+                 device=None, perturb_params=None):
         if serve_cfg.with_cycle and bwd_params is None:
             raise ValueError("with_cycle=True needs the cycle generator's "
                              "params (bwd_params)")
+        if serve_cfg.perturb_tier and perturb_params is None:
+            raise ValueError(
+                "perturb_tier=True needs a perturb-trunk checkpoint "
+                "(perturb_params) — the perturbative generator's param "
+                "tree is structurally different from the resnet trunk's, "
+                "so it cannot be derived from the base weights")
         import contextlib
 
         import jax
@@ -248,6 +271,8 @@ class InferenceEngine:
             fwd_params = jax.device_put(fwd_params, device)
             if bwd_params is not None:
                 bwd_params = jax.device_put(bwd_params, device)
+            if perturb_params is not None:
+                perturb_params = jax.device_put(perturb_params, device)
         # Serving dtype overrides the checkpoint's recorded compute
         # dtype; the param tree is dtype-independent (flax casts at
         # apply time), so the same weights serve both paths.
@@ -307,6 +332,38 @@ class InferenceEngine:
                                     else None),
                             seconds=round(time.perf_counter() - t0, 3),
                         )
+        # The perturb tier: the brownout floor. Its programs trace the
+        # perturbative cheap trunk over its OWN param tree; the bucket
+        # grammar is shared so the fleet's batcher needs no tier-aware
+        # bucketing.
+        self.programs_perturb: Dict[Tuple[int, int], Any] = {}
+        self._perturb_params = None
+        if serve_cfg.perturb_tier:
+            # The perturb trunk cannot ride the scanned trunk (each
+            # block derives a distinct fixed mask from its index) and
+            # has no 3x3 pad sites for the epilogue kernel — coerce
+            # both; everything else inherits the serving config.
+            perturb_cfg = dataclasses.replace(
+                self.model_cfg, trunk_impl="perturb", scan_blocks=False,
+                pad_impl=("fused" if self.model_cfg.pad_impl == "epilogue"
+                          else self.model_cfg.pad_impl))
+            with place():
+                self._perturb_params = perturb_params
+                for size in self._sizes:
+                    for batch in self._batch_buckets:
+                        t0 = time.perf_counter()
+                        self.programs_perturb[(size, batch)] = lower_forward(
+                            perturb_cfg, perturb_params, None, batch,
+                            size, False,
+                        ).compile()
+                        self._event(
+                            "serve_compile", size=size, batch=batch,
+                            dtype=serve_cfg.dtype, tier="perturb",
+                            with_cycle=False,
+                            device=(str(device) if device is not None
+                                    else None),
+                            seconds=round(time.perf_counter() - t0, 3),
+                        )
 
     def _event(self, kind: str, **fields) -> None:
         if self._logger is not None:
@@ -319,14 +376,20 @@ class InferenceEngine:
 
     @property
     def tiers(self) -> Tuple[str, ...]:
-        """Program tiers this engine serves: "base" always, plus "int8"
-        when the quantized set was compiled."""
-        return ("base", "int8") if self.programs_int8 else ("base",)
+        """Program tiers this engine serves, cheapest last: "base"
+        always, plus "int8"/"perturb" when those sets were compiled.
+        The brownout cascade reads this as its degradation ladder."""
+        tiers = ["base"]
+        if self.programs_int8:
+            tiers.append("int8")
+        if self.programs_perturb:
+            tiers.append("perturb")
+        return tuple(tiers)
 
     def resolve_tier(self, tier: Optional[str]) -> str:
         """Normalize a request's tier tag. None / "base" / the base
-        dtype name all mean the base tier; "int8" requires the tier to
-        have been compiled."""
+        dtype name all mean the base tier; "int8"/"perturb" require the
+        tier to have been compiled."""
         if tier in (None, "base", self.serve_cfg.dtype):
             return "base"
         if tier == "int8":
@@ -335,6 +398,13 @@ class InferenceEngine:
                     "int8 tier requested but the engine was built "
                     "without it (ServeConfig(int8_tier=True))")
             return "int8"
+        if tier == "perturb":
+            if not self.programs_perturb:
+                raise ValueError(
+                    "perturb tier requested but the engine was built "
+                    "without it (ServeConfig(perturb_tier=True) + "
+                    "perturb_params)")
+            return "perturb"
         raise ValueError(f"unknown serving tier {tier!r} "
                          f"(have {self.tiers})")
 
@@ -396,6 +466,9 @@ class InferenceEngine:
         if tier == "int8":
             program = self.programs_int8[(size, bucket)]
             return (program(self._fwd_params_int8, batch_np),), n
+        if tier == "perturb":
+            program = self.programs_perturb[(size, bucket)]
+            return (program(self._perturb_params, batch_np),), n
         program = self.programs[(size, bucket)]
         if self.serve_cfg.with_cycle:
             outs = program(self._fwd_params, self._bwd_params, batch_np)

@@ -2,7 +2,7 @@
 queue — the layer that turns the PR-3 single-replica pipeline into
 something that can face overload without falling over.
 
-Four pieces, one per production failure mode:
+Six pieces, one per production failure mode:
 
 - classes.py    — priority/deadline classes (interactive / batch /
                   best_effort): every request carries an absolute
@@ -28,16 +28,38 @@ Four pieces, one per production failure mode:
                   deadline passed), the worker is respawned, and a
                   replica failing repeatedly is circuit-broken out of
                   the fleet (fleet_replica_down / fleet_recovery
-                  events).
+                  events). The same monitor evaluates the autoscaler,
+                  the brownout pressure tick, hedge deadlines, and the
+                  p95 quarantine.
+- autoscale.py  — the fleet-sizing decision core: drain/arrival EWMAs
+                  and the deadline-miss rollup in, "up"/"down"/hold
+                  out, with hysteresis + cooldown so it never flaps;
+                  actuated through the PR-8 respawn machinery.
+- cascade.py    — the brownout tier cascade: degrade request tiers
+                  class-by-class (f32 -> int8 -> perturb) under queue
+                  pressure BEFORE shedding, governed by a quality
+                  budget a sampled shadow-probe thread enforces.
 
 tools/check_no_sync.py scans this package as hot-path: the replica's
-one deferred fetch per flush is the only sanctioned device_get.
+one deferred fetch per flush and the quality probe's off-path shadow
+fetch are the only sanctioned device_gets.
 """
 
 from cyclegan_tpu.serve.fleet.admission import (
     AdmissionController,
     DeadlineExceeded,
+    FleetRequest,
     ShedError,
+)
+from cyclegan_tpu.serve.fleet.autoscale import (
+    Autoscaler,
+    AutoscaleConfig,
+    FleetSignals,
+)
+from cyclegan_tpu.serve.fleet.cascade import (
+    BrownoutController,
+    CascadeConfig,
+    QualityProbe,
 )
 from cyclegan_tpu.serve.fleet.classes import (
     DEFAULT_CLASSES,
@@ -49,11 +71,18 @@ from cyclegan_tpu.serve.fleet.replica import ReplicaCrashed, ReplicaWorker
 
 __all__ = [
     "AdmissionController",
+    "AutoscaleConfig",
+    "Autoscaler",
+    "BrownoutController",
+    "CascadeConfig",
     "DEFAULT_CLASSES",
     "DeadlineClass",
     "DeadlineExceeded",
     "FleetConfig",
     "FleetExecutor",
+    "FleetRequest",
+    "FleetSignals",
+    "QualityProbe",
     "ReplicaCrashed",
     "ReplicaWorker",
     "ShedError",

@@ -71,7 +71,8 @@ class FleetRequest:
     EDF orders by."""
 
     __slots__ = ("image", "size", "tier", "klass", "future", "t_submit",
-                 "deadline", "shed", "attempts")
+                 "deadline", "shed", "attempts", "hedged", "is_hedge",
+                 "won", "result", "probe", "degraded_from")
 
     def __init__(self, image, size: int, tier: str,
                  klass: DeadlineClass, now: Optional[float] = None):
@@ -89,6 +90,32 @@ class FleetRequest:
         # order stay honest), and FleetConfig.max_request_attempts
         # bounds how often a possibly-poisonous request may be retried.
         self.attempts = 0
+        # Hedged-dispatch bookkeeping. A primary that sat past its hedge
+        # deadline gets `hedged=True` and a `twin()` copy re-enqueued;
+        # the twin carries `is_hedge=True` and SHARES the future, so the
+        # first replica to resolve wins and the loser's set_result is a
+        # no-op. `won` marks the copy whose set_result actually landed
+        # (hedge win/loss accounting); `result` keeps the winner's host
+        # output long enough for the brownout quality probe to sample
+        # it. `probe` marks synthetic quarantine-probe work (excluded
+        # from rollups and crash re-enqueueing); `degraded_from` records
+        # the full tier a browned-out request was routed away from.
+        self.hedged = False
+        self.is_hedge = False
+        self.won = False
+        self.result = None
+        self.probe = False
+        self.degraded_from: Optional[str] = None
+
+    def twin(self) -> "FleetRequest":
+        """The hedge copy: same image, routing key, class, ORIGINAL
+        t_submit/deadline (EDF order and latency accounting stay
+        honest), and the same future object — first resolution wins."""
+        t = FleetRequest(self.image, self.size, self.tier, self.klass,
+                         now=self.t_submit)
+        t.future = self.future
+        t.is_hedge = True
+        return t
 
 
 class AdmissionController:
@@ -111,10 +138,15 @@ class AdmissionController:
         self.n_admitted: Dict[str, int] = {}
         self.n_shed: Dict[str, int] = {}      # class -> evict+reject count
         self.shed_reasons: Dict[str, int] = {}
+        self.n_cancelled: Dict[str, int] = {}  # pop-time drops, by reason
         # drain-rate EWMA (images/sec) feeding Retry-After estimates;
         # primed pessimistically so a cold queue suggests a real backoff.
         self._drain_rate = 1.0
         self._t_last_drain: Optional[float] = None
+        # arrival-rate EWMA (requests/sec) over inter-arrival gaps — the
+        # autoscaler's demand signal, paired with the drain rate above.
+        self._arrival_rate = 0.0
+        self._t_last_arrival: Optional[float] = None
 
     # -- producer side ----------------------------------------------------
     def offer(self, req: FleetRequest) -> Future:
@@ -140,12 +172,23 @@ class AdmissionController:
                 self._event("fleet_shed", klass=victim.klass.name,
                             reason="evicted", depth=self._live,
                             evicted_for=req.klass.name,
+                            hedge=victim.is_hedge,
                             retry_after_s=round(retry, 3))
-                victim.future.set_exception(
-                    ShedError("evicted", retry, victim.klass.name))
+                # A hedge twin shares its future with a primary that is
+                # still in flight — evicting the twin must only reclaim
+                # the slot, never fail the caller. Same for a future a
+                # racing replica already resolved.
+                if not victim.is_hedge and not victim.future.done():
+                    victim.future.set_exception(
+                        ShedError("evicted", retry, victim.klass.name))
             heapq.heappush(self._heap, (req.deadline, self._seq, req))
             self._seq += 1
             self._live += 1
+            now = time.perf_counter()
+            if self._t_last_arrival is not None:
+                dt = max(now - self._t_last_arrival, 1e-6)
+                self._arrival_rate += 0.3 * (1.0 / dt - self._arrival_rate)
+            self._t_last_arrival = now
             if self._live > self.max_depth:
                 self.max_depth = self._live
             self.n_admitted[req.klass.name] = \
@@ -170,14 +213,21 @@ class AdmissionController:
 
     # -- consumer side (the dispatcher) -----------------------------------
     def next_batch(self, max_n: int, max_wait_s: float,
-                   poll_s: float = 0.05) -> Optional[List[FleetRequest]]:
+                   poll_s: float = 0.05,
+                   idle_return_s: Optional[float] = None) \
+            -> Optional[List[FleetRequest]]:
         """Block until a batch is releasable, then pop up to ``max_n``
         requests in EDF order, all sharing the head's (size, tier)
         routing key. Release happens when the matching run can fill
         ``max_n`` slots, or when the EDF head has waited ``max_wait_s``
         since submission. Returns None only after close() with the
-        queue fully drained."""
+        queue fully drained. ``idle_return_s`` bounds how long an EMPTY
+        queue may hold the caller: past it, return [] so the dispatcher
+        can re-examine the replica it is holding (a scale-down or
+        quarantine mark must not wait for the next request to arrive
+        before taking effect)."""
         deadline_of_head = None
+        t_enter = time.perf_counter()
         while True:
             with self._lock:
                 self._compact_locked()
@@ -185,7 +235,13 @@ class AdmissionController:
                 if head is None:
                     if self._closed:
                         return None
-                    self._nonempty.wait(timeout=poll_s)
+                    if (idle_return_s is not None
+                            and time.perf_counter() - t_enter
+                            >= idle_return_s):
+                        return []
+                    self._nonempty.wait(
+                        timeout=(poll_s if idle_return_s is None
+                                 else min(poll_s, idle_return_s)))
                     continue
                 now = time.perf_counter()
                 matching = sum(
@@ -223,14 +279,35 @@ class AdmissionController:
             req = entry[2]
             if req.shed:
                 continue
+            if req.future.done():
+                # Cancelled at the batcher: the hedge counterpart
+                # already resolved this future (or recovery failed it) —
+                # dispatching the copy would be pure wasted compute.
+                self._live -= 1
+                self._count_cancel("won_elsewhere")
+                self._event("fleet_hedge_cancel", klass=req.klass.name,
+                            reason="won_elsewhere", depth=self._live)
+                continue
+            if req.is_hedge and now > req.deadline:
+                # The expiry-asymmetry fix: a hedged request whose
+                # deadline passed must not be dispatched TWICE past it.
+                # The twin dies silently here (no exception — the future
+                # is shared); the primary alone serves late, exactly
+                # like an un-hedged expired request of its class.
+                self._live -= 1
+                self._count_cancel("hedge_expired")
+                self._event("fleet_hedge_cancel", klass=req.klass.name,
+                            reason="hedge_expired", depth=self._live)
+                continue
             if now > req.deadline and req.klass.shed_rank > 0:
                 self._live -= 1
                 self._count_shed(req.klass.name, "expired")
                 self._event("fleet_shed", klass=req.klass.name,
                             reason="expired", depth=self._live)
-                req.future.set_exception(DeadlineExceeded(
-                    f"class {req.klass.name} deadline passed while "
-                    f"queued ({now - req.deadline:.3f}s late)"))
+                if not req.future.done():
+                    req.future.set_exception(DeadlineExceeded(
+                        f"class {req.klass.name} deadline passed while "
+                        f"queued ({now - req.deadline:.3f}s late)"))
                 continue
             if (req.size, req.tier) != (head.size, head.tier):
                 putback.append(entry)
@@ -263,6 +340,21 @@ class AdmissionController:
         with self._lock:
             return self._retry_after_locked()
 
+    def rates(self) -> Tuple[int, float, float]:
+        """(depth, drain_rate, arrival_rate) — the autoscaler's and the
+        brownout controller's pressure signals, one lock hit. The
+        arrival EWMA only updates on arrivals, so a silent queue would
+        report its last busy-hour rate forever; cap it by the rate the
+        current silence itself implies (1/gap) so demand decays the
+        moment traffic stops."""
+        with self._lock:
+            arrival = self._arrival_rate
+            if self._t_last_arrival is not None:
+                gap = time.perf_counter() - self._t_last_arrival
+                if gap > 1e-9:
+                    arrival = min(arrival, 1.0 / gap)
+            return self._live, self._drain_rate, arrival
+
     # -- shutdown / snapshots ---------------------------------------------
     def close(self) -> None:
         """Stop admitting; queued requests drain normally (next_batch
@@ -285,12 +377,18 @@ class AdmissionController:
                 "admitted": dict(self.n_admitted),
                 "shed": dict(self.n_shed),
                 "shed_reasons": dict(self.shed_reasons),
+                "cancelled": dict(self.n_cancelled),
+                "drain_rate": round(self._drain_rate, 4),
+                "arrival_rate": round(self._arrival_rate, 4),
                 "retry_after_s": round(self._retry_after_locked(), 3),
             }
 
     def _count_shed(self, klass: str, reason: str) -> None:
         self.n_shed[klass] = self.n_shed.get(klass, 0) + 1
         self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+
+    def _count_cancel(self, reason: str) -> None:
+        self.n_cancelled[reason] = self.n_cancelled.get(reason, 0) + 1
 
     def _event(self, kind: str, **fields) -> None:
         if self._logger is not None:

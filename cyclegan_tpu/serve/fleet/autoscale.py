@@ -1,0 +1,191 @@
+"""The fleet autoscaler: a pure decision core over queue-rate signals.
+
+The fixed-N fleet's only overload response is shedding; this module
+owns the *grow/shrink* decision so capacity tracks demand instead.
+Design split, deliberately:
+
+- **This file is signal -> decision only.** ``Autoscaler.observe``
+  consumes one ``FleetSignals`` snapshot (admission depth, drain-rate
+  EWMA, arrival-rate EWMA, the per-class deadline-miss rollup folded to
+  a counter, circuit-breaker count) plus a caller-supplied clock, and
+  returns ``"up"``, ``"down"``, or ``None``. No threads, no replica
+  handles, no wall-clock reads — the state machine is exhaustively
+  testable with synthetic signals and a fake ``now``
+  (tests/test_autoscale.py).
+- **Actuation lives in the controller.** FleetExecutor evaluates the
+  autoscaler on its monitor cadence and actuates through the SAME slot
+  machinery PR-8's crash recovery uses: scale-up revives a retired slot
+  (or appends a fresh one) via the respawn path, scale-down marks a
+  replica ``retiring`` and the dispatcher only stops it once it
+  surfaces free — i.e. after its in-flight flush fully drained.
+
+Anti-flap discipline, both required before any action fires:
+
+- **Hysteresis**: the over/under-provisioned condition must hold for
+  ``hysteresis`` CONSECUTIVE evaluations; a single noisy snapshot (one
+  burst admitted between two polls) moves a streak counter, not the
+  fleet.
+- **Cooldown**: at least ``cooldown_s`` between scale events, in either
+  direction. A scale-up changes the very signals the next decision
+  reads (drain rate climbs as the new replica warms); acting again
+  before the signals re-equilibrate is how autoscalers oscillate.
+
+Circuit-breaker interaction: a slot whose circuit just opened means
+replicas are *dying*, not that the fleet is under-provisioned — feeding
+that capacity loss straight into scale-up would respawn poisoned slots
+faster than the breaker retires them. A circuits_open increase
+suppresses scale-up for ``breaker_holdoff_s`` and resets the up-streak.
+
+Host-side arithmetic only (tools/check_no_sync.py scans this package).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSignals:
+    """One snapshot of the pressure signals the autoscaler reads.
+    Counters (deadline_misses, circuits_open) are cumulative — the
+    state machine diffs them between observations."""
+
+    queue_depth: int
+    drain_rate: float       # images/sec EWMA (admission on_complete)
+    arrival_rate: float     # requests/sec EWMA (admission offer)
+    deadline_misses: int    # cumulative, all classes
+    circuits_open: int      # cumulative open breaker count
+    n_active: int           # replicas currently taking traffic
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """Autoscaler knobs. Defaults are sized for the CPU toy geometry's
+    sub-second flushes; on-chip deployments mostly stretch cooldown_s
+    (docs/TPU_RUNBOOK.md §Overload playbook has sizing guidance)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    eval_s: float = 0.1          # decision cadence (controller-driven)
+    hysteresis: int = 2          # consecutive evals before acting
+    cooldown_s: float = 2.0      # min seconds between scale events
+    # Scale-up pressure: backlog would take this long to drain at the
+    # measured rate, OR arrivals outpace drain by this ratio while
+    # anything is queued, OR the deadline-miss rollup grew.
+    up_backlog_s: float = 0.5
+    up_arrival_ratio: float = 1.2
+    # Scale-down safety: queue empty AND the remaining n-1 replicas
+    # could absorb the measured arrival rate with this headroom factor.
+    down_margin: float = 1.5
+    # Scale-up suppression window after a circuit opens (see module
+    # docstring — capacity lost to the breaker is not demand).
+    breaker_holdoff_s: float = 5.0
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {self.min_replicas}")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas ({self.max_replicas}) must be >= "
+                f"min_replicas ({self.min_replicas})")
+        if self.eval_s <= 0:
+            raise ValueError(f"eval_s must be > 0, got {self.eval_s}")
+        if self.hysteresis < 1:
+            raise ValueError(
+                f"hysteresis must be >= 1, got {self.hysteresis}")
+        if self.cooldown_s < 0:
+            raise ValueError(
+                f"cooldown_s must be >= 0, got {self.cooldown_s}")
+        if self.up_backlog_s <= 0 or self.up_arrival_ratio <= 1.0:
+            raise ValueError(
+                "up_backlog_s must be > 0 and up_arrival_ratio > 1.0")
+        if self.down_margin < 1.0:
+            raise ValueError(
+                f"down_margin must be >= 1.0, got {self.down_margin}")
+        if self.breaker_holdoff_s < 0:
+            raise ValueError(
+                f"breaker_holdoff_s must be >= 0, "
+                f"got {self.breaker_holdoff_s}")
+
+
+class Autoscaler:
+    """The decision state machine. One instance per fleet; observe() is
+    called from a single thread (the controller's monitor)."""
+
+    def __init__(self, cfg: AutoscaleConfig):
+        self.cfg = cfg
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_scale_t: Optional[float] = None
+        self._last_misses: Optional[int] = None
+        self._last_circuits: Optional[int] = None
+        self._breaker_until: Optional[float] = None
+        # Telemetry mirrors (read by the controller's stats()).
+        self.n_evals = 0
+
+    def observe(self, sig: FleetSignals, now: float) -> Optional[str]:
+        """One evaluation: returns "up", "down", or None (hold). A
+        returned decision resets its streak and stamps the cooldown —
+        the caller is expected to actuate it."""
+        cfg = self.cfg
+        self.n_evals += 1
+        miss_delta = (0 if self._last_misses is None
+                      else sig.deadline_misses - self._last_misses)
+        circuit_delta = (0 if self._last_circuits is None
+                         else sig.circuits_open - self._last_circuits)
+        self._last_misses = sig.deadline_misses
+        self._last_circuits = sig.circuits_open
+        if circuit_delta > 0:
+            self._breaker_until = now + cfg.breaker_holdoff_s
+            self._up_streak = 0
+
+        backlog_s = sig.queue_depth / max(sig.drain_rate, 1e-6)
+        overloaded = (
+            backlog_s > cfg.up_backlog_s
+            or (sig.queue_depth > 0
+                and sig.arrival_rate > cfg.up_arrival_ratio * sig.drain_rate)
+            or miss_delta > 0)
+        idle = (
+            sig.queue_depth == 0
+            and sig.n_active > cfg.min_replicas
+            and sig.arrival_rate * cfg.down_margin
+            < sig.drain_rate * (sig.n_active - 1) / max(sig.n_active, 1))
+
+        if overloaded:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif idle:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = 0
+            self._down_streak = 0
+
+        cooling = (self._last_scale_t is not None
+                   and now - self._last_scale_t < cfg.cooldown_s)
+        held_by_breaker = (self._breaker_until is not None
+                           and now < self._breaker_until)
+        if (overloaded and self._up_streak >= cfg.hysteresis
+                and not cooling and not held_by_breaker
+                and sig.n_active < cfg.max_replicas):
+            self._last_scale_t = now
+            self._up_streak = 0
+            return "up"
+        if (idle and self._down_streak >= cfg.hysteresis
+                and not cooling and sig.n_active > cfg.min_replicas):
+            self._last_scale_t = now
+            self._down_streak = 0
+            return "down"
+        return None
+
+    def snapshot(self) -> dict:
+        """Host-side state for /stats and the close() rollup."""
+        return {
+            "up_streak": self._up_streak,
+            "down_streak": self._down_streak,
+            "last_scale_t": self._last_scale_t,
+            "breaker_holdoff_active": self._breaker_until is not None,
+            "n_evals": self.n_evals,
+        }

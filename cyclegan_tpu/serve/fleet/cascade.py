@@ -1,0 +1,352 @@
+"""Brownout tier cascade: degrade before you shed.
+
+Shedding a request costs its caller everything; serving it one tier
+cheaper costs a bounded quality delta. So under rising queue pressure
+the fleet FIRST walks request classes down the engine's tier ladder
+(f32 "base" -> weight-quantized "int8" -> the perturbative cheap
+trunk "perturb"), class-by-class from the most sheddable, and only
+sheds once the ladder is exhausted and the queue still overflows.
+docs/DESIGN.md carries the full argument.
+
+Mechanics, mirroring the autoscaler's pure-core split:
+
+- **BrownoutController** is the decision state machine. Its *plan* is
+  the flattened (class, ladder-step) sequence — depth-first per class
+  in ``degrade_order``, so best_effort rides the ladder to the floor
+  before batch is touched, and interactive is degraded last of all.
+  ``update(backlog_s, now)`` raises/lowers the active level with the
+  same hysteresis + cooldown discipline as autoscaling; ``tier_for``
+  maps a request's class and resolved tier to the (possibly cheaper)
+  tier it will actually serve on. Never upgrades: an explicit int8
+  request stays int8 when the brownout clears.
+- **Quality budget**: the level is additionally clamped by a cap the
+  probe owns. A deterministic 1-in-N sample of degraded requests is
+  re-run on the full tier by the **QualityProbe** thread (off the
+  dispatch path, bounded queue, drops under pressure — shedding shadow
+  work during overload is the point of sampling). The cheap-vs-full
+  mean-abs delta feeds an EWMA, run_compare-style: drift past
+  ``quality_budget`` NARROWS the brownout (cap shrinks, level clamps
+  down with it); sustained headroom WIDENS it back.
+
+The probe's ``jax.device_get`` is a sanctioned fetch: it runs on the
+probe's own thread against sampled shadow work, never on the dispatch
+or replica paths (tools/check_no_sync.py scans this package).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class CascadeConfig:
+    """Brownout knobs. ``tiers`` is the full cheap-ward ladder; the
+    controller intersects it with what the engine actually compiled."""
+
+    tiers: Tuple[str, ...] = ("base", "int8", "perturb")
+    degrade_order: Tuple[str, ...] = ("best_effort", "batch",
+                                      "interactive")
+    # Pressure thresholds on backlog_s = depth / drain_rate. Enter is
+    # deliberately far below the autoscaler's up_backlog_s default:
+    # brownout is the fast, cheap response; adding a replica is the
+    # slow, structural one.
+    enter_backlog_s: float = 0.25
+    exit_backlog_s: float = 0.05
+    hysteresis: int = 2
+    cooldown_s: float = 0.5
+    # Quality budget: shadow-sample 1 in round(1/shadow_fraction)
+    # degraded requests; narrow when the delta EWMA exceeds
+    # quality_budget, re-widen when it sits below widen_ratio * budget.
+    shadow_fraction: float = 0.05
+    quality_budget: float = 0.05
+    widen_ratio: float = 0.25
+    probe_ewma_alpha: float = 0.3
+    probe_cooldown_s: float = 0.5
+    probe_queue_max: int = 16
+
+    def __post_init__(self):
+        if len(self.tiers) < 2:
+            raise ValueError(
+                f"cascade needs a ladder of >= 2 tiers, got {self.tiers}")
+        if len(set(self.tiers)) != len(self.tiers):
+            raise ValueError(f"duplicate tiers in ladder {self.tiers}")
+        if not self.degrade_order:
+            raise ValueError("degrade_order must name >= 1 class")
+        if not (0 < self.exit_backlog_s < self.enter_backlog_s):
+            raise ValueError(
+                "need 0 < exit_backlog_s < enter_backlog_s, got "
+                f"exit={self.exit_backlog_s} enter={self.enter_backlog_s}")
+        if self.hysteresis < 1:
+            raise ValueError(
+                f"hysteresis must be >= 1, got {self.hysteresis}")
+        if self.cooldown_s < 0 or self.probe_cooldown_s < 0:
+            raise ValueError("cooldowns must be >= 0")
+        if not (0 <= self.shadow_fraction <= 1):
+            raise ValueError(
+                f"shadow_fraction must be in [0, 1], "
+                f"got {self.shadow_fraction}")
+        if self.quality_budget <= 0:
+            raise ValueError(
+                f"quality_budget must be > 0, got {self.quality_budget}")
+        if not (0 < self.widen_ratio < 1):
+            raise ValueError(
+                f"widen_ratio must be in (0, 1), got {self.widen_ratio}")
+        if not (0 < self.probe_ewma_alpha <= 1):
+            raise ValueError(
+                f"probe_ewma_alpha must be in (0, 1], "
+                f"got {self.probe_ewma_alpha}")
+        if self.probe_queue_max < 1:
+            raise ValueError(
+                f"probe_queue_max must be >= 1, "
+                f"got {self.probe_queue_max}")
+
+
+class BrownoutController:
+    """Pressure -> brownout level, quality probe -> level cap.
+
+    Thread model: ``update`` runs on the fleet monitor, ``tier_for`` /
+    ``take_sample`` on submitting and replica threads, ``note_probe``
+    on the QualityProbe thread — one internal lock covers the lot (all
+    O(1) arithmetic, nothing device-side).
+    """
+
+    def __init__(self, cfg: CascadeConfig, ladder: Sequence[str],
+                 class_names: Sequence[str]):
+        ladder = tuple(ladder)
+        if len(ladder) < 2:
+            raise ValueError(
+                f"brownout needs >= 2 available tiers to cascade "
+                f"across, got {ladder} — compile a cheap tier "
+                f"(int8/perturb) or disable --brownout")
+        for t in ladder:
+            if t not in cfg.tiers:
+                raise ValueError(
+                    f"available tier {t!r} not in the configured ladder "
+                    f"{cfg.tiers}")
+        self.cfg = cfg
+        self.ladder = ladder
+        # The degrade plan: depth-first per class — each entry is one
+        # class's next step down the ladder; level L activates plan[:L].
+        self._plan = [cls
+                      for cls in cfg.degrade_order if cls in class_names
+                      for _ in range(len(ladder) - 1)]
+        self.max_level = len(self._plan)
+        self._lock = threading.Lock()
+        self._level = 0
+        self._cap = self.max_level
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_change_t: Optional[float] = None
+        self._last_cap_t: Optional[float] = None
+        self._ewma: Optional[float] = None
+        self._sample_counter = 0
+        self._period = (max(1, int(round(1.0 / cfg.shadow_fraction)))
+                        if cfg.shadow_fraction > 0 else 0)
+        # Telemetry (all under _lock).
+        self.n_probes = 0
+        self.n_narrowed = 0
+        self.n_widened = 0
+
+    # -- pressure side (monitor thread) ------------------------------------
+    def update(self, backlog_s: float, now: float) -> Optional[int]:
+        """One pressure evaluation; returns the new level when it
+        changed, else None. Hysteresis + cooldown exactly as in
+        autoscale.py; the quality cap clamps from above immediately
+        (a busted budget must not wait out a streak)."""
+        cfg = self.cfg
+        with self._lock:
+            if self._level > self._cap:
+                self._level = self._cap
+                self._last_change_t = now
+                return self._level
+            if backlog_s > cfg.enter_backlog_s:
+                self._up_streak += 1
+                self._down_streak = 0
+            elif backlog_s < cfg.exit_backlog_s:
+                self._down_streak += 1
+                self._up_streak = 0
+            else:
+                self._up_streak = 0
+                self._down_streak = 0
+            cooling = (self._last_change_t is not None
+                       and now - self._last_change_t < cfg.cooldown_s)
+            if cooling:
+                return None
+            if (self._up_streak >= cfg.hysteresis
+                    and self._level < self._cap):
+                self._level += 1
+                self._up_streak = 0
+                self._last_change_t = now
+                return self._level
+            if self._down_streak >= cfg.hysteresis and self._level > 0:
+                self._level -= 1
+                self._down_streak = 0
+                self._last_change_t = now
+                return self._level
+            return None
+
+    # -- routing side (submit path) ----------------------------------------
+    def steps_for(self, class_name: str) -> int:
+        with self._lock:
+            return self._plan[:self._level].count(class_name)
+
+    def tier_for(self, class_name: str, resolved_tier: str) -> str:
+        """The tier a request of this class actually serves on under
+        the current brownout level. Off-ladder tiers pass through
+        untouched; on-ladder tiers only ever move cheap-ward."""
+        steps = self.steps_for(class_name)
+        if steps == 0 or resolved_tier not in self.ladder:
+            return resolved_tier
+        i = self.ladder.index(resolved_tier)
+        return self.ladder[min(i + steps, len(self.ladder) - 1)]
+
+    def take_sample(self) -> bool:
+        """Deterministic 1-in-N shadow sampling of degraded requests
+        (counter-based, not random: reproducible under test and evenly
+        spread under load)."""
+        if self._period == 0:
+            return False
+        with self._lock:
+            self._sample_counter += 1
+            return self._sample_counter % self._period == 0
+
+    # -- quality side (probe thread) ---------------------------------------
+    def note_probe(self, delta: float, now: float) -> Optional[str]:
+        """Fold one cheap-vs-full delta into the EWMA and move the
+        quality cap: "narrow" when the budget is blown, "widen" when
+        there is sustained headroom, None to hold."""
+        cfg = self.cfg
+        with self._lock:
+            self.n_probes += 1
+            self._ewma = (delta if self._ewma is None else
+                          self._ewma
+                          + cfg.probe_ewma_alpha * (delta - self._ewma))
+            cooling = (self._last_cap_t is not None
+                       and now - self._last_cap_t < cfg.probe_cooldown_s)
+            if cooling:
+                return None
+            if self._ewma > cfg.quality_budget and self._cap > 0:
+                self._cap -= 1
+                self.n_narrowed += 1
+                self._last_cap_t = now
+                return "narrow"
+            if (self._ewma < cfg.widen_ratio * cfg.quality_budget
+                    and self._cap < self.max_level):
+                self._cap += 1
+                self.n_widened += 1
+                self._last_cap_t = now
+                return "widen"
+            return None
+
+    # -- snapshots ---------------------------------------------------------
+    @property
+    def level(self) -> int:
+        with self._lock:
+            return self._level
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            active = self._plan[:self._level]
+            return {
+                "level": self._level,
+                "max_level": self.max_level,
+                "quality_cap": self._cap,
+                "ladder": list(self.ladder),
+                "steps_by_class": {c: active.count(c) for c in set(active)},
+                "delta_ewma": (round(self._ewma, 6)
+                               if self._ewma is not None else None),
+                "n_probes": self.n_probes,
+                "n_narrowed": self.n_narrowed,
+                "n_widened": self.n_widened,
+            }
+
+
+class QualityProbe:
+    """The shadow re-run worker: sampled (image, full-tier, cheap
+    output) jobs in, cheap-vs-full deltas into the BrownoutController.
+
+    One daemon thread, bounded inbox — ``submit`` never blocks a
+    replica thread; jobs past the bound are dropped and counted (the
+    shadow fraction is a budget, not a guarantee, and overload is
+    exactly when dropping shadows is correct).
+    """
+
+    _STOP = object()
+
+    def __init__(self, engine, brownout: BrownoutController, *,
+                 logger=None, maxsize: Optional[int] = None):
+        self.engine = engine
+        self.brownout = brownout
+        self._logger = logger
+        self._q: "queue.Queue" = queue.Queue(
+            maxsize or brownout.cfg.probe_queue_max)
+        self.n_submitted = 0
+        self.n_dropped = 0
+        self.n_run = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="fleet-quality-probe")
+        self._thread.start()
+
+    def submit(self, image, size: int, full_tier: str,
+               cheap_fake) -> bool:
+        """Enqueue one shadow job; False = dropped (inbox full)."""
+        self.n_submitted += 1
+        try:
+            self._q.put_nowait((image, size, full_tier, cheap_fake))
+            return True
+        except queue.Full:
+            self.n_dropped += 1
+            return False
+
+    def close(self, timeout: float = 10.0) -> bool:
+        self._q.put(self._STOP)
+        self._thread.join(timeout=timeout)
+        return not self._thread.is_alive()
+
+    def _run(self) -> None:
+        import jax
+
+        while True:
+            job = self._q.get()
+            if job is self._STOP:
+                return
+            image, size, full_tier, cheap_fake = job
+            try:
+                outs, _ = self.engine.run(np.stack([image]), size=size,
+                                          tier=full_tier)
+                host = jax.device_get(outs)  # sanctioned-fetch: off-path shadow re-run, probe thread only
+            except Exception:  # noqa: BLE001 — a failed shadow is a lost sample, nothing more
+                continue
+            full_fake = np.asarray(host[0][0], np.float32)
+            delta = float(np.mean(np.abs(
+                full_fake - np.asarray(cheap_fake, np.float32))))
+            verdict = self.brownout.note_probe(delta, time.perf_counter())
+            self.n_run += 1
+            if self._logger is not None:
+                snap = self.brownout.snapshot()
+                self._logger.event(
+                    "fleet_quality_probe",
+                    tier_full=full_tier, delta=round(delta, 6),
+                    ewma=snap["delta_ewma"], verdict=verdict,
+                    quality_cap=snap["quality_cap"],
+                    level=snap["level"])
+
+
+def census_key(class_name: str, tier: str) -> str:
+    """Stable "class:tier" key for the brownout census rollups
+    (obs_report.py and the fleet summary share it)."""
+    return f"{class_name}:{tier}"
+
+
+__all__ = [
+    "BrownoutController",
+    "CascadeConfig",
+    "QualityProbe",
+    "census_key",
+]

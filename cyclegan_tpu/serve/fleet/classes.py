@@ -34,6 +34,14 @@ class DeadlineClass:
     deadline_ms: float      # admission -> completion budget
     shed_rank: int          # higher sheds first; 0 = protected longest
     tier: Optional[str] = None  # engine tier override (None = base)
+    # Hedge deadline: a dispatched request of this class still
+    # unresolved this long after submission is speculatively re-enqueued
+    # to a second replica (first result wins; the loser is cancelled at
+    # the batcher). None = defer to FleetConfig.hedge_ms (and hedging
+    # stays off when that is None too). Sizing guidance lives in
+    # docs/TPU_RUNBOOK.md §Overload playbook — a sane hedge point is
+    # past the class's own p95 but well inside its deadline budget.
+    hedge_ms: Optional[float] = None
 
     def __post_init__(self):
         if self.deadline_ms <= 0:
@@ -42,6 +50,11 @@ class DeadlineClass:
         if self.shed_rank < 0:
             raise ValueError(f"class {self.name!r}: shed_rank must be "
                              f">= 0, got {self.shed_rank}")
+        if self.hedge_ms is not None and not (
+                0 < self.hedge_ms < self.deadline_ms):
+            raise ValueError(
+                f"class {self.name!r}: hedge_ms must sit inside "
+                f"(0, deadline_ms), got {self.hedge_ms}")
 
 
 DEFAULT_CLASSES: Tuple[DeadlineClass, ...] = (

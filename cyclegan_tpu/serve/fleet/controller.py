@@ -18,16 +18,41 @@ Flushes dispatched while other replicas are still busy are flagged
 ``refill`` in telemetry, so the bench can verify overlap actually
 happens.
 
+The monitor thread is the fleet's whole control plane. Beyond PR-8
+crash/wedge recovery it now owns three overload-survival subsystems,
+each optional and host-side only:
+
+- **Autoscaling** (``FleetConfig.autoscale``): the autoscale.py
+  decision core is evaluated on its own cadence; "up" actuates through
+  the SAME ``_spawn_slot_locked`` path crash respawn uses (circuit
+  breaker and all), "down" marks a replica ``retiring`` and the
+  dispatcher completes the retirement only once the replica surfaces
+  free — after its in-flight work drained.
+- **Brownout cascade** (``FleetConfig.cascade``): submit-time tier
+  routing through cascade.py degrades classes to cheaper engine tiers
+  under queue pressure BEFORE the admission queue ever sheds; a
+  sampled shadow fraction re-runs degraded work on the full tier and
+  the quality probe narrows the brownout if the delta drifts.
+- **Hedged dispatch + p95 quarantine** (``FleetConfig.hedge_ms`` /
+  ``quarantine_multiple``): in-flight requests past their class hedge
+  deadline get a twin re-enqueued (shared future, first result wins,
+  loser cancelled at the batcher's pop), and a replica whose rolling
+  flush p95 detaches from the fleet median is quarantined, probed with
+  synthetic flushes, and readmitted or respawned.
+
 Telemetry (PR-1 JSONL schema, folded by tools/obs_report.py):
 ``fleet_flush`` per flush (replica, fill, trigger, class mix, latency
 splits), ``fleet_shed`` per shed decision (emitted by the admission
-queue), and a ``fleet_summary`` rollup at close with per-class latency
-percentiles, deadline-miss counts, shed counts, and the queue
-high-water mark.
+queue), ``fleet_autoscale`` / ``fleet_brownout`` / ``fleet_hedge`` /
+``fleet_quality_probe`` / ``fleet_quarantine`` for the overload
+machinery, and a ``fleet_summary`` rollup at close with per-class
+latency percentiles, deadline-miss counts, shed counts, hedge
+win/loss, the brownout census, and the queue high-water mark.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import queue
 import threading
@@ -41,6 +66,17 @@ from cyclegan_tpu.serve.engine import InferenceEngine, preprocess_request
 from cyclegan_tpu.serve.fleet.admission import (
     AdmissionController,
     FleetRequest,
+)
+from cyclegan_tpu.serve.fleet.autoscale import (
+    Autoscaler,
+    AutoscaleConfig,
+    FleetSignals,
+)
+from cyclegan_tpu.serve.fleet.cascade import (
+    BrownoutController,
+    CascadeConfig,
+    QualityProbe,
+    census_key,
 )
 from cyclegan_tpu.serve.fleet.classes import (
     DEFAULT_CLASSES,
@@ -85,6 +121,26 @@ class FleetConfig:
     # a poison batch that kills every replica it touches).
     max_request_attempts: int = 2
     health_poll_s: float = 0.05  # monitor thread cadence
+    # Overload-survival layer (all off by default — the fixed-N fleet
+    # of PR 6/8 is the zero-config behavior):
+    # `autoscale` turns n_replicas into the STARTING size of a
+    # [min_replicas, max_replicas] fleet driven by autoscale.py;
+    # `cascade` enables the brownout tier cascade (cascade.py) over
+    # whatever cheap tiers the engine compiled; `hedge_ms` is the
+    # default hedge deadline for classes that don't carry their own
+    # (DeadlineClass.hedge_ms wins; None everywhere = hedging off).
+    autoscale: Optional[AutoscaleConfig] = None
+    cascade: Optional[CascadeConfig] = None
+    hedge_ms: Optional[float] = None
+    # Per-replica p95 quarantine: a replica whose rolling flush-service
+    # p95 exceeds `quarantine_multiple` x the fleet median (both over
+    # >= quarantine_min_samples flushes) stops taking traffic and is
+    # probed with synthetic flushes; `quarantine_probes` consecutive
+    # failed probes condemn it to the respawn path. None disables.
+    quarantine_multiple: Optional[float] = None
+    quarantine_min_samples: int = 8
+    quarantine_probes: int = 3
+    quarantine_probe_interval_s: float = 0.25
 
     def __post_init__(self):
         if self.n_replicas < 1:
@@ -113,6 +169,33 @@ class FleetConfig:
             raise ValueError(
                 f"default_class {self.default_class!r} not among "
                 f"classes {sorted(names)}")
+        if self.hedge_ms is not None and self.hedge_ms <= 0:
+            raise ValueError(
+                f"hedge_ms must be > 0 or None, got {self.hedge_ms}")
+        if self.autoscale is not None and not (
+                self.autoscale.min_replicas <= self.n_replicas
+                <= self.autoscale.max_replicas):
+            raise ValueError(
+                f"n_replicas={self.n_replicas} must start inside the "
+                f"autoscale range [{self.autoscale.min_replicas}, "
+                f"{self.autoscale.max_replicas}]")
+        if self.quarantine_multiple is not None \
+                and self.quarantine_multiple <= 1.0:
+            raise ValueError(
+                f"quarantine_multiple must be > 1.0 or None, "
+                f"got {self.quarantine_multiple}")
+        if self.quarantine_min_samples < 2:
+            raise ValueError(
+                f"quarantine_min_samples must be >= 2, "
+                f"got {self.quarantine_min_samples}")
+        if self.quarantine_probes < 1:
+            raise ValueError(
+                f"quarantine_probes must be >= 1, "
+                f"got {self.quarantine_probes}")
+        if self.quarantine_probe_interval_s <= 0:
+            raise ValueError(
+                f"quarantine_probe_interval_s must be > 0, "
+                f"got {self.quarantine_probe_interval_s}")
 
 
 class FleetExecutor:
@@ -165,14 +248,6 @@ class FleetExecutor:
         self.admission = AdmissionController(self.cfg.capacity,
                                              logger=logger)
         self._free: "queue.Queue" = queue.Queue()
-        self.replicas = [
-            ReplicaWorker(i, self._engine_for_slot(i),
-                          on_free=self._free.put,
-                          on_done=self._on_done, injector=injector)
-            for i in range(self.cfg.n_replicas)
-        ]
-        for r in self.replicas:
-            self._free.put(r)
         self._busy = 0  # replicas holding a dispatched flush
         self._closed = False
         # Rollup state (guarded by _stats_lock; written by replica
@@ -185,12 +260,59 @@ class FleetExecutor:
         self._n_refill = 0
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
-        # Self-healing state (slot-indexed; guarded by _stats_lock).
-        self._fail_counts = [0] * self.cfg.n_replicas
-        self._circuit_open = [False] * self.cfg.n_replicas
+        # Self-healing + autoscale state (slot-indexed; guarded by
+        # _stats_lock). `_retired` marks slots the autoscaler drained
+        # and stopped — scale-up revives them through the same
+        # _spawn_slot path crash recovery uses.
+        self._fail_counts: List[int] = []
+        self._circuit_open: List[bool] = []
+        self._retired: List[bool] = []
+        # Rolling per-slot flush service times feeding the p95
+        # quarantine comparison.
+        self._flush_lat: List[collections.deque] = []
         self._n_recoveries = 0
         self._n_requeued = 0
         self._n_crash_failed = 0
+        # Hedged-dispatch rollup.
+        self._hedging = (self.cfg.hedge_ms is not None
+                         or any(c.hedge_ms is not None
+                                for c in self.cfg.classes))
+        self._n_hedges = 0
+        self._n_hedge_wins = 0
+        self._n_hedge_losses = 0
+        # Brownout census: class -> served tier -> count (degraded
+        # requests only).
+        self._degraded_census: Dict[str, int] = {}
+        self._n_degraded = 0
+        # Quarantine rollup + parked (quarantined, between-probes)
+        # replicas the monitor re-offers on their probe interval.
+        self._n_quarantined = 0
+        self._n_readmitted = 0
+        self._n_condemned = 0
+        self._parked: List[ReplicaWorker] = []
+        # Autoscale wiring: the decision core plus actuation counters.
+        self._autoscaler = (Autoscaler(self.cfg.autoscale)
+                            if self.cfg.autoscale is not None else None)
+        self._t_next_autoscale = 0.0
+        self._n_scale_up = 0
+        self._n_scale_down = 0
+        # Brownout wiring: ladder = configured cascade tiers the engine
+        # actually compiled, in cascade order.
+        self._brownout: Optional[BrownoutController] = None
+        self._probe: Optional[QualityProbe] = None
+        if self.cfg.cascade is not None:
+            ladder = [t for t in self.cfg.cascade.tiers
+                      if t in engine.tiers]
+            self._brownout = BrownoutController(
+                self.cfg.cascade, ladder, list(self._classes))
+            if self.cfg.cascade.shadow_fraction > 0:
+                self._probe = QualityProbe(engine, self._brownout,
+                                           logger=logger)
+        self.replicas: List[ReplicaWorker] = []
+        with self._stats_lock:
+            for i in range(self.cfg.n_replicas):
+                self._grow_slot_arrays_locked()
+                self._spawn_slot_locked(i)
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
             name="fleet-dispatcher")
@@ -206,6 +328,42 @@ class FleetExecutor:
         a recovered slot rebinds to the SAME engine/device its crashed
         predecessor ran on (the device is fine; the thread died)."""
         return self.engines[slot % len(self.engines)]
+
+    # -- slot machinery (shared by startup, crash respawn, autoscale) ------
+    def _grow_slot_arrays_locked(self) -> int:
+        """Append one empty slot to every slot-indexed array; returns
+        the new slot id. _stats_lock held by the caller."""
+        self._fail_counts.append(0)
+        self._circuit_open.append(False)
+        self._retired.append(False)
+        self._flush_lat.append(collections.deque(maxlen=32))
+        return len(self._fail_counts) - 1
+
+    def _spawn_slot_locked(self, slot: int) -> ReplicaWorker:
+        """Bind a fresh worker into `slot` and offer it to the
+        dispatcher — THE actuator: initial startup, PR-8 crash respawn,
+        and autoscale scale-up all pass through here, so they share the
+        engine binding, the free-queue hand-off, and the slot arrays.
+        _stats_lock held by the caller."""
+        worker = ReplicaWorker(slot, self._engine_for_slot(slot),
+                               on_free=self._free.put,
+                               on_done=self._on_done,
+                               injector=self._injector)
+        if slot == len(self.replicas):
+            self.replicas.append(worker)
+        else:
+            self.replicas[slot] = worker
+        self._retired[slot] = False
+        self._free.put(worker)
+        return worker
+
+    def _n_active_locked(self) -> int:
+        """Replicas currently accepting traffic: not breaker-retired,
+        not autoscale-retired, not draining toward retirement."""
+        return sum(
+            1 for slot in range(len(self.replicas))
+            if not self._circuit_open[slot] and not self._retired[slot]
+            and not self.replicas[slot].retiring)
 
     # -- submission --------------------------------------------------------
     def submit_raw(self, img: np.ndarray, klass: Optional[str] = None,
@@ -238,8 +396,21 @@ class FleetExecutor:
             raise ValueError(
                 f"size {size} is not a compiled resolution bucket "
                 f"{tuple(sorted({s for s, _ in self.engine.programs}))}")
-        return self.admission.offer(
-            FleetRequest(image, size, resolved, k))
+        req = FleetRequest(image, size, resolved, k)
+        if self._brownout is not None:
+            browned = self._brownout.tier_for(k.name, resolved)
+            if browned != resolved:
+                # Brownout routing: serve cheaper INSTEAD of shedding.
+                # The original tier is kept on the request so the
+                # quality probe knows what to shadow against.
+                req.tier = browned
+                req.degraded_from = resolved
+                with self._stats_lock:
+                    self._n_degraded += 1
+                    ck = census_key(k.name, browned)
+                    self._degraded_census[ck] = \
+                        self._degraded_census.get(ck, 0) + 1
+        return self.admission.offer(req)
 
     # -- the dispatcher ----------------------------------------------------
     def _dispatch_loop(self) -> None:
@@ -255,8 +426,36 @@ class FleetExecutor:
                 # up on it and re-put itself: its slot already hosts a
                 # respawn (or an open circuit) — drop, don't re-use.
                 continue
-            batch = self.admission.next_batch(self._max_batch,
-                                              self._max_wait_s)
+            if replica.retiring:
+                # Drain-before-retire: a replica only surfaces here
+                # with no in-flight work, so the scale-down that marked
+                # it can now complete without stranding anything.
+                self._finish_retire(replica)
+                continue
+            if replica.quarantined:
+                if replica.condemned:
+                    # Probes exhausted; the monitor owns the respawn —
+                    # just keep it away from real traffic.
+                    continue
+                now = time.perf_counter()
+                if now >= replica.next_probe_t:
+                    replica.next_probe_t = (
+                        now + self.cfg.quarantine_probe_interval_s)
+                    self._dispatch_probe(replica)
+                else:
+                    # Between probes: park it; the monitor re-offers it
+                    # when the interval elapses (re-putting it here
+                    # would spin this loop hot).
+                    with self._stats_lock:
+                        self._parked.append(replica)
+                continue
+            # idle_return_s: an empty queue returns [] on the health
+            # cadence instead of holding this replica indefinitely — a
+            # retiring/quarantine mark set by the monitor must take
+            # effect on an IDLE fleet too, not at the next request.
+            batch = self.admission.next_batch(
+                self._max_batch, self._max_wait_s,
+                idle_return_s=self.cfg.health_poll_s)
             if batch is None:  # closed and drained
                 self._free.put(replica)
                 return
@@ -280,15 +479,128 @@ class FleetExecutor:
             replica.inflight = (batch, time.perf_counter())
             replica.dispatch(batch, trigger)
 
-    # -- self-healing (monitor thread) -------------------------------------
+    # -- autoscale actuation -----------------------------------------------
+    def _scale_up(self) -> None:
+        """Add one replica: revive the lowest retired slot if any (the
+        respawn actuator), else append a fresh slot. Runs on the
+        monitor thread only."""
+        with self._stats_lock:
+            slot = next(
+                (i for i in range(len(self.replicas))
+                 if self._retired[i] and not self._circuit_open[i]),
+                None)
+            if slot is None:
+                slot = self._grow_slot_arrays_locked()
+            self._spawn_slot_locked(slot)
+            self._n_scale_up += 1
+            n_active = self._n_active_locked()
+        if self._logger is not None:
+            self._logger.event(
+                "fleet_autoscale", phase="up", replica=slot,
+                n_active=n_active)
+
+    def _scale_down(self) -> None:
+        """Mark the highest-slot active replica `retiring`; the
+        dispatcher completes the retirement once the replica surfaces
+        free (i.e. after its in-flight flush drained). Runs on the
+        monitor thread only."""
+        with self._stats_lock:
+            victim = next(
+                (i for i in range(len(self.replicas) - 1, -1, -1)
+                 if not self._circuit_open[i] and not self._retired[i]
+                 and not self.replicas[i].retiring),
+                None)
+            if victim is None:
+                return
+            self.replicas[victim].retiring = True
+            self._n_scale_down += 1
+            n_active = self._n_active_locked()
+        if self._logger is not None:
+            self._logger.event(
+                "fleet_autoscale", phase="down", replica=victim,
+                n_active=n_active)
+
+    def _finish_retire(self, replica: ReplicaWorker) -> None:
+        """Dispatcher-side completion of a scale-down: the replica is
+        free (in-flight drained), stop its thread and mark the slot
+        revivable."""
+        replica.request_stop()
+        with self._stats_lock:
+            self._retired[replica.replica_id] = True
+            replica.retiring = False
+            n_active = self._n_active_locked()
+        if self._logger is not None:
+            self._logger.event(
+                "fleet_autoscale", phase="retired",
+                replica=replica.replica_id, n_active=n_active)
+
+    # -- quarantine probing ------------------------------------------------
+    def _dispatch_probe(self, replica: ReplicaWorker) -> None:
+        """Synthetic single-image flush against a quarantined replica;
+        _on_done (trigger="probe") judges the service time."""
+        size = min(s for s, _ in self.engine.programs)
+        img = np.zeros((size, size, 3), np.float32)
+        req = FleetRequest(img, size, self.engine.resolve_tier(None),
+                          self._classes[self.cfg.default_class])
+        req.probe = True
+        with self._stats_lock:
+            self._busy += 1
+        replica.inflight = ([req], time.perf_counter())
+        replica.dispatch([req], "probe")
+
+    def _judge_probe(self, replica: ReplicaWorker,
+                     service_s: float) -> None:
+        """Probe verdict (replica thread, via _on_done): back under the
+        bound recorded at quarantine time -> readmit; `quarantine_probes`
+        consecutive failures -> condemn (the monitor respawns)."""
+        with self._stats_lock:
+            self._fail_counts[replica.replica_id] = 0
+            self._busy -= 1
+        ok = service_s <= replica.probe_bound_s
+        action = "readmit"
+        if ok:
+            replica.probe_strikes = 0
+            replica.quarantined = False
+            with self._stats_lock:
+                self._n_readmitted += 1
+        else:
+            replica.probe_strikes += 1
+            if replica.probe_strikes >= self.cfg.quarantine_probes:
+                action = "condemn"
+                with self._stats_lock:
+                    self._n_condemned += 1
+                # Monitor-side respawn keys off this flag.
+                replica.condemned = True
+            else:
+                action = "probe_fail"
+        if self._logger is not None:
+            self._logger.event(
+                "fleet_quarantine", action=action,
+                replica=replica.replica_id,
+                probe_s=round(service_s, 6),
+                bound_s=round(replica.probe_bound_s, 6),
+                strikes=replica.probe_strikes)
     def _monitor_loop(self) -> None:
-        """Detect dead or wedged replicas and route them through
-        _recover. Polling (not event-driven) on purpose: the failure
-        being detected is precisely the one that fires no callback."""
+        """The fleet's control plane, one polling thread: dead/wedged
+        replica recovery (PR 8), hedge-deadline scanning, p95
+        quarantine, the brownout pressure tick, and the autoscale
+        evaluation. Polling (not event-driven) on purpose: the failures
+        being detected are precisely the ones that fire no callback.
+        Everything that MUTATES fleet topology (recover, scale, condemn
+        -> respawn) runs on this thread only."""
         while not self._monitor_stop.wait(self.cfg.health_poll_s):
             now = time.perf_counter()
-            for slot, replica in enumerate(self.replicas):
-                if replica.abandoned or self._circuit_open[slot]:
+            for slot in range(len(self.replicas)):
+                replica = self.replicas[slot]
+                if (replica.abandoned or self._circuit_open[slot]
+                        or self._retired[slot]):
+                    continue
+                if replica.condemned and replica.quarantined:
+                    # Probes exhausted: stop the slow worker's thread
+                    # and route the slot through the SAME respawn path
+                    # (and circuit breaker) a crash would take.
+                    replica.request_stop()
+                    self._recover(slot, replica, "quarantine")
                     continue
                 inflight = replica.inflight
                 if not replica.alive():
@@ -299,6 +611,133 @@ class FleetExecutor:
                         and inflight is not None
                         and now - inflight[1] > self.cfg.wedge_timeout_s):
                     self._recover(slot, replica, "wedge")
+                    continue
+                if self._hedging and inflight is not None:
+                    self._maybe_hedge(replica, inflight[0], now)
+            if self.cfg.quarantine_multiple is not None:
+                self._check_quarantine(now)
+                self._unpark_probes(now)
+            if self._brownout is not None:
+                self._brownout_tick(now)
+            if (self._autoscaler is not None
+                    and now >= self._t_next_autoscale):
+                self._t_next_autoscale = now + self.cfg.autoscale.eval_s
+                self._autoscale_tick(now)
+
+    # -- hedged dispatch (monitor thread) ----------------------------------
+    def _maybe_hedge(self, replica: ReplicaWorker,
+                     batch: List[FleetRequest], now: float) -> None:
+        """Speculatively re-enqueue in-flight requests that sat past
+        their class's hedge deadline: a twin sharing the future goes
+        back through admission and races the stuck copy on whichever
+        replica frees first. Only in-flight work hedges — a QUEUED slow
+        request would just re-join the same queue behind itself."""
+        for req in batch:
+            if (req.hedged or req.is_hedge or req.probe
+                    or req.future.done()):
+                continue
+            h_ms = (req.klass.hedge_ms
+                    if req.klass.hedge_ms is not None
+                    else self.cfg.hedge_ms)
+            if h_ms is None or (now - req.t_submit) * 1000.0 < h_ms:
+                continue
+            req.hedged = True
+            try:
+                self.admission.offer(req.twin())
+            except Exception:  # noqa: BLE001 — queue full/closed: the primary rides alone
+                continue
+            with self._stats_lock:
+                self._n_hedges += 1
+            if self._logger is not None:
+                self._logger.event(
+                    "fleet_hedge", klass=req.klass.name,
+                    replica=replica.replica_id,
+                    age_ms=round((now - req.t_submit) * 1000.0, 3),
+                    hedge_ms=h_ms)
+
+    # -- p95 quarantine (monitor thread) -----------------------------------
+    def _check_quarantine(self, now: float) -> None:
+        """Quarantine any replica whose rolling flush-service p95
+        detaches from the median of its peers'."""
+        mult = self.cfg.quarantine_multiple
+        to_event = []
+        with self._stats_lock:
+            p95s: Dict[int, float] = {}
+            for slot in range(len(self.replicas)):
+                if self._circuit_open[slot] or self._retired[slot]:
+                    continue
+                lats = self._flush_lat[slot]
+                if len(lats) >= self.cfg.quarantine_min_samples:
+                    p95s[slot] = _percentile(sorted(lats), 0.95)
+            if len(p95s) < 2:
+                return
+            for slot, p95 in p95s.items():
+                replica = self.replicas[slot]
+                if (replica.quarantined or replica.retiring
+                        or replica.abandoned):
+                    continue
+                others = sorted(v for s, v in p95s.items() if s != slot)
+                median = others[len(others) // 2]
+                if p95 > mult * median:
+                    replica.probe_strikes = 0
+                    replica.probe_bound_s = mult * median
+                    replica.next_probe_t = now
+                    replica.quarantined = True
+                    self._flush_lat[slot].clear()
+                    self._n_quarantined += 1
+                    to_event.append((slot, p95, median))
+        if self._logger is not None:
+            for slot, p95, median in to_event:
+                self._logger.event(
+                    "fleet_quarantine", action="quarantine",
+                    replica=slot, p95_s=round(p95, 6),
+                    fleet_median_s=round(median, 6))
+
+    def _unpark_probes(self, now: float) -> None:
+        """Re-offer parked quarantined replicas whose probe interval
+        elapsed (or that were readmitted while parked)."""
+        with self._stats_lock:
+            still: List[ReplicaWorker] = []
+            ready: List[ReplicaWorker] = []
+            for r in self._parked:
+                if r.abandoned or r.condemned:
+                    continue  # recovery owns the slot now
+                if not r.quarantined or now >= r.next_probe_t:
+                    ready.append(r)
+                else:
+                    still.append(r)
+            self._parked = still
+        for r in ready:
+            self._free.put(r)
+
+    # -- brownout / autoscale ticks (monitor thread) -----------------------
+    def _brownout_tick(self, now: float) -> None:
+        depth, drain, _ = self.admission.rates()
+        backlog_s = depth / max(drain, 1e-6)
+        new_level = self._brownout.update(backlog_s, now)
+        if new_level is not None and self._logger is not None:
+            snap = self._brownout.snapshot()
+            self._logger.event(
+                "fleet_brownout", level=new_level,
+                quality_cap=snap["quality_cap"],
+                steps_by_class=snap["steps_by_class"],
+                backlog_s=round(backlog_s, 4))
+
+    def _autoscale_tick(self, now: float) -> None:
+        depth, drain, arrival = self.admission.rates()
+        with self._stats_lock:
+            misses = sum(self._miss_by_class.values())
+            circuits = sum(self._circuit_open)
+            n_active = self._n_active_locked()
+        decision = self._autoscaler.observe(
+            FleetSignals(queue_depth=depth, drain_rate=drain,
+                         arrival_rate=arrival, deadline_misses=misses,
+                         circuits_open=circuits, n_active=n_active),
+            now)
+        if decision == "up":
+            self._scale_up()
+        elif decision == "down":
+            self._scale_down()
 
     def _recover(self, slot: int, replica: ReplicaWorker,
                  reason: str) -> None:
@@ -324,6 +763,10 @@ class FleetExecutor:
                 inflight=len(batch), consecutive_failures=consecutive)
         requeued = failed = 0
         for req in batch:
+            if req.probe:
+                # Synthetic quarantine probes carry no caller; nothing
+                # to re-enqueue.
+                continue
             if req.future.done():
                 continue
             req.attempts += 1
@@ -346,11 +789,8 @@ class FleetExecutor:
             with self._stats_lock:
                 self._circuit_open[slot] = True
         else:
-            self.replicas[slot] = ReplicaWorker(
-                replica.replica_id, self._engine_for_slot(slot),
-                on_free=self._free.put,
-                on_done=self._on_done, injector=self._injector)
-            self._free.put(self.replicas[slot])
+            with self._stats_lock:
+                self._spawn_slot_locked(slot)
             respawned = True
         with self._stats_lock:
             self._n_requeued += requeued
@@ -372,16 +812,30 @@ class FleetExecutor:
             # accounting (busy count, requeues) — double-counting here
             # would corrupt the rollup.
             return
+        if trigger == "probe":
+            self._judge_probe(replica, t_done - t0)
+            return
         self.admission.on_complete(n)
+        # Only copies that actually resolved their future count toward
+        # latency/deadline rollups: a losing hedge copy completing after
+        # its twin would otherwise double-count the request (and charge
+        # the class a phantom miss).
         lats = [(r.klass.name, t_done - r.t_submit,
-                 t_done > r.deadline) for r in batch]
+                 t_done > r.deadline) for r in batch if r.won]
+        hedge_wins = sum(1 for r in batch if r.is_hedge and r.won)
+        hedge_losses = sum(1 for r in batch if r.hedged and r.won)
         with self._stats_lock:
             # A completed flush closes the failure streak: the circuit
             # breaker counts CONSECUTIVE failures per slot.
             self._fail_counts[replica.replica_id] = 0
+            self._flush_lat[replica.replica_id].append(t_done - t0)
             self._busy -= 1
             self._n_done += n
             self._n_flushes += 1
+            self._n_hedge_wins += hedge_wins
+            # A primary that resolved AFTER hedging means the hedge was
+            # wasted work — the twin lost (or will be cancelled at pop).
+            self._n_hedge_losses += hedge_losses
             if trigger == "refill":
                 self._n_refill += 1
             if self._t_first is None:
@@ -392,6 +846,13 @@ class FleetExecutor:
                 if missed:
                     self._miss_by_class[name] = \
                         self._miss_by_class.get(name, 0) + 1
+        if self._probe is not None:
+            for r in batch:
+                if (r.won and r.degraded_from is not None
+                        and r.result is not None
+                        and self._brownout.take_sample()):
+                    self._probe.submit(r.image, r.size, r.degraded_from,
+                                       r.result["fake"])
         if self._logger is not None:
             mix: Dict[str, int] = {}
             for name, _, _ in lats:
@@ -426,13 +887,27 @@ class FleetExecutor:
                 for name, lats in sorted(self._lat_by_class.items())
             }
             busy = self._busy
+            n_active = self._n_active_locked()
             snap = {
                 "n_images_done": self._n_done,
                 "n_flushes": self._n_flushes,
                 "refill_flushes": self._n_refill,
+                "hedges": {
+                    "dispatched": self._n_hedges,
+                    "wins": self._n_hedge_wins,
+                    "losses": self._n_hedge_losses,
+                },
+                "degraded_requests": self._n_degraded,
+                "degraded_census": dict(self._degraded_census),
+                "quarantine": {
+                    "quarantined": self._n_quarantined,
+                    "readmitted": self._n_readmitted,
+                    "condemned": self._n_condemned,
+                },
             }
         snap.update({
             "n_replicas": len(self.replicas),
+            "n_replicas_active": n_active,
             "replica_devices": [
                 str(getattr(self._engine_for_slot(i), "device", None))
                 for i in range(len(self.replicas))],
@@ -445,6 +920,21 @@ class FleetExecutor:
             "crash_failed_requests": self._n_crash_failed,
             "circuits_open": sum(self._circuit_open),
         })
+        if self._autoscaler is not None:
+            snap["autoscale"] = dict(
+                self._autoscaler.snapshot(),
+                min_replicas=self.cfg.autoscale.min_replicas,
+                max_replicas=self.cfg.autoscale.max_replicas,
+                scale_ups=self._n_scale_up,
+                scale_downs=self._n_scale_down)
+        if self._brownout is not None:
+            snap["brownout"] = self._brownout.snapshot()
+            if self._probe is not None:
+                snap["brownout"]["shadow"] = {
+                    "submitted": self._probe.n_submitted,
+                    "run": self._probe.n_run,
+                    "dropped": self._probe.n_dropped,
+                }
         return snap
 
     # -- shutdown ----------------------------------------------------------
@@ -460,7 +950,10 @@ class FleetExecutor:
         self._monitor.join(timeout=10.0)
         self.admission.close()
         with self._stats_lock:
-            fleet_dead = all(self._circuit_open)
+            # Dead = no slot will ever free itself again: breaker-open
+            # or autoscale-retired (a retired worker's thread stopped).
+            fleet_dead = all(
+                o or r for o, r in zip(self._circuit_open, self._retired))
         if fleet_dead:
             # No live replica will ever free itself, so the dispatcher
             # is parked on _free.get() forever: wake it with the close
@@ -478,6 +971,8 @@ class FleetExecutor:
                         req.future.set_exception(ReplicaCrashed(
                             "fleet closed with every replica circuit "
                             "open; request was never dispatched"))
+        if self._probe is not None:
+            self._probe.close()
         unjoined = [r.replica_id for r in self.replicas if not r.close()]
         with self._stats_lock:
             wall = ((self._t_last - self._t_first)
@@ -511,12 +1006,30 @@ class FleetExecutor:
         adm = self.admission.stats()
         summary["shed"] = adm["shed"]
         summary["shed_reasons"] = adm["shed_reasons"]
+        summary["cancelled"] = adm["cancelled"]
         summary["max_queue_depth"] = adm["max_depth"]
         with self._stats_lock:
             summary["recoveries"] = self._n_recoveries
             summary["requeued_requests"] = self._n_requeued
             summary["crash_failed_requests"] = self._n_crash_failed
             summary["circuits_open"] = sum(self._circuit_open)
+            summary["n_replicas_active"] = self._n_active_locked()
+            summary["hedges"] = {
+                "dispatched": self._n_hedges,
+                "wins": self._n_hedge_wins,
+                "losses": self._n_hedge_losses,
+            }
+            summary["degraded_requests"] = self._n_degraded
+            summary["degraded_census"] = dict(self._degraded_census)
+            summary["quarantine"] = {
+                "quarantined": self._n_quarantined,
+                "readmitted": self._n_readmitted,
+                "condemned": self._n_condemned,
+            }
+            summary["scale_ups"] = self._n_scale_up
+            summary["scale_downs"] = self._n_scale_down
+        if self._brownout is not None:
+            summary["brownout"] = self._brownout.snapshot()
         # Replicas that refused to join: a clean fleet reports [] here;
         # anything else is a wedged worker the caller must not mistake
         # for a completed shutdown.

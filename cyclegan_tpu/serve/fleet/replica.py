@@ -34,6 +34,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from concurrent.futures import InvalidStateError
 from typing import Callable, List, Optional
 
 import numpy as np
@@ -81,6 +82,20 @@ class ReplicaWorker:
         self.abandoned = False
         self.crashed = False
         self.last_beat = _now()
+        # Hedge/quarantine/autoscale surface (written by the controller
+        # under its stats lock; this thread only ever reads them):
+        # `quarantined` — p95 detached from the fleet median, real
+        # traffic withheld, synthetic probes decide readmit-vs-respawn;
+        # `condemned` — probes exhausted, the monitor will respawn the
+        # slot; `retiring` — autoscale scale-down marked this replica,
+        # the dispatcher stops it the next time it surfaces free (i.e.
+        # only after its in-flight flush drained).
+        self.quarantined = False
+        self.condemned = False
+        self.retiring = False
+        self.probe_strikes = 0
+        self.next_probe_t = 0.0
+        self.probe_bound_s = float("inf")
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"fleet-replica-{replica_id}")
@@ -91,6 +106,14 @@ class ReplicaWorker:
 
     def dispatch(self, batch: List[FleetRequest], trigger: str) -> None:
         self._inbox.put((batch, trigger))
+
+    def request_stop(self) -> None:
+        """Post the stop sentinel without joining — the autoscaler's
+        drain-before-retire path and the quarantine respawn both run on
+        threads that must not block on a worker's exit; close() at
+        shutdown still joins (a second _STOP in a dead inbox is
+        harmless)."""
+        self._inbox.put(_STOP)
 
     def close(self, timeout: Optional[float] = 30.0) -> bool:
         """Stop and join; True = the thread exited. False = it is STILL
@@ -181,7 +204,17 @@ class ReplicaWorker:
             if cycled is not None:
                 result["cycled"] = cycled[i]
             if not r.future.done():
-                r.future.set_result(result)
+                try:
+                    r.future.set_result(result)
+                except InvalidStateError:
+                    # Lost the hedge race between the done() check and
+                    # set_result — the twin's replica got there first.
+                    continue
+                # This copy's resolution actually landed: the flag feeds
+                # hedge win/loss accounting, and the kept host output
+                # feeds the brownout quality probe's shadow sampling.
+                r.won = True
+                r.result = result
 
 
 def _now() -> float:

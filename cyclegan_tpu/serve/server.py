@@ -27,7 +27,16 @@ Endpoints:
 Run:
   python -m cyclegan_tpu.serve.server --output_dir runs --port 8080 \
       [--dtype bfloat16] [--batch_bucket 8] [--max_wait_ms 5] [--panels] \
-      [--fleet 2 [--capacity 256]] [--int8]
+      [--fleet 2 [--capacity 256]] [--int8] \
+      [--autoscale --min_replicas 1 --max_replicas 4] \
+      [--brownout [--shadow_fraction 0.05]] [--hedge_ms 250]
+
+The last row is the self-driving overlay (fleet mode only): the
+autoscaler grows/shrinks the replica fleet from queue-rate signals, the
+brownout cascade degrades request tiers (f32 -> int8) before shedding
+— governed by a sampled shadow-probe quality budget — and --hedge_ms
+re-dispatches stragglers to a second replica (first result wins).
+/stats reports all three (autoscale/brownout/hedges/quarantine keys).
 """
 
 from __future__ import annotations
@@ -238,6 +247,28 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--int8", action="store_true",
                    help="also compile the int8 weight-quantized program "
                         "tier (?tier=int8 routes to it)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="fleet mode: grow/shrink the replica fleet from "
+                        "queue-rate signals (--fleet N is the starting "
+                        "size; bounds via --min/--max_replicas)")
+    p.add_argument("--min_replicas", default=1, type=int,
+                   help="autoscale floor (drain-before-retire scale-down "
+                        "never goes below this)")
+    p.add_argument("--max_replicas", default=None, type=int,
+                   help="autoscale ceiling (default: the --fleet size, "
+                        "i.e. scale-down-only)")
+    p.add_argument("--brownout", action="store_true",
+                   help="degrade request tiers class-by-class under "
+                        "queue pressure BEFORE shedding (requires "
+                        "--int8 for a non-trivial ladder)")
+    p.add_argument("--shadow_fraction", default=0.05, type=float,
+                   help="fraction of degraded requests shadow-re-run at "
+                        "full tier to police the brownout quality "
+                        "budget (0 disables the probe)")
+    p.add_argument("--hedge_ms", default=None, type=float,
+                   help="hedged dispatch: re-submit a request still "
+                        "in flight after this many ms to a second "
+                        "replica; first result wins")
     p.add_argument("--obs_jsonl", default=None,
                    help="telemetry stream path (PR-1 schema; fold with "
                         "tools/obs_report.py)")
@@ -293,8 +324,22 @@ def main(argv: Optional[list] = None) -> None:
           f"instant — tools/cache_warm.py)...", flush=True)
     engine = InferenceEngine(model_cfg, fwd_params, bwd_params,
                              serve_cfg=serve_cfg, logger=logger)
+    for flag, name in ((args.autoscale, "--autoscale"),
+                       (args.brownout, "--brownout"),
+                       (args.hedge_ms is not None, "--hedge_ms")):
+        if flag and args.fleet <= 0:
+            raise SystemExit(f"{name} requires fleet mode (--fleet N)")
+    if args.brownout and not args.int8:
+        raise SystemExit("--brownout needs a degradation ladder — "
+                         "enable --int8 so there is a cheaper tier to "
+                         "degrade onto")
     if args.fleet > 0:
-        from cyclegan_tpu.serve.fleet import FleetConfig, FleetExecutor
+        from cyclegan_tpu.serve.fleet import (
+            AutoscaleConfig,
+            CascadeConfig,
+            FleetConfig,
+            FleetExecutor,
+        )
 
         # Bind replicas round-robin to distinct local devices: one
         # engine per device actually used (min(fleet, devices) — extra
@@ -312,11 +357,23 @@ def main(argv: Optional[list] = None) -> None:
         if len(engines) > 1:
             print(f"fleet replicas bound round-robin over "
                   f"{len(engines)} local devices", flush=True)
+        autoscale_cfg = None
+        if args.autoscale:
+            autoscale_cfg = AutoscaleConfig(
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas or args.fleet)
+        cascade_cfg = None
+        if args.brownout:
+            cascade_cfg = CascadeConfig(
+                tiers=engine.tiers,
+                shadow_fraction=args.shadow_fraction)
         executor = FleetExecutor(
             engine,
             FleetConfig(n_replicas=args.fleet, capacity=args.capacity,
                         max_wait_ms=args.max_wait_ms,
-                        default_class=args.default_class),
+                        default_class=args.default_class,
+                        autoscale=autoscale_cfg, cascade=cascade_cfg,
+                        hedge_ms=args.hedge_ms),
             logger=logger, engines=engines)
     else:
         executor = PipelinedExecutor(engine, max_wait_ms=args.max_wait_ms,

@@ -1,0 +1,567 @@
+"""Self-driving fleet (PR 12): the autoscaler state machine, the
+brownout tier cascade + quality-probe budget loop, hedged dispatch with
+the pop-time cancellation asymmetry, the p95 quarantine, and the
+overload_brownout chaos drill end-to-end.
+
+The decision cores (autoscale.Autoscaler, cascade.BrownoutController)
+take a caller-supplied clock, so their state machines are tested with
+synthetic signals and a fake `now` — no sleeps, no threads. The
+integration tests drive a FleetExecutor over tests/test_fleet.py's
+FakeEngine (control plane only, no XLA).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from cyclegan_tpu.serve.fleet import (  # noqa: E402
+    AutoscaleConfig,
+    Autoscaler,
+    BrownoutController,
+    CascadeConfig,
+    DEFAULT_CLASSES,
+    DeadlineClass,
+    FleetConfig,
+    FleetExecutor,
+    FleetSignals,
+    QualityProbe,
+    class_map,
+)
+from cyclegan_tpu.serve.fleet.admission import (  # noqa: E402
+    AdmissionController,
+    FleetRequest,
+)
+from tests.test_fleet import FakeEngine  # noqa: E402
+
+CLASSES = class_map(DEFAULT_CLASSES)
+BATCH = CLASSES["batch"]
+
+
+def _sig(depth=0, drain=10.0, arrival=0.0, misses=0, circuits=0,
+         n_active=1):
+    return FleetSignals(queue_depth=depth, drain_rate=drain,
+                        arrival_rate=arrival, deadline_misses=misses,
+                        circuits_open=circuits, n_active=n_active)
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []
+
+    def event(self, kind, **fields):
+        with self._lock:
+            self.events.append(dict(fields, event=kind))
+
+    def of(self, kind):
+        with self._lock:
+            return [e for e in self.events if e["event"] == kind]
+
+
+# -- autoscaler decision core ----------------------------------------------
+
+def test_autoscale_config_validates():
+    with pytest.raises(ValueError):
+        AutoscaleConfig(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(hysteresis=0)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(up_arrival_ratio=1.0)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(down_margin=0.5)
+
+
+def test_hysteresis_requires_consecutive_overloaded_evals():
+    a = Autoscaler(AutoscaleConfig(hysteresis=3, cooldown_s=0.0))
+    hot = _sig(depth=50, drain=10.0)  # backlog 5s >> up_backlog_s
+    assert a.observe(hot, now=0.0) is None
+    assert a.observe(hot, now=0.1) is None
+    # A single calm snapshot resets the streak — noise never scales.
+    assert a.observe(_sig(depth=1, drain=100.0), now=0.2) is None
+    assert a.observe(hot, now=0.3) is None
+    assert a.observe(hot, now=0.4) is None
+    assert a.observe(hot, now=0.5) == "up"
+    # The decision consumed the streak: the very next eval starts over.
+    assert a.observe(hot, now=0.6) is None
+
+
+def test_cooldown_separates_scale_events():
+    a = Autoscaler(AutoscaleConfig(hysteresis=1, cooldown_s=2.0,
+                                   max_replicas=8))
+    hot = _sig(depth=50, drain=10.0)
+    assert a.observe(hot, now=0.0) == "up"
+    # Still overloaded, but inside the cooldown window: hold.
+    assert a.observe(hot, now=0.5) is None
+    assert a.observe(hot, now=1.9) is None
+    assert a.observe(hot, now=2.1) == "up"
+
+
+def test_scale_up_stops_at_max_replicas():
+    a = Autoscaler(AutoscaleConfig(hysteresis=1, cooldown_s=0.0,
+                                   max_replicas=2))
+    hot = _sig(depth=50, drain=10.0, n_active=2)
+    assert a.observe(hot, now=0.0) is None
+
+
+def test_arrival_outpacing_drain_counts_as_overload():
+    a = Autoscaler(AutoscaleConfig(hysteresis=1, cooldown_s=0.0))
+    # Tiny backlog, but arrivals are 2x drain with work queued.
+    assert a.observe(_sig(depth=1, drain=100.0, arrival=200.0),
+                     now=0.0) == "up"
+    # An empty queue never scales up, whatever the rates say.
+    a2 = Autoscaler(AutoscaleConfig(hysteresis=1, cooldown_s=0.0))
+    assert a2.observe(_sig(depth=0, drain=100.0, arrival=200.0),
+                      now=0.0) is None
+
+
+def test_miss_delta_counts_as_overload():
+    a = Autoscaler(AutoscaleConfig(hysteresis=1, cooldown_s=0.0))
+    assert a.observe(_sig(misses=3), now=0.0) is None  # first = baseline
+    assert a.observe(_sig(misses=3), now=0.1) is None  # no growth
+    assert a.observe(_sig(misses=5), now=0.2) == "up"  # rollup grew
+
+
+def test_scale_down_requires_idle_queue_and_margin():
+    cfg = AutoscaleConfig(hysteresis=2, cooldown_s=0.0, down_margin=1.5)
+    a = Autoscaler(cfg)
+    # 3 active, arrival 10/s, drain 60/s: 2 replicas drain 40/s, margin
+    # holds (10 * 1.5 < 40) -> idle streak builds to a down decision.
+    idle = _sig(depth=0, drain=60.0, arrival=10.0, n_active=3)
+    assert a.observe(idle, now=0.0) is None
+    assert a.observe(idle, now=0.1) == "down"
+    # At min_replicas the same signals hold steady instead.
+    floor = _sig(depth=0, drain=60.0, arrival=10.0, n_active=1)
+    a2 = Autoscaler(cfg)
+    assert a2.observe(floor, now=0.0) is None
+    assert a2.observe(floor, now=0.1) is None
+    # Queued work vetoes scale-down outright.
+    busy = _sig(depth=5, drain=60.0, arrival=10.0, n_active=3)
+    a3 = Autoscaler(cfg)
+    assert a3.observe(busy, now=0.0) is None
+    assert a3.observe(busy, now=0.1) is None
+
+
+def test_breaker_opening_suppresses_scale_up():
+    """Circuit-breaker interaction: replicas DYING is not demand — a
+    circuits_open increase must hold off scale-up for breaker_holdoff_s
+    and reset the accumulated streak."""
+    a = Autoscaler(AutoscaleConfig(hysteresis=2, cooldown_s=0.0,
+                                   breaker_holdoff_s=5.0))
+    hot = _sig(depth=50, drain=10.0)
+    assert a.observe(hot, now=0.0) is None  # streak 1
+    # A circuit opens: the capacity loss shows up as MORE backlog, but
+    # scale-up must not chase it.
+    assert a.observe(_sig(depth=80, drain=10.0, circuits=1),
+                     now=0.1) is None
+    assert a.observe(_sig(depth=80, drain=10.0, circuits=1),
+                     now=1.0) is None
+    assert a.observe(_sig(depth=80, drain=10.0, circuits=1),
+                     now=4.0) is None
+    # Holdoff expired (0.1 + 5.0): the pressure streak accumulated
+    # while suppressed, so scale-up fires on the next evaluation.
+    assert a.observe(_sig(depth=80, drain=10.0, circuits=1),
+                     now=5.2) == "up"
+
+
+# -- brownout cascade decision core ----------------------------------------
+
+def _brownout(**over):
+    kw = dict(tiers=("base", "int8", "perturb"), enter_backlog_s=0.2,
+              exit_backlog_s=0.05, hysteresis=2, cooldown_s=0.0,
+              shadow_fraction=0.25, quality_budget=0.05,
+              widen_ratio=0.25, probe_cooldown_s=0.0)
+    kw.update(over)
+    cfg = CascadeConfig(**kw)
+    return BrownoutController(cfg, cfg.tiers,
+                              ["interactive", "batch", "best_effort"])
+
+
+def test_brownout_plan_is_depth_first_per_class():
+    b = _brownout()
+    # 3 classes x 2 ladder steps: best_effort rides to the floor before
+    # batch is touched; interactive degrades last of all.
+    assert b.max_level == 6
+    b._level = 2  # best_effort at the perturb floor
+    assert b.tier_for("best_effort", "base") == "perturb"
+    assert b.tier_for("batch", "base") == "base"
+    assert b.tier_for("interactive", "base") == "base"
+    b._level = 3  # batch takes its first step
+    assert b.tier_for("batch", "base") == "int8"
+    assert b.tier_for("interactive", "base") == "base"
+    b._level = 6
+    assert b.tier_for("interactive", "base") == "perturb"
+    # Never upgrades: an explicit int8 request stays int8 at level 0,
+    # and clamps at the floor rather than wrapping.
+    b._level = 0
+    assert b.tier_for("best_effort", "int8") == "int8"
+    b._level = 2
+    assert b.tier_for("best_effort", "int8") == "perturb"
+    # Off-ladder tiers pass through untouched.
+    assert b.tier_for("best_effort", "weird") == "weird"
+
+
+def test_brownout_needs_two_available_tiers():
+    cfg = CascadeConfig(tiers=("base", "int8"))
+    with pytest.raises(ValueError):
+        BrownoutController(cfg, ["base"], ["batch"])
+
+
+def test_brownout_hysteresis_and_cooldown():
+    b = _brownout(cooldown_s=1.0)
+    assert b.update(backlog_s=0.5, now=0.0) is None   # streak 1
+    assert b.update(backlog_s=0.5, now=0.1) == 1      # streak 2 -> raise
+    # Cooling: pressure persists but the level holds for cooldown_s.
+    assert b.update(backlog_s=0.5, now=0.5) is None
+    assert b.update(backlog_s=0.5, now=0.8) is None
+    assert b.update(backlog_s=0.5, now=1.2) == 2
+    # Recovery path: sustained calm walks the level back down.
+    assert b.update(backlog_s=0.01, now=2.3) is None
+    assert b.update(backlog_s=0.01, now=2.4) == 1
+    # Mid-band (between exit and enter) resets both streaks.
+    assert b.update(backlog_s=0.1, now=3.5) is None
+    assert b.update(backlog_s=0.01, now=3.6) is None
+    assert b.update(backlog_s=0.01, now=3.7) == 0
+
+
+def test_quality_probe_narrows_cap_and_level_clamps():
+    b = _brownout()
+    b.update(0.5, now=0.0)
+    b.update(0.5, now=0.1)
+    assert b.level == 1
+    # A budget-blowing delta narrows the cap below the current level...
+    assert b.note_probe(delta=0.5, now=0.2) == "narrow"
+    for _ in range(10):
+        b.note_probe(delta=0.5, now=0.3)
+    assert b.snapshot()["quality_cap"] < b.max_level
+    # ...and the next pressure tick clamps the level down immediately,
+    # without waiting out a streak.
+    caps = b.snapshot()["quality_cap"]
+    if b.level > caps:
+        assert b.update(0.5, now=0.4) == caps
+
+
+def test_quality_probe_widens_cap_back_on_headroom():
+    b = _brownout()
+    assert b.note_probe(delta=0.5, now=0.0) == "narrow"
+    assert b.snapshot()["quality_cap"] == b.max_level - 1
+    # Sustained tiny deltas drag the EWMA under widen_ratio * budget
+    # and the cap recovers step by step.
+    verdicts = [b.note_probe(delta=0.0, now=1.0 + 0.1 * i)
+                for i in range(20)]
+    assert "widen" in verdicts
+    assert b.snapshot()["quality_cap"] == b.max_level
+    snap = b.snapshot()
+    assert snap["n_narrowed"] >= 1 and snap["n_widened"] >= 1
+
+
+def test_shadow_sampling_is_deterministic_one_in_n():
+    b = _brownout(shadow_fraction=0.25)
+    picks = [b.take_sample() for _ in range(12)]
+    assert picks == [False, False, False, True] * 3
+    b0 = _brownout(shadow_fraction=0.0)
+    assert not any(b0.take_sample() for _ in range(8))
+
+
+def test_quality_probe_thread_loop_over_fake_engine():
+    """The full widen/narrow loop through the QualityProbe worker: a
+    shadow re-run whose cheap output drifted past the budget narrows
+    the cap; clean shadows widen it back."""
+    b = _brownout(probe_cooldown_s=0.0)
+    eng = FakeEngine(sizes=(32,), buckets=(1, 4))
+    rec = Recorder()
+    probe = QualityProbe(eng, b, logger=rec)
+    try:
+        img = np.random.RandomState(0).rand(32, 32, 3).astype(np.float32)
+        # FakeEngine.run echoes its input, so the "full tier" output is
+        # the image itself: cheap_fake = img + 1 is a delta of exactly 1.
+        assert probe.submit(img, 32, "base", img + 1.0)
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline and probe.n_run < 1:
+            time.sleep(0.005)
+        assert probe.n_run == 1
+        assert b.snapshot()["quality_cap"] == b.max_level - 1
+        # Clean shadows (delta 0) decay the EWMA under the widen
+        # threshold and walk the cap back up step by step. Keep feeding
+        # them until it fully recovers (the bounded inbox may drop).
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline and \
+                b.snapshot()["quality_cap"] < b.max_level:
+            probe.submit(img, 32, "base", img.copy())
+            time.sleep(0.005)
+        assert b.snapshot()["quality_cap"] == b.max_level
+        evs = rec.of("fleet_quality_probe")
+        assert evs and evs[0]["verdict"] == "narrow"
+        assert any(e["verdict"] == "widen" for e in evs)
+    finally:
+        assert probe.close()
+
+
+# -- hedged dispatch -------------------------------------------------------
+
+def test_hedge_ms_validates_against_deadline():
+    with pytest.raises(ValueError):
+        DeadlineClass("x", deadline_ms=100, shed_rank=0, hedge_ms=100)
+    with pytest.raises(ValueError):
+        DeadlineClass("x", deadline_ms=100, shed_rank=0, hedge_ms=0)
+    k = DeadlineClass("x", deadline_ms=100, shed_rank=0, hedge_ms=50)
+    assert k.hedge_ms == 50
+
+
+def test_hedge_twin_shares_future_and_keeps_deadline():
+    req = FleetRequest(np.zeros((32, 32, 3), np.float32), 32, "base",
+                       BATCH, now=100.0)
+    twin = req.twin()
+    assert twin.future is req.future
+    assert twin.is_hedge and not req.is_hedge
+    assert twin.deadline == req.deadline
+    assert twin.t_submit == req.t_submit
+
+
+def test_resolved_elsewhere_copy_cancelled_at_pop():
+    """A queued copy whose shared future already resolved must be
+    dropped at pop time (won_elsewhere), not dispatched again."""
+    adm = AdmissionController(capacity=8)
+    req = FleetRequest(np.zeros((32, 32, 3), np.float32), 32, "base",
+                       BATCH)
+    adm.offer(req.twin())
+    req.future.set_result({"fake": None})  # the primary won elsewhere
+    batch = adm.next_batch(4, max_wait_s=0.0)
+    assert batch == []
+    assert adm.stats()["cancelled"] == {"won_elsewhere": 1}
+    assert adm.depth == 0
+
+
+def test_expired_hedge_twin_dies_silently_at_pop():
+    """The expiry-asymmetry pin: an EXPIRED hedge twin is cancelled at
+    pop WITHOUT failing the shared future — the primary (still in
+    flight on a replica) alone serves late. Before the fix the twin
+    took the expired-sheddable path and killed the caller's future
+    while the primary was still computing."""
+    adm = AdmissionController(capacity=8)
+    past = time.perf_counter() - 10.0  # deadline long gone
+    req = FleetRequest(np.zeros((32, 32, 3), np.float32), 32, "base",
+                       BATCH, now=past)
+    adm.offer(req.twin())
+    batch = adm.next_batch(4, max_wait_s=0.0)
+    assert batch == []
+    assert not req.future.done()  # the primary still owns the outcome
+    assert adm.stats()["cancelled"] == {"hedge_expired": 1}
+    # Whereas an expired hedged PRIMARY (both copies lost to the queue,
+    # e.g. after a crash re-enqueue) must still resolve the future —
+    # conservatively failing it beats hanging the caller forever.
+    primary = FleetRequest(np.zeros((32, 32, 3), np.float32), 32,
+                           "base", BATCH, now=past)
+    primary.hedged = True
+    adm.offer(primary)
+    assert adm.next_batch(4, max_wait_s=0.0) == []
+    assert primary.future.done()
+
+
+def test_hedge_fires_and_first_result_wins():
+    """End-to-end over two FakeEngines: replica 0's engine stalls, the
+    monitor hedges the in-flight request, the twin serves on replica 1,
+    and the caller gets the twin's result while the stuck primary's
+    later resolution is a no-op."""
+    e0, e1 = FakeEngine(buckets=(1,)), FakeEngine(buckets=(1,))
+    e0.gate = threading.Event()
+    rec = Recorder()
+    ex = FleetExecutor(
+        e0,
+        FleetConfig(n_replicas=2, max_wait_ms=1.0, health_poll_s=0.01,
+                    hedge_ms=40.0),
+        logger=rec, engines=[e0, e1])
+    try:
+        img = np.ones((32, 32, 3), np.float32)
+        fut = ex.submit(img, klass="batch")
+        assert fut.result(timeout=10.0)["fake"].shape == (32, 32, 3)
+        st = ex.stats()
+        assert st["hedges"]["dispatched"] == 1
+        assert st["hedges"]["wins"] == 1
+        assert rec.of("fleet_hedge")[0]["klass"] == "batch"
+        e0.gate.set()  # release the stuck primary
+        time.sleep(0.1)
+    finally:
+        summary = ex.close()
+    assert summary["unjoined_replicas"] == []
+
+
+# -- fleet integration: scale up / drain-before-retire / quarantine --------
+
+def test_fleet_scales_up_and_drains_before_retire():
+    """Overload a 1-replica fleet with a slow FakeEngine: the
+    autoscaler must add a replica, and after the surge decays it must
+    retire one — completing the retirement only once the victim
+    surfaces free (no stranded futures, no lost requests)."""
+    eng = FakeEngine(sizes=(32,), buckets=(1, 4), flush_s=0.02)
+    rec = Recorder()
+    ex = FleetExecutor(
+        eng,
+        FleetConfig(
+            n_replicas=1, capacity=256, max_wait_ms=1.0,
+            health_poll_s=0.01,
+            autoscale=AutoscaleConfig(
+                min_replicas=1, max_replicas=2, eval_s=0.05,
+                hysteresis=2, cooldown_s=0.2, up_backlog_s=0.1)),
+        logger=rec)
+    img = np.zeros((32, 32, 3), np.float32)
+    futs = []
+    try:
+        t_end = time.perf_counter() + 1.5
+        while time.perf_counter() < t_end:
+            futs.append(ex.submit(img, klass="best_effort"))
+            time.sleep(0.002)
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline and \
+                not rec.of("fleet_autoscale"):
+            time.sleep(0.01)
+        ups = [e for e in rec.of("fleet_autoscale")
+               if e["phase"] == "up"]
+        assert ups, "fleet never scaled up under sustained backlog"
+        # Decay: stop traffic; the fleet must come back down to 1, and
+        # every submitted future must have resolved by then.
+        deadline = time.perf_counter() + 20.0
+        while time.perf_counter() < deadline and \
+                ex.stats()["n_replicas_active"] > 1:
+            time.sleep(0.02)
+        # The active count drops at the "down" mark; the "retired"
+        # completion event lands when the dispatcher next observes the
+        # victim free — wait for it too, or this races under load.
+        while time.perf_counter() < deadline and not any(
+                e["phase"] == "retired"
+                for e in rec.of("fleet_autoscale")):
+            time.sleep(0.02)
+        st = ex.stats()
+        assert st["n_replicas_active"] == 1
+        assert st["autoscale"]["scale_ups"] >= 1
+        assert st["autoscale"]["scale_downs"] >= 1
+        phases = [e["phase"] for e in rec.of("fleet_autoscale")]
+        # Drain-before-retire is two distinct acts: the mark ("down")
+        # and the dispatcher-side completion ("retired") once free.
+        assert "down" in phases and "retired" in phases
+        assert phases.index("down") < phases.index("retired")
+        done = [f for f in futs if f.done()]
+        assert len(done) == len(futs)
+        assert all(f.exception() is None for f in done)
+    finally:
+        summary = ex.close()
+    assert summary["unjoined_replicas"] == []
+
+
+def test_quarantine_readmits_a_healed_replica():
+    """Slot 1's engine runs 20x slower than slot 0's: its p95 detaches,
+    it is quarantined and probed; once healed the probe lands under the
+    bound recorded at quarantine time and the replica is readmitted."""
+    fast, slow = FakeEngine(buckets=(1,)), FakeEngine(buckets=(1,))
+    fast.flush_s, slow.flush_s = 0.005, 0.1
+    rec = Recorder()
+    ex = FleetExecutor(
+        fast,
+        FleetConfig(n_replicas=2, max_wait_ms=1.0, health_poll_s=0.01,
+                    quarantine_multiple=3.0, quarantine_min_samples=4,
+                    quarantine_probes=5,
+                    quarantine_probe_interval_s=0.05),
+        logger=rec, engines=[fast, slow])
+    img = np.zeros((32, 32, 3), np.float32)
+    try:
+        deadline = time.perf_counter() + 15.0
+        while time.perf_counter() < deadline and \
+                not rec.of("fleet_quarantine"):
+            ex.submit(img, klass="best_effort").result(timeout=10.0)
+        quar = rec.of("fleet_quarantine")
+        assert quar and quar[0]["action"] == "quarantine"
+        assert quar[0]["replica"] == 1
+        slow.flush_s = 0.0  # heal: the next probe must readmit
+        deadline = time.perf_counter() + 15.0
+        while time.perf_counter() < deadline and \
+                ex.stats()["quarantine"]["readmitted"] < 1:
+            time.sleep(0.02)
+        st = ex.stats()
+        assert st["quarantine"]["quarantined"] >= 1
+        assert st["quarantine"]["readmitted"] >= 1
+        assert st["quarantine"]["condemned"] == 0
+        assert st["recoveries"] == 0
+    finally:
+        summary = ex.close()
+    assert summary["unjoined_replicas"] == []
+
+
+def test_quarantine_condemns_and_respawns_a_sick_replica():
+    """A replica that stays slow burns its probe budget: condemned,
+    stopped, and respawned through the SAME recovery path a crash
+    takes (reason='quarantine')."""
+    fast, slow = FakeEngine(buckets=(1,)), FakeEngine(buckets=(1,))
+    fast.flush_s, slow.flush_s = 0.005, 0.15
+    rec = Recorder()
+    ex = FleetExecutor(
+        fast,
+        FleetConfig(n_replicas=2, max_wait_ms=1.0, health_poll_s=0.01,
+                    quarantine_multiple=3.0, quarantine_min_samples=4,
+                    quarantine_probes=2,
+                    quarantine_probe_interval_s=0.03),
+        logger=rec, engines=[fast, slow])
+    img = np.zeros((32, 32, 3), np.float32)
+    try:
+        deadline = time.perf_counter() + 20.0
+        while time.perf_counter() < deadline and \
+                ex.stats()["quarantine"]["condemned"] < 1:
+            try:
+                ex.submit(img, klass="best_effort").result(timeout=10.0)
+            except Exception:  # noqa: BLE001 — shed under churn is fine
+                pass
+        assert ex.stats()["quarantine"]["condemned"] >= 1
+        # The monitor respawns the condemned slot on its next tick.
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline and not any(
+                e["reason"] == "quarantine"
+                for e in rec.of("fleet_replica_down")):
+            time.sleep(0.02)
+        downs = rec.of("fleet_replica_down")
+        assert any(e["reason"] == "quarantine" for e in downs)
+        assert ex.stats()["recoveries"] >= 1
+        actions = [e["action"] for e in rec.of("fleet_quarantine")]
+        assert "condemn" in actions
+    finally:
+        slow.flush_s = 0.0
+        summary = ex.close()
+    assert summary["unjoined_replicas"] == []
+
+
+# -- the acceptance drill --------------------------------------------------
+
+def test_overload_brownout_drill_fast_passes():
+    """tools/chaos_drill.py --fast --only overload_brownout: the
+    scripted end-to-end — scale-up within bound, degrade-before-shed,
+    zero interactive sheds with in-deadline p95, scale back down."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    # The drill's pass bounds are timing-based (scale-up latency, p95
+    # deadlines); one retry absorbs transient host contention while a
+    # real regression still fails both attempts deterministically.
+    for attempt in (0, 1):
+        r = subprocess.run(
+            [sys.executable, "tools/chaos_drill.py", "--fast",
+             "--only", "overload_brownout"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=220)
+        if r.returncode == 0:
+            break
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    report = json.loads(r.stdout.strip().splitlines()[-1])
+    drill = report["drills"]["overload_brownout"]
+    assert drill["pass"], drill["detail"]
+    checks = drill["detail"]["checks"]
+    for key in ("scale_up_within_bound", "degrade_before_shed",
+                "zero_interactive_sheds", "interactive_p95_in_deadline",
+                "scaled_back_down"):
+        assert checks[key], (key, drill["detail"])

@@ -529,8 +529,8 @@ def test_no_sync_check_covers_fleet_directory():
     from check_no_sync import hot_path_entries, run_check
 
     entries = dict(hot_path_entries())
-    for mod in ("admission", "classes", "controller", "replica",
-                "__init__"):
+    for mod in ("admission", "autoscale", "cascade", "classes",
+                "controller", "replica", "__init__"):
         assert entries.get(f"cyclegan_tpu/serve/fleet/{mod}.py") is True
     assert run_check() == []
 

@@ -172,6 +172,65 @@ def test_bench_stream_sections(tmp_path):
     assert "mfu 0.4100" in text
 
 
+def test_self_driving_fleet_sections_fold_and_render(tmp_path):
+    """The autoscale/brownout/hedge/quarantine events fold into the
+    autoscale_rollup and render as the self-driving-fleet section with
+    the scale timeline."""
+    path = str(tmp_path / "fleet.jsonl")
+    _write_stream(path, [
+        {"event": "manifest", "t": 0.0, "role": "serve"},
+        {"event": "fleet_brownout", "t": 1.0, "level": 1,
+         "quality_cap": 3, "steps_by_class": {"best_effort": 1},
+         "backlog_s": 0.12},
+        {"event": "fleet_autoscale", "t": 1.2, "phase": "up",
+         "replica": 1, "n_active": 2},
+        {"event": "fleet_hedge", "t": 1.5, "klass": "interactive",
+         "replica": 0, "age_ms": 61.0, "hedge_ms": 60.0},
+        {"event": "fleet_hedge_cancel", "t": 1.6, "klass": "interactive",
+         "reason": "won_elsewhere", "depth": 3},
+        {"event": "fleet_quality_probe", "t": 1.8, "tier_full": "base",
+         "delta": 0.01, "ewma": 0.01, "verdict": "narrow",
+         "quality_cap": 2, "level": 1},
+        {"event": "fleet_quarantine", "t": 2.0, "action": "quarantine",
+         "replica": 0, "p95_s": 0.9, "fleet_median_s": 0.1},
+        {"event": "fleet_quarantine", "t": 2.5, "action": "readmit",
+         "replica": 0, "probe_s": 0.1, "bound_s": 0.2, "strikes": 0},
+        {"event": "fleet_autoscale", "t": 3.0, "phase": "down",
+         "replica": 1, "n_active": 1},
+        {"event": "fleet_autoscale", "t": 3.1, "phase": "retired",
+         "replica": 1, "n_active": 1},
+        {"event": "fleet_summary", "t": 4.0, "n_images": 100,
+         "n_replicas": 2, "degraded_requests": 7,
+         "degraded_census": {"best_effort:int8": 7},
+         "scale_ups": 1, "scale_downs": 1},
+        {"event": "end", "t": 4.1, "status": "completed"},
+    ])
+    events, skipped = load_events(path)
+    rep = fold(events, skipped)
+    roll = rep["autoscale_rollup"]
+    assert roll["scale_events"] == {"up": 1, "down": 1, "retired": 1}
+    assert roll["final_n_active"] == 1
+    assert roll["brownout_moves"] == 1 and roll["brownout_max_level"] == 1
+    assert roll["hedges_dispatched"] == 1
+    assert roll["hedge_cancels"] == {"won_elsewhere": 1}
+    assert roll["probe_verdicts"] == {"narrow": 1}
+    assert roll["quarantine_actions"] == {"quarantine": 1, "readmit": 1}
+    text = render(rep)
+    assert "-- self-driving fleet" in text
+    assert "scale events: 1 up, 1 down (1 retirements completed)" in text
+    assert "brownout: 1 level moves, deepest level 1" in text
+    assert "hedges: 1 dispatched, cancelled won_elsewhere=1" in text
+    assert "quality probes: narrow=1" in text
+    assert "quarantine: quarantine=1, readmit=1" in text
+    assert "t=1.20s scale up replica 1 -> 2 active" in text
+    assert "t=1.00s brownout level 1" in text
+    assert "degraded requests: 7 (best_effort:int8=7)" in text
+    # A stream without any self-driving events renders no section.
+    plain = fold([{"event": "end", "t": 1.0, "status": "completed"}], 0)
+    assert "autoscale_rollup" not in plain
+    assert "-- self-driving fleet" not in render(plain)
+
+
 def test_health_sections_fold_and_render():
     """The flight-recorder fixture (tests/data/run_fail.jsonl, also the
     run_compare FAIL fixture) carries health + health_fault events: the

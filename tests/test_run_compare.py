@@ -191,6 +191,83 @@ def test_serve_shed_ordering_invariant():
     assert (FAIL, "serve shed ordering") in [(s, a) for s, a, _ in checks]
 
 
+def _autoscale_block(**over):
+    blk = {
+        "min_replicas": 1, "max_replicas": 2, "brownout_enabled": True,
+        "phases": {
+            "surge": {"offered_rate": 300.0, "duration_s": 2.5,
+                      "shed_by_class": {"best_effort": 3},
+                      "interactive_p95_ms": 40.0, "batch_p95_ms": 90.0},
+            "sustain": {"offered_rate": 195.0, "duration_s": 2.0,
+                        "shed_by_class": {},
+                        "interactive_p95_ms": 20.0},
+            "decay": {"offered_rate": 37.5, "duration_s": 2.0,
+                      "shed_by_class": {},
+                      "interactive_p95_ms": 15.0},
+        },
+        "scale_events": [{"event": "fleet_autoscale", "phase": "up",
+                          "n_active": 2, "t_s": 0.4}],
+        "scale_ups": 1, "scale_downs": 1,
+        "degraded_requests": 120,
+        "fixed_fleet_interactive_p95_ms": 70.0,
+    }
+    blk.update(over)
+    return blk
+
+
+def test_serve_profile_extracts_autoscale_phase():
+    rec = _serve_record()
+    rec["fleet"] = dict(rec["fleet"], autoscale=_autoscale_block())
+    p = run_compare.serve_profile(rec, "x.json")
+    assert p["has_autoscale"] and p["autoscale_brownout"]
+    assert p["p95_ms"]["autoscale surge interactive"] == pytest.approx(40.0)
+    assert p["p95_ms"]["autoscale decay interactive"] == pytest.approx(15.0)
+    assert p["autoscale_shed_by_class"] == {"best_effort": 3}
+    assert p["autoscale_surge_interactive_p95"] == pytest.approx(40.0)
+    assert p["fixed_fleet_interactive_p95"] == pytest.approx(70.0)
+
+
+def test_serve_autoscale_gates():
+    """The three autoscale-phase candidate invariants: brownout
+    ordering (degrade before shed), zero interactive sheds, and the
+    surge interactive p95 bounded by the fixed fleet's overload p95."""
+    base = run_compare.serve_profile(_serve_record(), "base.json")
+
+    def cand_with(**over):
+        rec = _serve_record()
+        rec["fleet"] = dict(rec["fleet"],
+                            autoscale=_autoscale_block(**over))
+        return run_compare.serve_profile(rec, "cand.json")
+
+    ok = compare_profiles(base, cand_with(), make_thresholds())
+    for axis in ("serve brownout ordering",
+                 "serve autoscale interactive shed",
+                 "serve autoscale surge p95"):
+        assert (PASS, axis) in [(s, a) for s, a, _ in ok], axis
+
+    # Shed without a single degradation: the ladder was skipped.
+    bad = compare_profiles(base, cand_with(degraded_requests=0),
+                           make_thresholds())
+    assert (FAIL, "serve brownout ordering") in [(s, a) for s, a, _ in bad]
+
+    # Any interactive shed during the trace fails.
+    phases = _autoscale_block()["phases"]
+    phases["surge"] = dict(phases["surge"],
+                           shed_by_class={"interactive": 1})
+    bad = compare_profiles(base, cand_with(phases=phases),
+                           make_thresholds())
+    assert (FAIL, "serve autoscale interactive shed") \
+        in [(s, a) for s, a, _ in bad]
+
+    # Surge interactive p95 above the fixed-fleet reference fails.
+    phases = _autoscale_block()["phases"]
+    phases["surge"] = dict(phases["surge"], interactive_p95_ms=71.0)
+    bad = compare_profiles(base, cand_with(phases=phases),
+                           make_thresholds())
+    assert (FAIL, "serve autoscale surge p95") \
+        in [(s, a) for s, a, _ in bad]
+
+
 def test_serve_cross_platform_pair_skips():
     base = run_compare.serve_profile(_serve_record(), "base.json")
     cand = run_compare.serve_profile(_serve_record(platform="tpu"),

@@ -4,7 +4,7 @@
     python tools/chaos_drill.py             # full drill set
 
 Fault injection (``--inject``) makes failure deterministic; this tool
-makes RECOVERY an asserted invariant instead of a hope. Four drills,
+makes RECOVERY an asserted invariant instead of a hope. Five drills,
 one per recovery subsystem:
 
 - **nan_rollback** — a real `python main.py` training run on synthetic
@@ -35,6 +35,14 @@ one per recovery subsystem:
   and final test metrics equal to the control's. The full set adds the
   deadline-overrun edge: an impossibly small budget must trip the
   armed kill timer (exit 124) rather than hang in the save.
+- **overload_brownout** — the self-driving-fleet drill: an in-process
+  autoscaling FleetExecutor (base+int8 tiers, brownout cascade, hedged
+  dispatch) is hit with mixed-class traffic at ~2x its single-replica
+  drain capacity. The fleet must scale UP within the
+  hysteresis+cooldown bound, the brownout must engage (degraded
+  requests served cheaper) BEFORE any shed, `interactive` must see
+  zero sheds and an in-deadline p95 throughout, and after the surge
+  decays the fleet must drain-and-retire back down to min_replicas.
 
 Output: one JSON line on stdout
 (``{"metric": "cyclegan_chaos_drill", ..., "pass": bool}``), human
@@ -495,6 +503,177 @@ def drill_elastic_resume(workdir: str, fast: bool) -> dict:
     return {"pass": all(checks.values()), "detail": detail}
 
 
+# --------------------------------------------------------------- drill (e)
+
+def drill_overload_brownout(fast: bool) -> dict:
+    """Mixed-class traffic at ~2x measured drain capacity against an
+    autoscaling, brownout-enabled fleet: scale-up inside the
+    hysteresis+cooldown bound, degrade-before-shed ordering, zero
+    interactive sheds with an in-deadline p95, scale back down after
+    the surge decays."""
+    import concurrent.futures as cf
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cyclegan_tpu.config import GeneratorConfig, ModelConfig
+    from cyclegan_tpu.serve.engine import (
+        InferenceEngine,
+        ServeConfig,
+        build_generator,
+    )
+    from cyclegan_tpu.serve.fleet import (
+        AutoscaleConfig,
+        CascadeConfig,
+        DeadlineExceeded,
+        FleetConfig,
+        FleetExecutor,
+        ReplicaCrashed,
+        ShedError,
+    )
+
+    checks = {}
+    cfg = ModelConfig(
+        generator=GeneratorConfig(filters=4, num_residual_blocks=1),
+        image_size=16, compute_dtype="float32")
+    gen = build_generator(cfg)
+    params = gen.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, 16, 16, 3), jnp.float32))
+    engine = InferenceEngine(
+        cfg, params,
+        serve_cfg=ServeConfig(batch_buckets=(1, 2), sizes=(16,),
+                              int8_tier=True))
+    rec = _Recorder()
+    # Capacity must leave backlog headroom ABOVE the autoscale trigger
+    # (capacity/drain > up_backlog_s), or the queue saturates and sheds
+    # while the backlog signal never crosses the scale-up threshold.
+    auto = AutoscaleConfig(min_replicas=1, max_replicas=3, eval_s=0.05,
+                           hysteresis=2, cooldown_s=0.4,
+                           up_backlog_s=0.1)
+    casc = CascadeConfig(tiers=("base", "int8"), enter_backlog_s=0.05,
+                         exit_backlog_s=0.02, hysteresis=2,
+                         cooldown_s=0.1, shadow_fraction=0.1)
+    ex = FleetExecutor(
+        engine,
+        FleetConfig(n_replicas=1, capacity=256, max_wait_ms=2.0,
+                    health_poll_s=0.02, autoscale=auto, cascade=casc,
+                    hedge_ms=500.0),
+        logger=rec)
+    rng = np.random.RandomState(0)
+    img = rng.rand(16, 16, 3).astype(np.float32)
+    # Deterministic 2/3/5 interactive/batch/best_effort mix.
+    mix = (["interactive"] * 2 + ["batch"] * 3 + ["best_effort"] * 5)
+    futs = []
+    ok = shed = expired = 0
+
+    def _submit(klass):
+        nonlocal shed
+        try:
+            futs.append(ex.submit(img.copy(), klass=klass))
+        except ShedError:
+            shed += 1
+
+    try:
+        # Calibrate: closed-loop wave to measure single-replica drain.
+        warm = [ex.submit(img.copy(), klass="batch") for _ in range(8)]
+        cf.wait(warm, timeout=60.0)
+        t0 = time.perf_counter()
+        warm2 = [ex.submit(img.copy(), klass="batch") for _ in range(24)]
+        cf.wait(warm2, timeout=60.0)
+        drain = 24.0 / max(time.perf_counter() - t0, 1e-3)
+        futs.extend(warm + warm2)
+        # Surge: open-loop at ~2x the measured drain, in 5 ms ticks.
+        surge_s = 2.5 if fast else 6.0
+        tick_s = 0.005
+        per_tick = max(1, int(round(2.0 * drain * tick_s)))
+        t_surge = time.perf_counter()
+        t_up = None
+        i = 0
+        while time.perf_counter() - t_surge < surge_s:
+            for _ in range(per_tick):
+                _submit(mix[i % len(mix)])
+                i += 1
+            if t_up is None and any(
+                    e.get("phase") == "up"
+                    for e in rec.of("fleet_autoscale")):
+                t_up = time.perf_counter() - t_surge
+            time.sleep(tick_s)
+        # Scale-up must land within the structural bound: hysteresis
+        # evaluations plus the cooldown plus monitor slack.
+        up_bound = (auto.hysteresis * auto.eval_s + auto.cooldown_s
+                    + 20 * 0.02 + 1.0)
+        checks["scaled_up"] = t_up is not None
+        checks["scale_up_within_bound"] = (t_up is not None
+                                           and t_up <= up_bound)
+        # Degrade-before-shed: the first brownout level-raise precedes
+        # the first shed in the event stream (trivially true when the
+        # cascade absorbed the whole surge and nothing shed).
+        kinds = rec.kinds()
+        first_brown = next(
+            (j for j, e in enumerate(rec.events)
+             if e["event"] == "fleet_brownout" and e.get("level", 0) >= 1),
+            None)
+        first_shed = next(
+            (j for j, k in enumerate(kinds) if k == "fleet_shed"), None)
+        checks["brownout_engaged"] = first_brown is not None
+        checks["degrade_before_shed"] = (
+            first_brown is not None
+            and (first_shed is None or first_brown < first_shed))
+        checks["zero_interactive_sheds"] = not any(
+            e.get("klass") == "interactive" for e in rec.of("fleet_shed"))
+        # Decay: stop submitting, drain the queue, and the fleet must
+        # retire back to min_replicas (drain-before-retire, so nothing
+        # strands).
+        done, not_done = cf.wait(futs, timeout=120.0)
+        checks["no_hung_futures"] = len(not_done) == 0
+        for f in done:
+            err = f.exception()
+            if err is None:
+                ok += 1
+            elif isinstance(err, (ShedError, DeadlineExceeded,
+                                  ReplicaCrashed)):
+                expired += 1
+            else:
+                checks["typed_failures_only"] = False
+        checks.setdefault("typed_failures_only", True)
+        deadline = time.perf_counter() + 30.0
+        n_active = ex.stats()["n_replicas_active"]
+        while time.perf_counter() < deadline and n_active > 1:
+            time.sleep(0.05)
+            n_active = ex.stats()["n_replicas_active"]
+        stats = ex.stats()
+        checks["scaled_back_down"] = n_active == auto.min_replicas
+        checks["degraded_served_cheaper"] = stats["degraded_requests"] > 0
+        checks["shadow_probes_sampled"] = (
+            stats["brownout"]["shadow"]["submitted"] >= 1)
+        inter = stats["classes"].get("interactive", {})
+        checks["interactive_p95_in_deadline"] = (
+            inter.get("n", 0) > 0 and inter.get("p95_s", 99.0) <= 0.5)
+        checks["no_recovery_needed"] = stats["recoveries"] == 0
+    finally:
+        summary = ex.close()
+    checks["all_replicas_joined"] = summary.get("unjoined_replicas") == []
+    return {
+        "pass": all(checks.values()),
+        "detail": {
+            "checks": checks,
+            "drain_calibrated_per_s": round(drain, 1),
+            "submitted": len(futs) + shed,
+            "served": ok,
+            "shed_submit": shed,
+            "typed_failures": expired,
+            "t_scale_up_s": round(t_up, 3) if t_up is not None else None,
+            "scale_ups": summary.get("scale_ups"),
+            "scale_downs": summary.get("scale_downs"),
+            "degraded": summary.get("degraded_requests"),
+            "degraded_census": summary.get("degraded_census"),
+            "interactive": summary.get("classes", {}).get("interactive"),
+            "shed_total": summary.get("shed"),
+        },
+    }
+
+
 # ------------------------------------------------------------------ driver
 
 def run_drills(workdir: str, fast: bool, only=None) -> dict:
@@ -507,6 +686,7 @@ def run_drills(workdir: str, fast: bool, only=None) -> dict:
         ("fleet_crash", lambda: drill_fleet_crash(12 if fast else 24)),
         ("ckpt_retry", lambda: drill_ckpt_retry(workdir)),
         ("elastic_resume", lambda: drill_elastic_resume(workdir, fast)),
+        ("overload_brownout", lambda: drill_overload_brownout(fast)),
     ]
     for name, fn in plan:
         if only and name not in only:
@@ -542,7 +722,7 @@ def main(argv=None) -> int:
                         "fleet load, skip the rollback-budget edge case")
     p.add_argument("--only", action="append", default=None,
                    choices=["nan_rollback", "fleet_crash", "ckpt_retry",
-                            "elastic_resume"],
+                            "elastic_resume", "overload_brownout"],
                    help="run a subset (repeatable)")
     p.add_argument("--workdir", default=None,
                    help="scratch dir (default: a fresh temp dir)")

@@ -238,8 +238,11 @@ def build_queue(mode: str, round_tag: str = ROUND_TAG) -> list:
         # Chaos drill on chip (resil acceptance): the same scripted
         # fault drills tier-1 runs on CPU — NaN rollback through the
         # verified ring, replica-crash self-healing, retried ckpt I/O,
-        # and the elastic preempt/resume drill (full set here, including
-        # the deadline-overrun kill edge tier-1 skips in --fast mode) —
+        # the elastic preempt/resume drill (full set here, including
+        # the deadline-overrun kill edge tier-1 skips in --fast mode),
+        # and the overload_brownout drill (autoscaler grows/retires
+        # replicas through a surge while the brownout cascade degrades
+        # tiers before shedding and hedged dispatch covers the tail) —
         # executed against the real accelerator path. One JSON line,
         # exit nonzero if any recovery invariant fails.
         Step("chaos_drill", [py, "tools/chaos_drill.py"], 3600.0,

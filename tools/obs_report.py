@@ -105,6 +105,16 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
         "fleet_flushes": [],    # per-flush fleet dispatcher events
         "fleet_sheds": [],      # admission-control shed decisions
         "fleet_summary": None,  # FleetExecutor close() rollup
+        # Self-driving fleet overlay (autoscaler + brownout cascade +
+        # hedged dispatch + p95 quarantine): scale decisions, cascade
+        # level moves, hedge dispatch/cancel pairs, shadow-probe
+        # verdicts, quarantine lifecycle.
+        "fleet_autoscales": [],
+        "fleet_brownouts": [],
+        "fleet_hedges": [],
+        "fleet_hedge_cancels": [],
+        "fleet_quality_probes": [],
+        "fleet_quarantines": [],
         # Resilience stream (cyclegan_tpu/resil): injected faults, I/O
         # retries, rollback recoveries, fleet self-healing.
         "fault_injections": [],
@@ -171,6 +181,18 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             report["fleet_sheds"].append(ev)
         elif kind == "fleet_summary":
             report["fleet_summary"] = ev
+        elif kind == "fleet_autoscale":
+            report["fleet_autoscales"].append(ev)
+        elif kind == "fleet_brownout":
+            report["fleet_brownouts"].append(ev)
+        elif kind == "fleet_hedge":
+            report["fleet_hedges"].append(ev)
+        elif kind == "fleet_hedge_cancel":
+            report["fleet_hedge_cancels"].append(ev)
+        elif kind == "fleet_quality_probe":
+            report["fleet_quality_probes"].append(ev)
+        elif kind == "fleet_quarantine":
+            report["fleet_quarantines"].append(ev)
         elif kind == "fault_injected":
             report["fault_injections"].append(ev)
         elif kind == "retry":
@@ -313,6 +335,44 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             "shed_by_reason": shed_reason,
             "max_queue_depth": max(
                 (int(ev.get("queue_depth", 0)) for ev in ff), default=0),
+        }
+
+    # Self-driving-fleet rollup: the scale decision census, how deep
+    # the brownout ladder went, hedge economics (dispatched vs the two
+    # cancel flavors), shadow-probe verdicts, and the quarantine
+    # lifecycle — the "did the fleet drive itself sensibly" block.
+    if (report["fleet_autoscales"] or report["fleet_brownouts"]
+            or report["fleet_hedges"] or report["fleet_hedge_cancels"]
+            or report["fleet_quality_probes"]
+            or report["fleet_quarantines"]):
+        scale_phases: Dict[str, int] = {}
+        for ev in report["fleet_autoscales"]:
+            p = str(ev.get("phase", "?"))
+            scale_phases[p] = scale_phases.get(p, 0) + 1
+        cancels: Dict[str, int] = {}
+        for ev in report["fleet_hedge_cancels"]:
+            r = str(ev.get("reason", "?"))
+            cancels[r] = cancels.get(r, 0) + 1
+        verdicts: Dict[str, int] = {}
+        for ev in report["fleet_quality_probes"]:
+            v = str(ev.get("verdict", "?"))
+            verdicts[v] = verdicts.get(v, 0) + 1
+        q_actions: Dict[str, int] = {}
+        for ev in report["fleet_quarantines"]:
+            a = str(ev.get("action", "?"))
+            q_actions[a] = q_actions.get(a, 0) + 1
+        levels = [int(ev.get("level", 0))
+                  for ev in report["fleet_brownouts"]]
+        report["autoscale_rollup"] = {
+            "scale_events": scale_phases,
+            "final_n_active": (report["fleet_autoscales"][-1].get("n_active")
+                               if report["fleet_autoscales"] else None),
+            "brownout_moves": len(levels),
+            "brownout_max_level": max(levels, default=0),
+            "hedges_dispatched": len(report["fleet_hedges"]),
+            "hedge_cancels": cancels,
+            "probe_verdicts": verdicts,
+            "quarantine_actions": q_actions,
         }
     return report
 
@@ -667,6 +727,58 @@ def render(report: dict) -> str:
             w(f"  class {name}: n={row.get('n', '?')} "
               f"p50 {_fmt(row.get('p50_s'))}s / p95 {_fmt(row.get('p95_s'))}s"
               f"  deadline misses: {row.get('deadline_misses', 0)}")
+
+    aroll = report.get("autoscale_rollup")
+    if aroll:
+        w("-- self-driving fleet (autoscale / brownout / hedging / "
+          "quarantine) --")
+        if aroll["scale_events"]:
+            ups = aroll["scale_events"].get("up", 0)
+            downs = aroll["scale_events"].get("down", 0)
+            retired = aroll["scale_events"].get("retired", 0)
+            w(f"scale events: {ups} up, {downs} down "
+              f"({retired} retirements completed), final active "
+              f"{aroll['final_n_active']}")
+        if aroll["brownout_moves"]:
+            w(f"brownout: {aroll['brownout_moves']} level moves, "
+              f"deepest level {aroll['brownout_max_level']}")
+        if aroll["hedges_dispatched"] or aroll["hedge_cancels"]:
+            canc = ", ".join(f"{k}={v}" for k, v in
+                             sorted(aroll["hedge_cancels"].items()))
+            w(f"hedges: {aroll['hedges_dispatched']} dispatched"
+              + (f", cancelled {canc}" if canc else ""))
+        if aroll["probe_verdicts"]:
+            w("quality probes: " + ", ".join(
+                f"{k}={v}" for k, v in
+                sorted(aroll["probe_verdicts"].items())))
+        if aroll["quarantine_actions"]:
+            w("quarantine: " + ", ".join(
+                f"{k}={v}" for k, v in
+                sorted(aroll["quarantine_actions"].items())))
+        # Scale timeline (stream order, capped): WHEN the fleet moved
+        # and what the brownout ladder was doing around each move.
+        timeline = sorted(
+            report["fleet_autoscales"] + report["fleet_brownouts"],
+            key=lambda ev: float(ev.get("t", 0.0)))
+        for ev in timeline[:20]:
+            if ev.get("event") == "fleet_autoscale":
+                w(f"  t={_fmt(ev.get('t'), '.2f')}s scale "
+                  f"{ev.get('phase', '?')} replica {ev.get('replica', '?')} "
+                  f"-> {ev.get('n_active', '?')} active")
+            else:
+                w(f"  t={_fmt(ev.get('t'), '.2f')}s brownout level "
+                  f"{ev.get('level', '?')} (backlog "
+                  f"{_fmt(ev.get('backlog_s'), '.3f')}s, steps "
+                  f"{ev.get('steps_by_class') or {}})")
+        if len(timeline) > 20:
+            w(f"  ... {len(timeline) - 20} more scale/brownout events")
+        fs = report["fleet_summary"] or {}
+        if fs.get("degraded_requests"):
+            census = ", ".join(
+                f"{k}={v}" for k, v in
+                sorted((fs.get("degraded_census") or {}).items()))
+            w(f"degraded requests: {fs['degraded_requests']}"
+              + (f" ({census})" if census else ""))
 
     lint = report.get("lint")
     if lint:

@@ -37,11 +37,20 @@ SKIPPED, not failed: the committed series legally changes platform.
 
 bench_serve records (metric `cyclegan_serve_*`) get a serving axis:
 saturated pipeline + fleet + int8-tier images/sec (each gated by
---max_bench_drop), the p95 latency set — low-load, saturated, and the
-overload sweep's per-class p95s — gated by --max_serve_p95_increase,
-and the class-ordered-shedding invariant (a candidate that sheds
-`interactive` while `best_effort` goes unshed FAILS regardless of the
-base). The same cross-platform SKIP rule applies.
+--max_bench_drop), the p95 latency set — low-load, saturated, the
+overload sweep's per-class p95s, and the autoscale phases' per-class
+p95s — gated by --max_serve_p95_increase, and the class-ordered-
+shedding invariant (a candidate that sheds `interactive` while
+`best_effort` goes unshed FAILS regardless of the base). When the
+record carries the autoscale phase two more candidate invariants
+engage, gated the same way shed ordering is: brownout ordering (a
+brownout-enabled fleet that shed ANY request while degrading NONE
+skipped the cheap-tier rung of the ladder) and the surge interactive
+bound (interactive p95 during the surge must not exceed the fixed
+fleet's overload interactive p95, and the autoscale trace must shed
+zero interactive requests — the self-driving fleet has to do at least
+as well as static overprovisioning). The same cross-platform SKIP
+rule applies.
 
 With 3+ files the tool runs the consecutive-pair gate over the whole
 series (this is how bench.py's end-of-run hook uses it: newest
@@ -146,6 +155,28 @@ def serve_profile(record: dict, name: str = "?") -> dict:
             p95[f"overload {str(k)[:-len('_p95_ms')]}"] = fv
     shed = overload.get("shed_by_class") \
         if isinstance(overload.get("shed_by_class"), dict) else {}
+    # Autoscale phase (surge -> sustain -> decay through the
+    # self-driving fleet): per-phase per-class p95s join the diffable
+    # p95 set; the shed/degraded censuses feed the candidate-side
+    # ordering invariants in _compare_serve.
+    autoscale = fleet.get("autoscale") \
+        if isinstance(fleet.get("autoscale"), dict) else {}
+    auto_phases = autoscale.get("phases") \
+        if isinstance(autoscale.get("phases"), dict) else {}
+    auto_shed: Dict[str, int] = {}
+    for phase, row in sorted(auto_phases.items()):
+        if not isinstance(row, dict):
+            continue
+        for k, v in row.items():
+            if str(k).endswith("_p95_ms") and (fv := _float(v)) is not None:
+                p95[f"autoscale {phase} {str(k)[:-len('_p95_ms')]}"] = fv
+        by_class = row.get("shed_by_class") \
+            if isinstance(row.get("shed_by_class"), dict) else {}
+        for k, v in by_class.items():
+            if isinstance(v, (int, float)):
+                auto_shed[str(k)] = auto_shed.get(str(k), 0) + int(v)
+    surge = auto_phases.get("surge") \
+        if isinstance(auto_phases.get("surge"), dict) else {}
     return {
         "kind": "serve",
         "name": name,
@@ -158,6 +189,16 @@ def serve_profile(record: dict, name: str = "?") -> dict:
         "p95_ms": p95,
         "shed_by_class": {str(k): int(v) for k, v in shed.items()
                           if isinstance(v, (int, float))},
+        "has_autoscale": bool(autoscale),
+        "autoscale_brownout": bool(autoscale.get("brownout_enabled")),
+        "autoscale_degraded": int(autoscale.get("degraded_requests") or 0),
+        "autoscale_shed_by_class": auto_shed,
+        "autoscale_surge_interactive_p95": _float(
+            surge.get("interactive_p95_ms")),
+        "fixed_fleet_interactive_p95": _float(
+            autoscale.get("fixed_fleet_interactive_p95_ms")),
+        "autoscale_scale_ups": autoscale.get("scale_ups"),
+        "autoscale_scale_downs": autoscale.get("scale_downs"),
     }
 
 
@@ -361,6 +402,43 @@ def _compare_serve(base: dict, cand: dict, th) -> List[Check]:
     else:
         checks.append((INFO, "serve shed ordering",
                        "no overload shedding recorded"))
+    # Autoscale-phase invariants — like shed ordering, these judge the
+    # CANDIDATE alone (the base may predate the self-driving fleet).
+    if cand.get("has_autoscale"):
+        auto_shed = cand.get("autoscale_shed_by_class") or {}
+        degraded = cand.get("autoscale_degraded", 0)
+        n_shed = sum(auto_shed.values())
+        if cand.get("autoscale_brownout"):
+            # Brownout ordering: the ladder degrades tiers BEFORE the
+            # queue sheds. A brownout-enabled fleet that shed anything
+            # without degrading anything skipped its cheap-tier rungs.
+            ordered = not (n_shed > 0 and degraded == 0)
+            checks.append((
+                PASS if ordered else FAIL, "serve brownout ordering",
+                f"autoscale trace degraded {degraded} request(s), shed "
+                f"{_fmt_kinds(auto_shed)}"
+                + ("" if ordered else
+                   " — shed without degrading (brownout never engaged)")))
+        n_int = auto_shed.get("interactive", 0)
+        checks.append((
+            PASS if n_int == 0 else FAIL, "serve autoscale interactive shed",
+            f"{n_int} interactive request(s) shed across the autoscale "
+            f"trace (any is a failure: interactive work rides out the "
+            f"surge on scale-up + brownout)"))
+        sp95 = cand.get("autoscale_surge_interactive_p95")
+        ref = cand.get("fixed_fleet_interactive_p95")
+        if sp95 is not None and ref is not None:
+            checks.append((
+                PASS if sp95 <= ref else FAIL,
+                "serve autoscale surge p95",
+                f"surge interactive p95 {sp95:.1f} ms vs fixed-fleet "
+                f"overload {ref:.1f} ms (must not exceed it)"))
+        else:
+            checks.append((SKIP, "serve autoscale surge p95",
+                           "surge or fixed-fleet interactive p95 missing"))
+        checks.append((INFO, "serve autoscale churn",
+                       f"scale_ups {cand.get('autoscale_scale_ups')}, "
+                       f"scale_downs {cand.get('autoscale_scale_downs')}"))
     return checks
 
 
