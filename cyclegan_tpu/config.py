@@ -193,18 +193,40 @@ class LossConfig:
 class DataConfig:
     """Input pipeline (reference main.py:18-83)."""
 
+    # Registry key for this run's domain pair (domains/registry.py): the
+    # identity recorded in checkpoint sidecars, telemetry manifests, and
+    # fleet tenant tables. `--domain <key>` resolves a DomainSpec and
+    # fills the fields below; constructing a DataConfig by hand with a
+    # mismatched key is legal (tests do) but the key is what downstream
+    # compatibility checks trust.
+    domain: str = "horse2zebra"
     dataset: str = "horse2zebra"  # main.py:22 ("cycle_gan/horse2zebra")
     data_dir: Optional[str] = None  # folder with trainA/trainB/testA/testB
     source: str = "auto"  # "tfds" | "folder" | "synthetic" | "auto"
     resize_size: int = 286  # main.py:14
     crop_size: int = 256  # main.py:15
     shuffle_buffer: int = 256  # main.py:20
+    # Horizontal-flip augmentation (reference main.py:41 flips always).
+    # Directional domain pairs (maps, facades) set False via their
+    # DomainSpec — mirroring breaks left/right-asymmetric content.
+    augment_flip: bool = True
     # Reference quirk: `.cache()` AFTER random augmentation (main.py:53-54)
     # freezes the augmentations after epoch 1. Reproduced by default;
     # set False for fresh augmentations every epoch.
     cache_augmented: bool = True
     synthetic_train_size: int = 64  # samples per domain when source=synthetic
     synthetic_test_size: int = 16
+
+    def __post_init__(self):
+        # The domain key names sidecar records, telemetry fields, and
+        # tenant-table entries — an empty or malformed key would
+        # propagate into every downstream identity check.
+        from cyclegan_tpu.domains.registry import DomainError, _KEY_RE
+
+        if not _KEY_RE.match(self.domain or ""):
+            raise DomainError(
+                f"data.domain {self.domain!r} is not a valid domain key "
+                f"(want {_KEY_RE.pattern})")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -265,6 +287,20 @@ class TrainConfig:
     #               the saving is one disc forward + one activation
     #               backward per fake (utils/flops.py: 14d vs 16d).
     grad_impl: str = "combined"  # "combined" | "fusedprop"
+    # Mind2Mind transfer onboarding (domains/transfer.py; PAPERS.md
+    # arXiv:1906.11613). init_from names a PARENT run directory whose
+    # verified checkpoint ring seeds this run's four param trees
+    # (optimizer state and step start fresh); transfer_mode
+    # "encoder_freeze" additionally pins both generators' encoder
+    # trunks (c7s1 stem + downsampling blocks) by zeroing their
+    # gradients inside the jitted step. Provenance (parent_ckpt,
+    # parent_domain, transfer_mode) is recorded in every sidecar.
+    init_from: Optional[str] = None
+    transfer_mode: str = "full_finetune"  # "full_finetune" | "encoder_freeze"
+    # Refuse (rather than warn) when a restored checkpoint's sidecar
+    # domain key differs from this run's --domain. Off by default:
+    # cross-domain restore is exactly what transfer onboarding does.
+    strict_domain: bool = False
     # Preemption grace budget in seconds (resil/elastic.py). 0 = the
     # historical protocol: a SIGTERM finishes the in-flight EPOCH, then
     # checkpoints. > 0 arms mid-epoch emergency saves: the dispatch loop
@@ -286,6 +322,11 @@ class TrainConfig:
             raise ValueError(
                 f"train.grad_impl must be 'combined' or 'fusedprop', got "
                 f"{self.grad_impl!r}"
+            )
+        if self.transfer_mode not in ("full_finetune", "encoder_freeze"):
+            raise ValueError(
+                f"train.transfer_mode must be 'full_finetune' or "
+                f"'encoder_freeze', got {self.transfer_mode!r}"
             )
         if self.preempt_deadline_s < 0:
             raise ValueError(

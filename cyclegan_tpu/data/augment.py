@@ -70,14 +70,19 @@ def preprocess_train(
     crop_size: int = 256,
     use_native: bool | None = None,
     normalize: bool = True,
+    allow_flip: bool = True,
 ) -> np.ndarray:
     """Random flip -> resize -> random crop -> normalize (main.py:40-45).
 
     Dispatches to the fused C++ kernel (data/native.py) when built,
     falling back to the identical-algorithm numpy path. normalize=False
-    returns uint8 (cache format, see quantize_uint8).
+    returns uint8 (cache format, see quantize_uint8). allow_flip=False
+    (directional domain pairs, DomainSpec.augment_flip) suppresses the
+    mirror AFTER drawing the decision stream, so crop offsets are
+    identical with flipping on or off.
     """
     flip, oy, ox = draw_augment_params(rng, resize_size, crop_size)
+    flip = flip and allow_flip
     if use_native is None or use_native:
         from cyclegan_tpu.data import native
 

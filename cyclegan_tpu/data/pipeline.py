@@ -155,6 +155,7 @@ class CycleGANData:
             c.resize_size,
             c.crop_size,
             normalize=False,
+            allow_flip=c.augment_flip,
         )
 
     # Native preprocessing window: bounds the transient raw uint8 stack
@@ -180,7 +181,8 @@ class CycleGANData:
                 for i in range(lo, hi):
                     rng = self._sample_rng(split, epoch, i)
                     f, oy, ox = draw_augment_params(rng, c.resize_size, c.crop_size)
-                    flips.append(int(f)); oys.append(oy); oxs.append(ox)
+                    flips.append(int(f and c.augment_flip))
+                    oys.append(oy); oxs.append(ox)
                 out.extend(native.preprocess_batch(
                     np.stack(raws), c.resize_size,
                     np.asarray(flips, np.int32), np.asarray(oys, np.int32),
@@ -192,6 +194,7 @@ class CycleGANData:
                     preprocess_train(
                         raws[i - lo], self._sample_rng(split, epoch, i),
                         c.resize_size, c.crop_size, normalize=False,
+                        allow_flip=c.augment_flip,
                     )
                     for i in range(lo, hi)
                 )

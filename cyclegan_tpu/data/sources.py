@@ -174,8 +174,13 @@ class TFDSSource:
 
 def resolve_source(data_config) -> Source:
     """Pick a source per config: explicit, else folder if data_dir given,
-    else TFDS if importable, else synthetic."""
+    else TFDS if importable, else synthetic. The config's fields are
+    normally filled from a DomainSpec (domains/registry.py
+    data_config_for), so `--domain <key>` lands here with source/dataset
+    /data_dir already resolved; errors name the domain key so a bad
+    registry entry points back at its spec."""
     c = data_config
+    domain = getattr(c, "domain", None) or "?"
 
     def synthetic():
         return SyntheticSource(
@@ -186,7 +191,9 @@ def resolve_source(data_config) -> Source:
         return synthetic()
     if c.source == "folder" or (c.source == "auto" and c.data_dir):
         if not c.data_dir:
-            raise ValueError("--data_source folder requires --data_dir")
+            raise ValueError(
+                f"domain {domain!r}: source 'folder' requires a data_dir "
+                f"(--data_dir, or the spec's data_dir field)")
         return FolderSource(c.data_dir)
     if c.source == "tfds":
         return TFDSSource(c.dataset, data_dir=c.data_dir)

@@ -1,0 +1,23 @@
+"""N-domain scenario engine: the registry of domain-pair specs and the
+Mind2Mind transfer-onboarding path.
+
+`registry.py` owns the declarative specs and the `(domain, tier)` key
+grammar every other layer speaks — checkpoint sidecars, run_compare
+records, and the multi-tenant fleet's tenant table all key off the
+registry's domain keys (docs/DESIGN.md §domain registry).
+
+`transfer.py` owns new-domain onboarding from a trained parent
+checkpoint (`--init_from` / `--transfer`): verified-ring restore,
+encoder-trunk freezing via masked optimizer updates, and provenance
+recording.
+"""
+
+from cyclegan_tpu.domains.registry import (  # noqa: F401
+    DomainError,
+    DomainRegistry,
+    DomainSpec,
+    data_config_for,
+    default_registry,
+    split_tenant_key,
+    tenant_key,
+)

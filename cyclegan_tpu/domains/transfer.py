@@ -1,0 +1,241 @@
+"""Mind2Mind-style transfer onboarding: fine-tune a new domain pair
+from a trained parent checkpoint in a fraction of full training.
+
+Mind2Mind (arXiv:1906.11613, PAPERS.md) transfers a trained GAN to a
+new dataset by reusing the learned encoder and training the rest — the
+encoder's low/mid-level features (edges, textures, color statistics)
+are domain-generic, so the new pair only has to learn the high-level
+translation. Here that is the "new customer onboarding" path for the
+production service (ROADMAP item 4): `--init_from <parent_run>` seeds
+the four networks from the parent's verified checkpoint ring, and
+`--transfer encoder_freeze` additionally pins both generators' encoder
+trunks (the c7s1 stem + downsampling blocks) by masking their
+gradients to zero before the optimizer ever sees them.
+
+Design points:
+
+- **Restore rides the existing verified-ring path.** The parent's
+  params come out of `Checkpointer.restore` — manifest verification,
+  newest-verified-slot walk, donation-aliasing `_rebuffer`, strict
+  shape checking — not a second ad-hoc loader. Only the PARAMS
+  transfer; the child starts with fresh optimizer state and step 0
+  (fine-tuning wants fresh Adam moments, and it keeps the child's own
+  checkpoint ring structurally independent of the parent's).
+- **Freezing is gradient masking, not optimizer-state surgery.** The
+  frozen leaves' gradients are zeroed INSIDE the jitted step (steps.py
+  wraps make_grad_fn), so every step variant (plain, accum, shard_map,
+  fusedprop) inherits the mask, Adam's zero-gradient fixed point keeps
+  the updates at exactly 0, and the optimizer state tree is
+  structurally identical to an unfrozen run — checkpoints interchange
+  and the elastic/reshard path needs no special case.
+- **The frozen group is health-monitored as its own network group.**
+  `health/gnorm_enc_frozen` / `health/upd_ratio_enc_frozen` ride the
+  metrics dict like every health stat; both must pin at 0 — a nonzero
+  value means the mask regressed, and tools/obs_report.py's transfer
+  rollup flags it as a finding.
+- **Provenance is recorded in the sidecar.** Every save of a transfer
+  run carries {parent_ckpt, parent_epoch, parent_domain, transfer_mode,
+  domain} (resil/elastic.py save_meta), so a served model's lineage is
+  answerable from its slot alone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+from cyclegan_tpu.domains.registry import DEFAULT_DOMAIN, DomainError
+
+TRANSFER_MODES = ("full_finetune", "encoder_freeze")
+
+# Top-level generator modules forming the encoder trunk: the c7s1 stem
+# and the downsampling blocks (models/generator.py). Everything else
+# (residual trunk, upsample blocks, tail conv) stays trainable.
+ENCODER_MODULES = ("Conv_0", "Downsample_0", "Downsample_1")
+
+
+class TransferError(ValueError):
+    """A transfer request that cannot be satisfied (bad mode, missing
+    parent ring, architecture mismatch) — raised before training."""
+
+
+def validate_mode(mode: str) -> str:
+    if mode not in TRANSFER_MODES:
+        raise TransferError(
+            f"transfer mode must be one of {TRANSFER_MODES}, "
+            f"got {mode!r}")
+    return mode
+
+
+# --------------------------------------------------------- freeze mask
+
+
+def _is_frozen_path(path) -> bool:
+    """True for a tree path inside the encoder trunk. Paths look like
+    (DictKey('params'), DictKey('Conv_0'), ...) on generator trees."""
+    for entry in path:
+        key = getattr(entry, "key", None)
+        if key in ENCODER_MODULES:
+            return True
+    return False
+
+
+def mask_encoder_grads(grad_tree):
+    """Zero every encoder-trunk leaf of ONE generator gradient tree.
+    Runs inside the jitted step (pure tree surgery at trace time)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, g: jnp.zeros_like(g) if _is_frozen_path(path) else g,
+        grad_tree)
+
+
+def apply_freeze(grads: Tuple) -> Tuple:
+    """Mask the two generator gradient trees of the (g, f, dx, dy)
+    tuple; discriminators always train (they must re-learn the new
+    domain's real/fake boundary even when the encoders are pinned)."""
+    g_g, g_f, g_dx, g_dy = grads
+    return (mask_encoder_grads(g_g), mask_encoder_grads(g_f), g_dx, g_dy)
+
+
+def frozen_leaves(tree):
+    """The encoder-trunk leaves of one generator tree (health metrics
+    reduce over these)."""
+    import jax
+
+    leaves = []
+    jax.tree_util.tree_map_with_path(
+        lambda path, x: leaves.append(x) if _is_frozen_path(path) else None,
+        tree)
+    return leaves
+
+
+# ------------------------------------------------- domain compatibility
+
+
+def sidecar_domain(meta: Optional[dict]) -> str:
+    """The domain key a sidecar records; legacy sidecars (pre-domain
+    stacks, back-taggable via utils/convert.py) read as the default."""
+    if not isinstance(meta, dict):
+        return DEFAULT_DOMAIN
+    domain = meta.get("domain")
+    return str(domain) if domain else DEFAULT_DOMAIN
+
+
+def check_domain_compat(meta: Optional[dict], domain: str, strict: bool,
+                        context: str = "restore", telemetry=None,
+                        echo=None) -> bool:
+    """Compare a checkpoint sidecar's domain key against the run's.
+    Match -> True. Mismatch -> warn (and emit a `domain_mismatch`
+    event); with `strict` (--strict_domain) refuse instead — resuming
+    horse2zebra training on a monet2photo ring silently poisons both.
+    Transfer onboarding calls this too: cross-domain is the POINT
+    there, so transfer runs leave strict off unless the operator pins
+    it. Returns False on a non-strict mismatch."""
+    saved = sidecar_domain(meta)
+    if saved == domain:
+        return True
+    msg = (f"{context}: checkpoint domain {saved!r} does not match this "
+           f"run's domain {domain!r}")
+    if telemetry is not None:
+        telemetry.event("domain_mismatch", context=context,
+                        checkpoint_domain=saved, run_domain=domain,
+                        strict=bool(strict))
+    if strict:
+        raise DomainError(
+            msg + " — refused under --strict_domain (drop the flag to "
+                  "proceed, e.g. for deliberate cross-domain transfer)")
+    if echo is not None:
+        echo(f"WARNING: {msg} (continuing; --strict_domain refuses)")
+    return False
+
+
+# ------------------------------------------------------ parent restore
+
+
+def restore_parent(config, template_state, telemetry=None, echo=None):
+    """Seed a fresh training state with the parent checkpoint's params.
+
+    Returns (state, provenance). `template_state` is the CHILD's
+    freshly-created CycleGANState — the parent must match its param
+    structure exactly (the verified-ring restore's strict shape check
+    enforces this), which is precisely Mind2Mind's contract: same
+    architecture, new domains. Optimizer state and step stay fresh.
+    """
+    from cyclegan_tpu.utils.checkpoint import Checkpointer
+
+    parent_dir = config.train.init_from
+    mode = validate_mode(config.train.transfer_mode)
+    ckpt = Checkpointer(parent_dir, keep=1, telemetry=telemetry)
+    if not ckpt.slots():
+        raise TransferError(
+            f"--init_from {parent_dir!r}: no checkpoint slots found "
+            f"(want a run directory whose checkpoints/ ring has at "
+            f"least one verified slot)")
+    meta = ckpt.read_meta()
+    parent_domain = sidecar_domain(meta)
+    check_domain_compat(
+        meta, config.data.domain, strict=config.train.strict_domain,
+        context="transfer init", telemetry=telemetry, echo=echo)
+    try:
+        parent_state, next_epoch = ckpt.restore(template_state)
+    except (ValueError, FileNotFoundError) as e:
+        raise TransferError(
+            f"--init_from {parent_dir!r}: parent restore failed — "
+            f"transfer requires the parent and child architectures to "
+            f"match (same generator/discriminator config): {e}") from e
+    state = template_state.replace(
+        g_params=parent_state.g_params,
+        f_params=parent_state.f_params,
+        dx_params=parent_state.dx_params,
+        dy_params=parent_state.dy_params,
+    )
+    provenance = {
+        "parent_ckpt": os.path.abspath(parent_dir),
+        "parent_epoch": int(next_epoch) - 1,
+        "parent_domain": parent_domain,
+        "transfer_mode": mode,
+        "domain": str(config.data.domain),
+    }
+    if telemetry is not None:
+        telemetry.event("transfer_init", **provenance)
+    if echo is not None:
+        echo(f"transfer init: {mode} from {parent_dir} "
+             f"(parent domain {parent_domain!r}, epoch "
+             f"{provenance['parent_epoch']}) -> domain "
+             f"{config.data.domain!r}")
+    return state, provenance
+
+
+def provenance_from_config(config) -> Optional[dict]:
+    """Whether this config is a transfer run (drives grad masking and
+    the health frozen group) without touching any checkpoint."""
+    if not getattr(config.train, "init_from", None):
+        return None
+    return {"transfer_mode": validate_mode(config.train.transfer_mode)}
+
+
+def freeze_active(config) -> bool:
+    return (getattr(config.train, "init_from", None) is not None
+            and getattr(config.train, "transfer_mode", None)
+            == "encoder_freeze")
+
+
+def spec_summary(config) -> dict:
+    """Flat transfer facts for manifests/telemetry."""
+    return {
+        "init_from": getattr(config.train, "init_from", None),
+        "transfer_mode": (getattr(config.train, "transfer_mode", None)
+                          if getattr(config.train, "init_from", None)
+                          else None),
+        "frozen_modules": (list(ENCODER_MODULES) if freeze_active(config)
+                           else []),
+    }
+
+
+def _unused_dataclasses_guard():  # pragma: no cover
+    # dataclasses imported for parity with sibling modules' idiom; keep
+    # linters honest about the import below being intentional.
+    return dataclasses.FrozenInstanceError

@@ -95,7 +95,8 @@ def nonfinite_count(grads) -> "jax.Array":  # noqa: F821 (doc type)
     return total
 
 
-def finalize_health_metrics(metrics, grads, old_params, new_params):
+def finalize_health_metrics(metrics, grads, old_params, new_params,
+                            frozen_group: bool = False):
     """Fold aggregated internal moments into final stats and add the
     norm-based signals. Call AFTER microbatch/shard aggregation (the
     norms are nonlinear: summing per-microbatch norms would be wrong),
@@ -105,6 +106,14 @@ def finalize_health_metrics(metrics, grads, old_params, new_params):
     update-to-param ratio is ||Δθ|| / (||θ|| + eps) — the step size the
     optimizer ACTUALLY took (post-Adam), the classic divergence /
     dead-net signal (≫1e-2: blowing up; ~0: frozen).
+
+    `frozen_group` (encoder-freeze transfer runs, domains/transfer.py)
+    adds `health/gnorm_enc_frozen` / `health/upd_ratio_enc_frozen`
+    reduced over BOTH generators' encoder-trunk leaves only. These are
+    monitored like a fifth network group and must pin at exactly 0 —
+    the freeze is gradient masking upstream of Adam, so any nonzero
+    value means the mask regressed (obs_report's transfer rollup flags
+    it as a finding).
     """
     import jax
     import jax.numpy as jnp
@@ -126,6 +135,22 @@ def finalize_health_metrics(metrics, grads, old_params, new_params):
         delta = jax.tree.map(jnp.subtract, p_new, p_old)
         metrics[f"health/upd_ratio_{name}"] = optax.global_norm(delta) / (
             optax.global_norm(p_old) + 1e-12
+        )
+    if frozen_group:
+        from cyclegan_tpu.domains import transfer
+
+        # G and F generator trees are indices 0/1 of every tuple.
+        fro_g = transfer.frozen_leaves(grads[0]) + transfer.frozen_leaves(grads[1])
+        fro_old = transfer.frozen_leaves(old_params[0]) + transfer.frozen_leaves(
+            old_params[1]
+        )
+        fro_new = transfer.frozen_leaves(new_params[0]) + transfer.frozen_leaves(
+            new_params[1]
+        )
+        delta = [jnp.subtract(n, o) for n, o in zip(fro_new, fro_old)]
+        metrics["health/gnorm_enc_frozen"] = optax.global_norm(fro_g)
+        metrics["health/upd_ratio_enc_frozen"] = optax.global_norm(delta) / (
+            optax.global_norm(fro_old) + 1e-12
         )
     metrics["health/nonfinite"] = nonfinite_count(grads)
     return metrics
@@ -393,14 +418,18 @@ class HealthMonitor:
         event = {
             "epoch": epoch,
             "rows": self._row,
+            # enc_frozen is the fifth group on encoder-freeze transfer
+            # runs (domains/transfer.py); its envelope must pin at 0 and
+            # obs_report / run_compare gate on it, so it rides the same
+            # dicts as the four real networks whenever rows carried it.
             "gnorm": {
                 net: env
-                for net in NETWORKS
+                for net in NETWORKS + ("enc_frozen",)
                 if (env := _env(f"health/gnorm_{net}")) is not None
             },
             "upd_ratio": {
                 net: env
-                for net in NETWORKS
+                for net in NETWORKS + ("enc_frozen",)
                 if (env := _env(f"health/upd_ratio_{net}")) is not None
             },
             "disc": {

@@ -66,6 +66,9 @@ def shard_map_train_step(
     )
 
     with_health = config.obs.health
+    from cyclegan_tpu.domains import transfer
+
+    frozen_group = transfer.freeze_active(config)
 
     @jax.jit
     def train_step(state, x, y, weights):
@@ -81,7 +84,8 @@ def shard_map_train_step(
             new_params = (new_state.g_params, new_state.f_params,
                           new_state.dx_params, new_state.dy_params)
             metrics = health.finalize_health_metrics(
-                metrics, grads, params, new_params
+                metrics, grads, params, new_params,
+                frozen_group=frozen_group,
             )
         return new_state, metrics
 

@@ -118,16 +118,26 @@ def topology_record(plan, config, state=None) -> dict:
 
 
 def save_meta(config, plan, state=None, mid_epoch: Optional[dict] = None,
-              data_seed: Optional[int] = None) -> dict:
-    """The checkpoint meta dict: model architecture (as before) plus the
-    topology record; `mid_epoch` marks a step-granular emergency slot
-    with its resume position {"epoch", "step", "data_seed"}."""
+              data_seed: Optional[int] = None,
+              transfer: Optional[dict] = None) -> dict:
+    """The checkpoint meta dict: model architecture (as before), the
+    topology record, and the run's DOMAIN KEY (domains/registry.py) —
+    every slot is self-describing about what pair it was trained on, so
+    restore can refuse (or warn about) a cross-domain mix-up.
+    `mid_epoch` marks a step-granular emergency slot with its resume
+    position {"epoch", "step", "data_seed"}; `transfer` is the
+    Mind2Mind onboarding provenance (parent_ckpt/parent_epoch/
+    parent_domain/transfer_mode, domains/transfer.py) and rides every
+    save of a transfer run — the lineage survives in each slot."""
     meta = dict(config.model_meta())
     meta["topology"] = topology_record(plan, config, state=state)
+    meta["domain"] = str(config.data.domain)
     if data_seed is not None:
         meta["data_seed"] = int(data_seed)
     if mid_epoch is not None:
         meta["mid_epoch"] = {k: int(v) for k, v in mid_epoch.items()}
+    if transfer is not None:
+        meta["transfer"] = dict(transfer)
     return meta
 
 
@@ -311,6 +321,17 @@ def elastic_restore_if_exists(ckpt, template, plan, config,
         return ElasticResume(state, 0, False)
     meta = ckpt.read_meta()
     meta = meta if isinstance(meta, dict) else {}
+    # Domain identity check (domains/transfer.py): a slot records the
+    # pair it was trained on; resuming a different --domain onto it
+    # warns — or refuses under --strict_domain — BEFORE any training
+    # step can poison either run. Legacy sidecars read as the default
+    # domain (utils/convert.py back-tags them explicitly).
+    from cyclegan_tpu.domains import transfer as _dom_transfer
+
+    _dom_transfer.check_domain_compat(
+        meta, config.data.domain,
+        strict=getattr(config.train, "strict_domain", False),
+        context="resume", telemetry=telemetry, echo=echo)
     saved = meta.get("topology")
     out = ElasticResume(state, next_epoch, True)
     if isinstance(saved, dict) and not topology_matches(saved, plan):
@@ -377,7 +398,8 @@ _SHEDDABLE_JOB_PREFIXES = ("plot_cycle:", "fid:")
 
 
 def emergency_save(ckpt, state, config, plan, data, epoch, step, guard,
-                   services=None, telemetry=None, echo=None) -> bool:
+                   services=None, telemetry=None, echo=None,
+                   transfer: Optional[dict] = None) -> bool:
     """Write the step-granular emergency slot within the
     --preempt_deadline_s budget. The deadline clock starts at the
     SIGTERM (guard.requested_at), not here — in-flight dispatch drain
@@ -391,7 +413,8 @@ def emergency_save(ckpt, state, config, plan, data, epoch, step, guard,
     meta = save_meta(
         config, plan, state=state,
         mid_epoch={"epoch": int(epoch), "step": int(step),
-                   "data_seed": int(data.seed)})
+                   "data_seed": int(data.seed)},
+        transfer=transfer)
     shed = 0
     if services is not None:
         shed = services.drop_pending(

@@ -30,7 +30,11 @@ Six pieces, one per production failure mode:
                   the fleet (fleet_replica_down / fleet_recovery
                   events). The same monitor evaluates the autoscaler,
                   the brownout pressure tick, hedge deadlines, and the
-                  p95 quarantine.
+                  p95 quarantine. FleetConfig.tenants turns the fleet
+                  multi-tenant: several (domain, tier) model versions
+                  resident at once (TenantSpec per tenant: SLO + shed
+                  budget), hot-swappable via swap_tenant() without
+                  draining the queue.
 - autoscale.py  — the fleet-sizing decision core: drain/arrival EWMAs
                   and the deadline-miss rollup in, "up"/"down"/hold
                   out, with hysteresis + cooldown so it never flaps;
@@ -66,7 +70,11 @@ from cyclegan_tpu.serve.fleet.classes import (
     DeadlineClass,
     class_map,
 )
-from cyclegan_tpu.serve.fleet.controller import FleetConfig, FleetExecutor
+from cyclegan_tpu.serve.fleet.controller import (
+    FleetConfig,
+    FleetExecutor,
+    TenantSpec,
+)
 from cyclegan_tpu.serve.fleet.replica import ReplicaCrashed, ReplicaWorker
 
 __all__ = [
@@ -86,5 +94,6 @@ __all__ = [
     "ReplicaCrashed",
     "ReplicaWorker",
     "ShedError",
+    "TenantSpec",
     "class_map",
 ]

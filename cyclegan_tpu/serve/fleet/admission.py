@@ -31,6 +31,15 @@ waited the max-wait budget — so a freed replica refills immediately
 under load, and a lone request never waits for companions longer than
 the bound.
 
+**Multi-tenant extensions** (FleetConfig.tenants): the routing key a
+flush is homogeneous in grows a tenant component — (size, tier,
+tenant) — so one batch never mixes two resident models' inputs; and
+per-tenant **shed budgets** cap what fraction of a tenant's admitted
+traffic eviction may claim, spreading overload pressure across tenants
+instead of starving whichever one happens to run the cheapest class.
+A tenant SLO tightens (never loosens) the class deadline at request
+construction, so EDF and the deadline-miss rollups enforce it for free.
+
 No device interaction lives here; tools/check_no_sync.py scans this
 package as hot path (host-side queueing only).
 """
@@ -67,22 +76,36 @@ class DeadlineExceeded(Exception):
 
 class FleetRequest:
     """One admitted unit of work: the preprocessed image, its routing
-    key (size bucket, engine tier), its class, and the absolute deadline
-    EDF orders by."""
+    key (size bucket, engine tier, tenant), its class, and the absolute
+    deadline EDF orders by."""
 
-    __slots__ = ("image", "size", "tier", "klass", "future", "t_submit",
-                 "deadline", "shed", "attempts", "hedged", "is_hedge",
-                 "won", "result", "probe", "degraded_from")
+    __slots__ = ("image", "size", "tier", "tenant", "klass", "future",
+                 "t_submit", "deadline", "shed", "attempts", "hedged",
+                 "is_hedge", "won", "result", "probe", "degraded_from")
 
     def __init__(self, image, size: int, tier: str,
-                 klass: DeadlineClass, now: Optional[float] = None):
+                 klass: DeadlineClass, now: Optional[float] = None,
+                 tenant: str = "", slo_ms: Optional[float] = None):
         self.image = image
         self.size = size
         self.tier = tier
+        # Multi-tenant routing: "" = the single-tenant fleet (every
+        # request shares the replica's construction-time engine); a
+        # non-empty key names the (domain, tier) model version the
+        # dispatcher must serve this request from. Part of the routing
+        # key — a flush is homogeneous in tenant, so one batch never
+        # mixes two models' inputs.
+        self.tenant = tenant
         self.klass = klass
         self.future: Future = Future()
         self.t_submit = time.perf_counter() if now is None else now
-        self.deadline = self.t_submit + klass.deadline_ms / 1000.0
+        # The effective deadline is the STRICTER of the class budget and
+        # the tenant's SLO (a tenant SLO may tighten a class guarantee,
+        # never loosen it — the class is the fleet-wide floor).
+        budget_ms = klass.deadline_ms
+        if slo_ms is not None:
+            budget_ms = min(budget_ms, slo_ms)
+        self.deadline = self.t_submit + budget_ms / 1000.0
         self.shed = False  # lazy deletion flag (evicted while heaped)
         # Dispatch count, bumped by the fleet's crash-recovery path when
         # it re-enqueues this request: the original deadline and
@@ -108,11 +131,15 @@ class FleetRequest:
         self.degraded_from: Optional[str] = None
 
     def twin(self) -> "FleetRequest":
-        """The hedge copy: same image, routing key, class, ORIGINAL
-        t_submit/deadline (EDF order and latency accounting stay
-        honest), and the same future object — first resolution wins."""
+        """The hedge copy: same image, routing key (tenant included),
+        class, ORIGINAL t_submit/deadline (EDF order and latency
+        accounting stay honest), and the same future object — first
+        resolution wins."""
         t = FleetRequest(self.image, self.size, self.tier, self.klass,
-                         now=self.t_submit)
+                         now=self.t_submit, tenant=self.tenant)
+        # Copy the deadline verbatim rather than re-deriving it: the
+        # primary's may already carry a tenant-SLO tightening.
+        t.deadline = self.deadline
         t.future = self.future
         t.is_hedge = True
         return t
@@ -121,11 +148,21 @@ class FleetRequest:
 class AdmissionController:
     """Bounded class-aware EDF queue shared by every replica."""
 
-    def __init__(self, capacity: int = 256, logger=None):
+    def __init__(self, capacity: int = 256, logger=None,
+                 shed_budgets: Optional[Dict[str, float]] = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self._logger = logger
+        # Per-tenant shed budgets: tenant key -> max fraction of that
+        # tenant's ADMITTED requests the queue may shed. Once a tenant
+        # is at budget it stops being pickable as an eviction victim —
+        # overload pressure then spreads to the other tenants (or, with
+        # every candidate protected, rejects the arrival) instead of
+        # starving one tenant to zero. Enforced in _pick_victim; pop-
+        # time expiry still counts against the budget but is never
+        # blocked by it (an expired request is dead either way).
+        self._shed_budgets: Dict[str, float] = dict(shed_budgets or {})
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
         # heap entries: (deadline, seq, req); seq breaks ties FIFO.
@@ -139,6 +176,11 @@ class AdmissionController:
         self.n_shed: Dict[str, int] = {}      # class -> evict+reject count
         self.shed_reasons: Dict[str, int] = {}
         self.n_cancelled: Dict[str, int] = {}  # pop-time drops, by reason
+        # Per-tenant admission census (only populated for requests that
+        # carry a tenant key): feeds the shed-budget check above and the
+        # obs_report tenant section.
+        self.tenant_admitted: Dict[str, int] = {}
+        self.tenant_shed: Dict[str, int] = {}
         # drain-rate EWMA (images/sec) feeding Retry-After estimates;
         # primed pessimistically so a cold queue suggests a real backoff.
         self._drain_rate = 1.0
@@ -160,18 +202,22 @@ class AdmissionController:
                 victim = self._pick_victim(req.klass)
                 if victim is None:
                     retry = self._retry_after_locked()
-                    self._count_shed(req.klass.name, "rejected")
+                    self._count_shed(req.klass.name, "rejected",
+                                     req.tenant)
                     self._event("fleet_shed", klass=req.klass.name,
                                 reason="rejected", depth=self._live,
+                                tenant=req.tenant or None,
                                 retry_after_s=round(retry, 3))
                     raise ShedError("rejected", retry, req.klass.name)
                 victim.shed = True
                 self._live -= 1
                 retry = self._retry_after_locked()
-                self._count_shed(victim.klass.name, "evicted")
+                self._count_shed(victim.klass.name, "evicted",
+                                 victim.tenant)
                 self._event("fleet_shed", klass=victim.klass.name,
                             reason="evicted", depth=self._live,
                             evicted_for=req.klass.name,
+                            tenant=victim.tenant or None,
                             hedge=victim.is_hedge,
                             retry_after_s=round(retry, 3))
                 # A hedge twin shares its future with a primary that is
@@ -193,6 +239,9 @@ class AdmissionController:
                 self.max_depth = self._live
             self.n_admitted[req.klass.name] = \
                 self.n_admitted.get(req.klass.name, 0) + 1
+            if req.tenant:
+                self.tenant_admitted[req.tenant] = \
+                    self.tenant_admitted.get(req.tenant, 0) + 1
             self._nonempty.notify()
             return req.future
 
@@ -200,16 +249,29 @@ class AdmissionController:
             -> Optional[FleetRequest]:
         """Strictly-lower-class victim with the most slack: max
         (shed_rank, deadline) among live entries whose shed_rank exceeds
-        the arrival's. O(n) scan — only runs under overload, and
-        capacity bounds n."""
+        the arrival's — skipping tenants already at their shed budget.
+        O(n) scan — only runs under overload, and capacity bounds n."""
         best: Optional[FleetRequest] = None
         for _, _, req in self._heap:
             if req.shed or req.klass.shed_rank <= arriving.shed_rank:
+                continue
+            if req.tenant and self._over_shed_budget_locked(req.tenant):
                 continue
             if best is None or (req.klass.shed_rank, req.deadline) > \
                     (best.klass.shed_rank, best.deadline):
                 best = req
         return best
+
+    def _over_shed_budget_locked(self, tenant: str) -> bool:
+        """Would shedding one more of this tenant's requests take its
+        shed fraction past the configured budget? Tenants without a
+        budget are always fair game (the pre-tenant behavior)."""
+        budget = self._shed_budgets.get(tenant)
+        if budget is None:
+            return False
+        shed = self.tenant_shed.get(tenant, 0)
+        admitted = self.tenant_admitted.get(tenant, 0)
+        return (shed + 1) > budget * admitted
 
     # -- consumer side (the dispatcher) -----------------------------------
     def next_batch(self, max_n: int, max_wait_s: float,
@@ -217,8 +279,8 @@ class AdmissionController:
                    idle_return_s: Optional[float] = None) \
             -> Optional[List[FleetRequest]]:
         """Block until a batch is releasable, then pop up to ``max_n``
-        requests in EDF order, all sharing the head's (size, tier)
-        routing key. Release happens when the matching run can fill
+        requests in EDF order, all sharing the head's (size, tier,
+        tenant) routing key. Release happens when the matching run can fill
         ``max_n`` slots, or when the EDF head has waited ``max_wait_s``
         since submission. Returns None only after close() with the
         queue fully drained. ``idle_return_s`` bounds how long an EMPTY
@@ -246,8 +308,8 @@ class AdmissionController:
                 now = time.perf_counter()
                 matching = sum(
                     1 for _, _, r in self._heap
-                    if not r.shed and (r.size, r.tier) ==
-                    (head.size, head.tier))
+                    if not r.shed and (r.size, r.tier, r.tenant) ==
+                    (head.size, head.tier, head.tenant))
                 window_over = (now - head.t_submit) >= max_wait_s
                 if matching >= max_n or window_over or self._closed:
                     return self._pop_batch_locked(head, max_n)
@@ -269,8 +331,9 @@ class AdmissionController:
     def _pop_batch_locked(self, head: FleetRequest, max_n: int) \
             -> List[FleetRequest]:
         """EDF-ordered pop of up to max_n requests matching the head's
-        (size, tier); non-matching entries are re-heaped. Sheddable
-        requests whose deadline passed while queued are dropped here."""
+        (size, tier, tenant); non-matching entries are re-heaped.
+        Sheddable requests whose deadline passed while queued are
+        dropped here."""
         out: List[FleetRequest] = []
         putback: List[Tuple[float, int, FleetRequest]] = []
         now = time.perf_counter()
@@ -301,15 +364,17 @@ class AdmissionController:
                 continue
             if now > req.deadline and req.klass.shed_rank > 0:
                 self._live -= 1
-                self._count_shed(req.klass.name, "expired")
+                self._count_shed(req.klass.name, "expired", req.tenant)
                 self._event("fleet_shed", klass=req.klass.name,
-                            reason="expired", depth=self._live)
+                            reason="expired", depth=self._live,
+                            tenant=req.tenant or None)
                 if not req.future.done():
                     req.future.set_exception(DeadlineExceeded(
                         f"class {req.klass.name} deadline passed while "
                         f"queued ({now - req.deadline:.3f}s late)"))
                 continue
-            if (req.size, req.tier) != (head.size, head.tier):
+            if (req.size, req.tier, req.tenant) != \
+                    (head.size, head.tier, head.tenant):
                 putback.append(entry)
                 continue
             out.append(req)
@@ -370,7 +435,7 @@ class AdmissionController:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "depth": self._live,
                 "capacity": self.capacity,
                 "max_depth": self.max_depth,
@@ -382,10 +447,24 @@ class AdmissionController:
                 "arrival_rate": round(self._arrival_rate, 4),
                 "retry_after_s": round(self._retry_after_locked(), 3),
             }
+            if self.tenant_admitted or self.tenant_shed:
+                out["tenants"] = {
+                    t: {
+                        "admitted": self.tenant_admitted.get(t, 0),
+                        "shed": self.tenant_shed.get(t, 0),
+                        "shed_budget": self._shed_budgets.get(t),
+                    }
+                    for t in sorted(set(self.tenant_admitted)
+                                    | set(self.tenant_shed))
+                }
+            return out
 
-    def _count_shed(self, klass: str, reason: str) -> None:
+    def _count_shed(self, klass: str, reason: str,
+                    tenant: str = "") -> None:
         self.n_shed[klass] = self.n_shed.get(klass, 0) + 1
         self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+        if tenant:
+            self.tenant_shed[tenant] = self.tenant_shed.get(tenant, 0) + 1
 
     def _count_cancel(self, reason: str) -> None:
         self.n_cancelled[reason] = self.n_cancelled.get(reason, 0) + 1

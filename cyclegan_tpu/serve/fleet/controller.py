@@ -40,6 +40,17 @@ each optional and host-side only:
   flush p95 detaches from the fleet median is quarantined, probed with
   synthetic flushes, and readmitted or respawned.
 
+**Multi-tenant serving** (``FleetConfig.tenants``): several (domain,
+tier) model versions stay resident at once, each a ``TenantSpec`` with
+its own SLO and shed budget. Tenancy is a thin extension of the
+existing machinery — the admission routing key grows a tenant
+component (flushes stay model-homogeneous), the SLO rides the request
+deadline EDF already orders by, shed budgets constrain the existing
+victim scan, and the dispatcher resolves tenant -> engine per batch so
+``swap_tenant()`` can hot-swap a checkpoint with one atomic table flip:
+queued work picks up the new engine at dispatch, in-flight flushes
+finish on the old one, nothing drains and nothing drops.
+
 Telemetry (PR-1 JSONL schema, folded by tools/obs_report.py):
 ``fleet_flush`` per flush (replica, fill, trigger, class mix, latency
 splits), ``fleet_shed`` per shed decision (emitted by the admission
@@ -95,6 +106,45 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
 
 
 @dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One resident model version in a multi-tenant fleet: a (domain,
+    tier) identity plus its serving guarantees. The fleet keeps every
+    tenant's engine loaded at once and routes per-request by tenant key
+    (``<domain>/<tier>`` — domains/registry.py tenant_key grammar).
+
+    ``slo_ms`` tightens the deadline class budget for this tenant's
+    requests (never loosens it — the class stays the fleet-wide floor);
+    ``shed_budget`` caps the fraction of this tenant's admitted traffic
+    the admission queue may shed as eviction victims, so overload
+    pressure spreads across tenants instead of starving one."""
+
+    domain: str
+    tier: str = "base"
+    slo_ms: Optional[float] = None
+    shed_budget: Optional[float] = None
+
+    def __post_init__(self):
+        from cyclegan_tpu.domains.registry import _KEY_RE
+        if not _KEY_RE.match(self.domain or ""):
+            raise ValueError(
+                f"tenant domain {self.domain!r} is not a valid domain "
+                f"key (want {_KEY_RE.pattern})")
+        if self.slo_ms is not None and self.slo_ms <= 0:
+            raise ValueError(
+                f"tenant slo_ms must be > 0 or None, got {self.slo_ms}")
+        if self.shed_budget is not None and not (
+                0.0 < self.shed_budget <= 1.0):
+            raise ValueError(
+                f"tenant shed_budget must be in (0, 1] or None, "
+                f"got {self.shed_budget}")
+
+    @property
+    def key(self) -> str:
+        from cyclegan_tpu.domains.registry import tenant_key
+        return tenant_key(self.domain, self.tier)
+
+
+@dataclasses.dataclass(frozen=True)
 class FleetConfig:
     """Host-side fleet knobs (the engine's ServeConfig still owns the
     compiled-program grammar: sizes, batch buckets, dtype, int8 tier)."""
@@ -141,6 +191,12 @@ class FleetConfig:
     quarantine_min_samples: int = 8
     quarantine_probes: int = 3
     quarantine_probe_interval_s: float = 0.25
+    # Multi-tenant serving: each TenantSpec is a resident (domain, tier)
+    # model version with its own SLO/shed budget; the first spec is the
+    # default tenant (requests without an explicit tenant route there).
+    # Empty = the historical single-tenant fleet — no tenant routing
+    # key, no per-tenant rollups, identical behavior to before.
+    tenants: Tuple[TenantSpec, ...] = ()
 
     def __post_init__(self):
         if self.n_replicas < 1:
@@ -196,6 +252,12 @@ class FleetConfig:
             raise ValueError(
                 f"quarantine_probe_interval_s must be > 0, "
                 f"got {self.quarantine_probe_interval_s}")
+        keys = [t.key for t in self.tenants]
+        if len(keys) != len(set(keys)):
+            dupes = sorted({k for k in keys if keys.count(k) > 1})
+            raise ValueError(
+                f"duplicate tenant keys {dupes} — a (domain, tier) "
+                f"identity may be resident only once")
 
 
 class FleetExecutor:
@@ -210,7 +272,7 @@ class FleetExecutor:
 
     def __init__(self, engine: InferenceEngine,
                  cfg: Optional[FleetConfig] = None, *, logger=None,
-                 injector=None, engines=None):
+                 injector=None, engines=None, tenant_engines=None):
         self.engine = engine
         self.cfg = cfg or FleetConfig()
         self._logger = logger
@@ -226,12 +288,45 @@ class FleetExecutor:
         # a replica whose engine lacks the bucket would crash it.
         self.engines = list(engines) if engines else [engine]
         for i, eng in enumerate(self.engines):
-            if (set(eng.programs) != set(engine.programs)
-                    or eng.tiers != engine.tiers):
+            self._check_grammar(eng, f"engines[{i}]")
+        # Multi-tenant table: tenant key -> resident engine (that
+        # tenant's model version, its programs compiled at engine
+        # construction). Read at dispatch time under _tenant_lock;
+        # swap_tenant() flips one entry atomically — in-flight flushes
+        # keep the engine reference they were dispatched with, so a
+        # swap never drops work.
+        self._tenants: Dict[str, TenantSpec] = {
+            t.key: t for t in self.cfg.tenants}
+        self._tenant_lock = threading.Lock()
+        self._tenant_engines: Dict[str, InferenceEngine] = {}
+        if self._tenants:
+            given = dict(tenant_engines or {})
+            missing = sorted(k for k in self._tenants if k not in given)
+            if missing:
                 raise ValueError(
-                    f"engines[{i}] bucket grammar/tiers differ from the "
-                    f"primary engine — all fleet engines must be built "
-                    f"from the same ServeConfig")
+                    f"cfg.tenants declares {missing} but tenant_engines "
+                    f"carries no engine for them — every resident "
+                    f"tenant needs its model loaded up front")
+            unknown = sorted(k for k in given if k not in self._tenants)
+            if unknown:
+                raise ValueError(
+                    f"tenant_engines carries {unknown} not declared in "
+                    f"cfg.tenants")
+            for key, eng in given.items():
+                self._check_grammar(eng, f"tenant_engines[{key!r}]")
+                # The tenant's tier must exist on ITS engine (grammar
+                # equality already guarantees tier parity, but resolve
+                # it once here so a bad spec fails at startup).
+                eng.resolve_tier(self._tenants[key].tier)
+                self._tenant_engines[key] = eng
+            self._default_tenant = self.cfg.tenants[0].key
+        else:
+            if tenant_engines:
+                raise ValueError(
+                    "tenant_engines given without cfg.tenants — declare "
+                    "the tenants (TenantSpec) so their SLO/shed budgets "
+                    "exist")
+            self._default_tenant = ""
         self._classes = class_map(self.cfg.classes)
         max_batch = (engine.max_batch if self.cfg.max_batch is None
                      else self.cfg.max_batch)
@@ -245,8 +340,10 @@ class FleetExecutor:
         # checked here once rather than per-request.
         for c in self.cfg.classes:
             engine.resolve_tier(c.tier)
-        self.admission = AdmissionController(self.cfg.capacity,
-                                             logger=logger)
+        self.admission = AdmissionController(
+            self.cfg.capacity, logger=logger,
+            shed_budgets={t.key: t.shed_budget for t in self.cfg.tenants
+                          if t.shed_budget is not None})
         self._free: "queue.Queue" = queue.Queue()
         self._busy = 0  # replicas holding a dispatched flush
         self._closed = False
@@ -290,6 +387,13 @@ class FleetExecutor:
         self._n_readmitted = 0
         self._n_condemned = 0
         self._parked: List[ReplicaWorker] = []
+        # Per-tenant rollups (multi-tenant fleets only; guarded by
+        # _stats_lock): resolved-request latency, SLO/deadline misses,
+        # served-image counts, and the hot-swap census.
+        self._lat_by_tenant: Dict[str, List[float]] = {}
+        self._miss_by_tenant: Dict[str, int] = {}
+        self._done_by_tenant: Dict[str, int] = {}
+        self._n_tenant_swaps = 0
         # Autoscale wiring: the decision core plus actuation counters.
         self._autoscaler = (Autoscaler(self.cfg.autoscale)
                             if self.cfg.autoscale is not None else None)
@@ -323,11 +427,35 @@ class FleetExecutor:
             name="fleet-monitor")
         self._monitor.start()
 
+    def _check_grammar(self, eng: InferenceEngine, label: str) -> None:
+        """Every engine in the fleet — per-device replicas AND resident
+        tenants — must speak the primary engine's bucket grammar: the
+        dispatcher batches against ONE grammar, and a flush landing on
+        an engine lacking the bucket would crash the replica."""
+        if (set(eng.programs) != set(self.engine.programs)
+                or eng.tiers != self.engine.tiers):
+            raise ValueError(
+                f"{label} bucket grammar/tiers differ from the primary "
+                f"engine — all fleet engines must be built from the "
+                f"same ServeConfig")
+
     def _engine_for_slot(self, slot: int) -> InferenceEngine:
         """Round-robin slot -> engine binding. Stable across respawns:
         a recovered slot rebinds to the SAME engine/device its crashed
         predecessor ran on (the device is fine; the thread died)."""
         return self.engines[slot % len(self.engines)]
+
+    def _engine_for_tenant(self, tenant: str) \
+            -> Optional[InferenceEngine]:
+        """Resolve a batch's tenant to its CURRENT resident engine at
+        dispatch time (None = no tenant routing; the replica uses its
+        own slot-bound engine). Reading here rather than at submit time
+        is what makes swap_tenant() take effect for queued work the
+        moment it flips the table."""
+        if not tenant:
+            return None
+        with self._tenant_lock:
+            return self._tenant_engines[tenant]
 
     # -- slot machinery (shared by startup, crash respawn, autoscale) ------
     def _grow_slot_arrays_locked(self) -> int:
@@ -367,19 +495,23 @@ class FleetExecutor:
 
     # -- submission --------------------------------------------------------
     def submit_raw(self, img: np.ndarray, klass: Optional[str] = None,
-                   tier: Optional[str] = None) -> Future:
+                   tier: Optional[str] = None,
+                   tenant: Optional[str] = None) -> Future:
         """Decode-side entry: raw HWC image of any size -> bucket
         preprocess, class lookup, admission."""
         size = self.engine.size_bucket(img.shape[0], img.shape[1])
         return self.submit(preprocess_request(img, size), klass=klass,
-                           tier=tier)
+                           tier=tier, tenant=tenant)
 
     def submit(self, image: np.ndarray, klass: Optional[str] = None,
-               tier: Optional[str] = None) -> Future:
+               tier: Optional[str] = None,
+               tenant: Optional[str] = None) -> Future:
         """Admit one preprocessed [s, s, 3] image under a deadline
         class. Raises ShedError when admission rejects it (HTTP 429 at
-        the front-end); raises KeyError for an unknown class. An
-        explicit ``tier`` overrides the class's tier routing."""
+        the front-end); raises KeyError for an unknown class or tenant.
+        Tier precedence: an explicit ``tier`` wins, else the tenant's
+        resident tier (a tenant IS a (domain, tier) identity), else the
+        class's tier routing."""
         if self._closed:
             raise RuntimeError("fleet executor is closed")
         name = klass or self.cfg.default_class
@@ -389,14 +521,32 @@ class FleetExecutor:
             raise KeyError(
                 f"unknown deadline class {name!r}; have "
                 f"{sorted(self._classes)}") from None
-        resolved = self.engine.resolve_tier(
-            tier if tier is not None else k.tier)
+        spec: Optional[TenantSpec] = None
+        tkey = tenant or self._default_tenant
+        if self._tenants:
+            try:
+                spec = self._tenants[tkey]
+            except KeyError:
+                raise KeyError(
+                    f"unknown tenant {tkey!r}; have "
+                    f"{sorted(self._tenants)}") from None
+        elif tenant:
+            raise KeyError(
+                f"tenant {tenant!r} requested but the fleet has no "
+                f"tenants configured (FleetConfig.tenants)")
+        if tier is not None:
+            resolved = self.engine.resolve_tier(tier)
+        elif spec is not None:
+            resolved = self.engine.resolve_tier(spec.tier)
+        else:
+            resolved = self.engine.resolve_tier(k.tier)
         size = int(image.shape[0])
         if (size, self.engine.batch_bucket(1)) not in self.engine.programs:
             raise ValueError(
                 f"size {size} is not a compiled resolution bucket "
                 f"{tuple(sorted({s for s, _ in self.engine.programs}))}")
-        req = FleetRequest(image, size, resolved, k)
+        req = FleetRequest(image, size, resolved, k, tenant=tkey,
+                           slo_ms=spec.slo_ms if spec else None)
         if self._brownout is not None:
             browned = self._brownout.tier_for(k.name, resolved)
             if browned != resolved:
@@ -411,6 +561,43 @@ class FleetExecutor:
                     self._degraded_census[ck] = \
                         self._degraded_census.get(ck, 0) + 1
         return self.admission.offer(req)
+
+    # -- hot tenant swap ---------------------------------------------------
+    def swap_tenant(self, tenant: str,
+                    new_engine: InferenceEngine) -> InferenceEngine:
+        """Hot checkpoint swap: replace one tenant's resident engine
+        WITHOUT draining the queue. The caller builds ``new_engine``
+        from the new checkpoint first (InferenceEngine construction
+        AOT-compiles and warms every program, the expensive part), so
+        the swap itself is one atomic table flip:
+
+        - queued requests for this tenant pick up the new engine at
+          their dispatch (the dispatcher reads the table per batch);
+        - in-flight flushes keep the OLD engine reference they were
+          dispatched with and resolve normally — zero dropped requests
+          (pinned by tests/test_fleet.py under load);
+        - the old engine object is returned so the caller can release
+          its weights once any stragglers resolve.
+
+        Raises KeyError for an unknown tenant and ValueError when the
+        new engine's bucket grammar differs from the fleet's."""
+        if tenant not in self._tenants:
+            raise KeyError(
+                f"unknown tenant {tenant!r}; have "
+                f"{sorted(self._tenants)}")
+        self._check_grammar(new_engine, f"swap_tenant({tenant!r})")
+        new_engine.resolve_tier(self._tenants[tenant].tier)
+        with self._tenant_lock:
+            old = self._tenant_engines[tenant]
+            self._tenant_engines[tenant] = new_engine
+        with self._stats_lock:
+            self._n_tenant_swaps += 1
+            n_swaps = self._n_tenant_swaps
+        if self._logger is not None:
+            self._logger.event(
+                "fleet_tenant_swap", tenant=tenant, swap=n_swaps,
+                queue_depth=self.admission.depth)
+        return old
 
     # -- the dispatcher ----------------------------------------------------
     def _dispatch_loop(self) -> None:
@@ -476,8 +663,15 @@ class FleetExecutor:
             # Stamp the in-flight record BEFORE the hand-off: if the
             # worker thread is already dead (crashed between flushes)
             # the batch would otherwise strand invisibly in its inbox.
+            # The tenant's engine resolves HERE — batches are
+            # tenant-homogeneous (admission routing key), and reading
+            # the table at dispatch time means a hot swap covers queued
+            # work immediately while in-flight flushes keep their old
+            # engine reference.
             replica.inflight = (batch, time.perf_counter())
-            replica.dispatch(batch, trigger)
+            replica.dispatch(batch, trigger,
+                             engine=self._engine_for_tenant(
+                                 batch[0].tenant))
 
     # -- autoscale actuation -----------------------------------------------
     def _scale_up(self) -> None:
@@ -846,6 +1040,18 @@ class FleetExecutor:
                 if missed:
                     self._miss_by_class[name] = \
                         self._miss_by_class.get(name, 0) + 1
+            if batch[0].tenant:
+                # Tenant-homogeneous flush: one rollup bucket. Deadline
+                # misses here ARE SLO misses — the request deadline
+                # already carries the tenant-SLO tightening.
+                tkey = batch[0].tenant
+                self._done_by_tenant[tkey] = \
+                    self._done_by_tenant.get(tkey, 0) + n
+                for _, lat, missed in lats:
+                    self._lat_by_tenant.setdefault(tkey, []).append(lat)
+                    if missed:
+                        self._miss_by_tenant[tkey] = \
+                            self._miss_by_tenant.get(tkey, 0) + 1
         if self._probe is not None:
             for r in batch:
                 if (r.won and r.degraded_from is not None
@@ -862,6 +1068,7 @@ class FleetExecutor:
                 replica=replica.replica_id, n=n,
                 bucket=self.engine.batch_bucket(n),
                 size=batch[0].size, tier=batch[0].tier,
+                tenant=batch[0].tenant or None,
                 trigger=trigger, classes=mix,
                 queue_depth=self.admission.depth,
                 queue_wait_s=round(t0 - batch[0].t_submit, 6),
@@ -870,6 +1077,30 @@ class FleetExecutor:
                 e2e_p50_s=round(_percentile(
                     sorted(l for _, l, _ in lats), 0.5), 6),
             )
+
+    def _tenant_rollup_locked(self) -> dict:
+        """Per-tenant serving census (stats()/close(); _stats_lock
+        held): latency percentiles over resolved requests, SLO misses
+        (the request deadline carries the tenant-SLO tightening), and
+        the resident identity/guarantees from the spec."""
+        out = {}
+        for key in sorted(self._tenants):
+            spec = self._tenants[key]
+            lats = sorted(self._lat_by_tenant.get(key, []))
+            out[key] = {
+                "domain": spec.domain,
+                "tier": spec.tier,
+                "slo_ms": spec.slo_ms,
+                "shed_budget": spec.shed_budget,
+                "n": len(lats),
+                "n_images": self._done_by_tenant.get(key, 0),
+                "p50_s": round(_percentile(lats, 0.5), 6)
+                if lats else None,
+                "p95_s": round(_percentile(lats, 0.95), 6)
+                if lats else None,
+                "slo_misses": self._miss_by_tenant.get(key, 0),
+            }
+        return out
 
     # -- public snapshot ---------------------------------------------------
     def stats(self) -> dict:
@@ -905,6 +1136,9 @@ class FleetExecutor:
                     "condemned": self._n_condemned,
                 },
             }
+            if self._tenants:
+                snap["tenants"] = self._tenant_rollup_locked()
+                snap["tenant_swaps"] = self._n_tenant_swaps
         snap.update({
             "n_replicas": len(self.replicas),
             "n_replicas_active": n_active,
@@ -1028,6 +1262,10 @@ class FleetExecutor:
             }
             summary["scale_ups"] = self._n_scale_up
             summary["scale_downs"] = self._n_scale_down
+            if self._tenants:
+                summary["tenants"] = self._tenant_rollup_locked()
+                summary["tenant_swaps"] = self._n_tenant_swaps
+                summary["tenant_admission"] = adm.get("tenants", {})
         if self._brownout is not None:
             summary["brownout"] = self._brownout.snapshot()
         # Replicas that refused to join: a clean fleet reports [] here;

@@ -104,8 +104,15 @@ class ReplicaWorker:
     def alive(self) -> bool:
         return self._thread.is_alive()
 
-    def dispatch(self, batch: List[FleetRequest], trigger: str) -> None:
-        self._inbox.put((batch, trigger))
+    def dispatch(self, batch: List[FleetRequest], trigger: str,
+                 engine=None) -> None:
+        """Hand one flush to this worker. ``engine`` overrides the
+        construction-time engine FOR THIS FLUSH ONLY — the multi-tenant
+        dispatcher resolves the batch's tenant to its resident engine at
+        dispatch time, so a hot tenant swap never touches a worker:
+        in-flight flushes keep the engine reference they were dispatched
+        with, and the next flush picks up the new table entry."""
+        self._inbox.put((batch, trigger, engine))
 
     def request_stop(self) -> None:
         """Post the stop sentinel without joining — the autoscaler's
@@ -143,7 +150,9 @@ class ReplicaWorker:
             item = self._inbox.get()
             if item is _STOP:
                 return
-            batch, trigger = item
+            batch, trigger, engine = item
+            if engine is None:
+                engine = self.engine
             self.last_beat = _now()
             if self.injector is not None:
                 # Host-side injection BEFORE the per-flush error handler:
@@ -157,8 +166,8 @@ class ReplicaWorker:
             t0 = _now()
             try:
                 x = np.stack([r.image for r in batch])
-                outs, n = self.engine.run(x, size=batch[0].size,
-                                          tier=batch[0].tier)
+                outs, n = engine.run(x, size=batch[0].size,
+                                     tier=batch[0].tier)
                 t_dispatched = _now()
                 host = jax.device_get(outs)  # sanctioned-fetch: the replica's one deferred D2H per flush
             except Exception as e:  # noqa: BLE001 — fail the flush, keep the replica

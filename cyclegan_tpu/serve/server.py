@@ -17,6 +17,9 @@ Endpoints:
                     deadline class (fleet mode; default `batch`).
                     ?tier=int8 routes to the quantized program tier
                     when the engine compiled one.
+                    ?tenant=domain/tier picks a resident model version
+                    in a multi-tenant fleet (--tenant flags); unknown
+                    tenants/classes answer 400.
                     Overload answers 429 with a Retry-After header
                     (fleet mode's admission control shedding).
   GET  /healthz     200 once the engine's programs are compiled —
@@ -136,6 +139,7 @@ def make_handler(app: ServeApp):
             want_panel = q.get("panels", ["0"])[0] == "1"
             tier = q.get("tier", [None])[0]
             klass = q.get("class", [None])[0]
+            tenant = q.get("tenant", [None])[0]
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 img = _decode_upload(self.rfile.read(length))
@@ -145,7 +149,12 @@ def make_handler(app: ServeApp):
                 # of serve/executor.py.
                 if app.fleet:
                     fut = app.executor.submit_raw(img, klass=klass,
-                                                  tier=tier)
+                                                  tier=tier,
+                                                  tenant=tenant)
+                elif tenant is not None:
+                    raise KeyError(
+                        "?tenant= requires fleet mode with configured "
+                        "tenants (--fleet N --tenant ...)")
                 else:
                     fut = app.executor.submit_raw(img, tier=tier)
                 result = fut.result(timeout=120)
@@ -191,6 +200,13 @@ def make_handler(app: ServeApp):
                     self._reply(503, json.dumps(
                         {"error": "deadline exceeded in queue",
                          "detail": str(e)}).encode())
+                elif isinstance(e, KeyError):
+                    # Unknown ?class= / ?tenant=: the client named a
+                    # routing identity the fleet doesn't have — their
+                    # mistake, not an overload or a server fault.
+                    app.count(error=True)
+                    self._reply(400, json.dumps(
+                        {"error": str(e).strip("'\"")}).encode())
                 else:
                     app.count(error=True)
                     self._reply(500, json.dumps(
@@ -269,6 +285,23 @@ def main(argv: Optional[list] = None) -> None:
                    help="hedged dispatch: re-submit a request still "
                         "in flight after this many ms to a second "
                         "replica; first result wins")
+    p.add_argument("--tenant", action="append", default=None,
+                   metavar="DOMAIN[/TIER]=RUN_DIR",
+                   help="multi-tenant fleet: keep this (domain, tier) "
+                        "model version resident, loaded from RUN_DIR's "
+                        "verified checkpoint ring (repeatable; the "
+                        "first --tenant is the default; requests pick "
+                        "one via ?tenant=domain/tier). --output_dir "
+                        "still provides the primary engine whose "
+                        "grammar every tenant must match")
+    p.add_argument("--tenant_slo_ms", default=None, type=float,
+                   help="per-tenant SLO applied to every --tenant "
+                        "(tightens the deadline class budget; misses "
+                        "are reported per tenant in /stats)")
+    p.add_argument("--tenant_shed_budget", default=None, type=float,
+                   help="max fraction of each tenant's admitted "
+                        "traffic the admission queue may shed as "
+                        "eviction victims (0 < x <= 1)")
     p.add_argument("--obs_jsonl", default=None,
                    help="telemetry stream path (PR-1 schema; fold with "
                         "tools/obs_report.py)")
@@ -326,7 +359,8 @@ def main(argv: Optional[list] = None) -> None:
                              serve_cfg=serve_cfg, logger=logger)
     for flag, name in ((args.autoscale, "--autoscale"),
                        (args.brownout, "--brownout"),
-                       (args.hedge_ms is not None, "--hedge_ms")):
+                       (args.hedge_ms is not None, "--hedge_ms"),
+                       (args.tenant is not None, "--tenant")):
         if flag and args.fleet <= 0:
             raise SystemExit(f"{name} requires fleet mode (--fleet N)")
     if args.brownout and not args.int8:
@@ -367,14 +401,72 @@ def main(argv: Optional[list] = None) -> None:
             cascade_cfg = CascadeConfig(
                 tiers=engine.tiers,
                 shadow_fraction=args.shadow_fraction)
+        # Multi-tenant residency: each --tenant loads its own verified
+        # checkpoint ring and compiles its own program set against the
+        # PRIMARY serve grammar (the fleet batches against one grammar,
+        # so every tenant must speak it). The sidecar's recorded domain
+        # is cross-checked against the declared key — serving zebra
+        # weights under a monet tenant is a misconfiguration worth a
+        # loud warning even when the shapes happen to agree.
+        tenant_specs = []
+        tenant_engines = {}
+        for item in args.tenant or []:
+            from cyclegan_tpu.domains.registry import (
+                DEFAULT_DOMAIN,
+                TENANT_SEP,
+                split_tenant_key,
+            )
+            from cyclegan_tpu.serve.fleet import TenantSpec
+
+            key, sep, run_dir = item.partition("=")
+            if not sep or not run_dir:
+                raise SystemExit(
+                    f"--tenant wants DOMAIN[/TIER]=RUN_DIR, got {item!r}")
+            if TENANT_SEP not in key:
+                key = f"{key}{TENANT_SEP}base"
+            t_domain, t_tier = split_tenant_key(key)
+            t_ckpt = Checkpointer(run_dir)
+            t_meta = t_ckpt.read_meta()
+            if not t_ckpt.exists():
+                raise SystemExit(
+                    f"--tenant {item!r}: no checkpoint under "
+                    f"{run_dir}/checkpoints")
+            recorded = str(t_meta.get("domain") or DEFAULT_DOMAIN)
+            if recorded != t_domain:
+                print(f"WARNING: tenant {key!r} loads a checkpoint "
+                      f"whose sidecar records domain {recorded!r}",
+                      flush=True)
+            t_model_cfg = Config.model_from_cli_and_meta(
+                t_meta, image_size=args.image_size)
+            t_state = create_state(
+                Config(model=t_model_cfg,
+                       train=TrainConfig(output_dir=run_dir)),
+                jax.random.PRNGKey(0))
+            t_state, _, _ = t_ckpt.restore_for_cli(t_state)
+            t_fwd, t_bwd = (
+                (t_state.g_params, t_state.f_params)
+                if args.direction == "AtoB"
+                else (t_state.f_params, t_state.g_params))
+            spec = TenantSpec(domain=t_domain, tier=t_tier,
+                              slo_ms=args.tenant_slo_ms,
+                              shed_budget=args.tenant_shed_budget)
+            tenant_specs.append(spec)
+            tenant_engines[spec.key] = InferenceEngine(
+                t_model_cfg, t_fwd, t_bwd, serve_cfg=serve_cfg,
+                logger=logger)
+        if tenant_specs:
+            print(f"fleet tenants resident: "
+                  f"{[s.key for s in tenant_specs]}", flush=True)
         executor = FleetExecutor(
             engine,
             FleetConfig(n_replicas=args.fleet, capacity=args.capacity,
                         max_wait_ms=args.max_wait_ms,
                         default_class=args.default_class,
                         autoscale=autoscale_cfg, cascade=cascade_cfg,
-                        hedge_ms=args.hedge_ms),
-            logger=logger, engines=engines)
+                        hedge_ms=args.hedge_ms,
+                        tenants=tuple(tenant_specs)),
+            logger=logger, engines=engines,
+            tenant_engines=tenant_engines or None)
     else:
         executor = PipelinedExecutor(engine, max_wait_ms=args.max_wait_ms,
                                      logger=logger)

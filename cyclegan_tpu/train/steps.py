@@ -87,6 +87,14 @@ def _param_tuple(state: CycleGANState):
     return (state.g_params, state.f_params, state.dx_params, state.dy_params)
 
 
+def _frozen_group(config: Config) -> bool:
+    """Whether health finalization should emit the enc_frozen group
+    (encoder-freeze transfer runs, domains/transfer.py)."""
+    from cyclegan_tpu.domains import transfer
+
+    return transfer.freeze_active(config)
+
+
 def make_grad_fn(config: Config, global_batch_size: int) -> Callable:
     """Build the fused gradient function for `config.train.grad_impl`.
 
@@ -105,10 +113,31 @@ def make_grad_fn(config: Config, global_batch_size: int) -> Callable:
     They live in the aux output, so they cost a few reductions on
     activations the forward already produced — no extra backward work.
     Both impls emit the SAME metric key set (tests/test_fusedprop.py).
+
+    Transfer runs with `transfer_mode='encoder_freeze'`
+    (domains/transfer.py) wrap the returned fn to zero both generators'
+    encoder-trunk gradient leaves HERE — the single entry point every
+    step variant consumes — so plain, accum, and shard_map steps all
+    inherit the mask: zero microbatch grads sum to zero, zero shard
+    grads psum to zero, and Adam's zero-gradient fixed point keeps the
+    frozen params bit-identical with an optimizer-state tree
+    structurally equal to an unfrozen run's (checkpoints interchange).
     """
     if config.train.grad_impl == "fusedprop":
-        return _make_fusedprop_grad_fn(config, global_batch_size)
-    return _make_combined_grad_fn(config, global_batch_size)
+        fn = _make_fusedprop_grad_fn(config, global_batch_size)
+    else:
+        fn = _make_combined_grad_fn(config, global_batch_size)
+
+    from cyclegan_tpu.domains import transfer
+
+    if not transfer.freeze_active(config):
+        return fn
+
+    def frozen_grad_fn(g_params, f_params, dx_params, dy_params, x, y, w):
+        grads, metrics = fn(g_params, f_params, dx_params, dy_params, x, y, w)
+        return transfer.apply_freeze(grads), metrics
+
+    return frozen_grad_fn
 
 
 def _make_combined_grad_fn(config: Config, global_batch_size: int) -> Callable:
@@ -348,6 +377,7 @@ def make_train_step(
     grad_fn = make_grad_fn(config, global_batch_size)
     update = make_update_fn(config)
     with_health = config.obs.health
+    frozen_group = _frozen_group(config)
 
     def train_step(
         state: CycleGANState, x: jnp.ndarray, y: jnp.ndarray, weights: jnp.ndarray
@@ -361,7 +391,8 @@ def make_train_step(
             # through the same deferred fetch) — no extra program, no
             # host sync (obs/health.py, tools/check_no_sync.py).
             metrics = health.finalize_health_metrics(
-                metrics, grads, _param_tuple(state), _param_tuple(new_state)
+                metrics, grads, _param_tuple(state), _param_tuple(new_state),
+                frozen_group=frozen_group,
             )
         return new_state, metrics
 
@@ -394,6 +425,7 @@ def make_accum_train_step(
     grad_fn = make_grad_fn(config, global_batch_size)
     update = make_update_fn(config)
     with_health = config.obs.health
+    frozen_group = _frozen_group(config)
 
     def accum_step(
         state: CycleGANState, xs: jnp.ndarray, ys: jnp.ndarray, ws: jnp.ndarray
@@ -426,7 +458,8 @@ def make_accum_train_step(
             # (linearity), so norms/σ finalized here equal the
             # single-big-batch step's exactly (tests/test_accum.py).
             metrics = health.finalize_health_metrics(
-                metrics, grads, _param_tuple(state), _param_tuple(new_state)
+                metrics, grads, _param_tuple(state), _param_tuple(new_state),
+                frozen_group=frozen_group,
             )
         return new_state, metrics
 

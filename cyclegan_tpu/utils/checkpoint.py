@@ -244,8 +244,11 @@ class Checkpointer:
         # Elastic-recovery fields (resil/elastic.py): the writing mesh's
         # topology + per-leaf sharding specs make the slot restorable on
         # a DIFFERENT mesh; a mid_epoch record marks a step-granular
-        # emergency slot with its exact resume position.
-        for key in ("topology", "mid_epoch"):
+        # emergency slot with its exact resume position. Domain identity
+        # + transfer provenance (domains/) ride every slot too — the
+        # sidecar only describes the NEWEST save, and a ring fallback to
+        # an older slot must still know what pair it holds.
+        for key in ("topology", "mid_epoch", "domain", "transfer"):
             if meta and key in meta:
                 record[key] = meta[key]
         path = self._manifest_path(slot)

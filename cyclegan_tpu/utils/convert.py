@@ -1,4 +1,5 @@
-"""Convert checkpoints between the unrolled and scanned trunk layouts.
+"""Convert checkpoints between the unrolled and scanned trunk layouts,
+and back-tag legacy sidecars with their domain key.
 
 `--scan_blocks` (lax.scan residual trunk) stores generator params stacked
 on a leading axis under ScannedTrunk/ResidualBlock_0 instead of nine
@@ -6,9 +7,21 @@ ResidualBlock_i subtrees. This tool rewrites a saved training state —
 generator params AND their Adam mu/nu mirrors — so a checkpoint trained
 in one layout can resume in the other. Discriminator trees are untouched.
 
+`--tag_domain [KEY]` rewrites only the meta.json sidecar, stamping the
+domain key (domains/registry.py) that pre-domain checkpoints never
+recorded — every historical run trained horse2zebra (the reference's
+hard-coded dataset), so that is the default back-tag. Restore-side
+domain checks (resil/elastic.py) treat an untagged sidecar as
+horse2zebra anyway; tagging makes the identity explicit on disk so
+tools that read sidecars directly agree. Refuses to overwrite an
+EXISTING differing key unless --force_domain is given.
+
 Usage:
   python -m cyclegan_tpu.utils.convert --output_dir runs --to scanned
   python -m cyclegan_tpu.utils.convert --output_dir runs --to unrolled
+  python -m cyclegan_tpu.utils.convert --output_dir runs --tag_domain
+  python -m cyclegan_tpu.utils.convert --output_dir runs \
+      --tag_domain monet2photo --force_domain
 """
 
 from __future__ import annotations
@@ -46,7 +59,50 @@ def convert_state_trunk(
     )
 
 
+def tag_domain(output_dir: str, key: str, force: bool = False) -> str:
+    """Stamp `key` as the sidecar's domain (the --tag_domain mode).
+    Returns the previous value ("" when the sidecar recorded none).
+    Purely a sidecar rewrite — no state restore, no jax."""
+    from cyclegan_tpu.domains.registry import DomainError, _KEY_RE
+    from cyclegan_tpu.utils.checkpoint import Checkpointer
+
+    if not _KEY_RE.match(key or ""):
+        raise DomainError(
+            f"--tag_domain {key!r} is not a valid domain key "
+            f"(want {_KEY_RE.pattern})")
+    ckpt = Checkpointer(output_dir)
+    try:
+        if not ckpt.exists():
+            raise SystemExit(f"no checkpoint under {output_dir}/checkpoints")
+        meta = ckpt.read_meta()
+        prev = str(meta.get("domain") or "")
+        if prev and prev != key and not force:
+            raise SystemExit(
+                f"sidecar already records domain {prev!r}; re-tagging as "
+                f"{key!r} would rewrite a real identity — pass "
+                f"--force_domain if that is intended")
+        meta["domain"] = key
+        ckpt._write_sidecar(meta)
+        return prev
+    finally:
+        ckpt.close()
+
+
 def main(args: argparse.Namespace) -> None:
+    # getattr defaults: programmatic callers (tests, scripts) build a
+    # Namespace with only the flags their mode needs.
+    tag = getattr(args, "tag_domain", None)
+    if (args.to is None) == (tag is None):
+        raise SystemExit(
+            "pass exactly one of --to (trunk layout conversion) or "
+            "--tag_domain (sidecar domain back-tag)")
+    if tag is not None:
+        prev = tag_domain(args.output_dir, tag,
+                          force=getattr(args, "force_domain", False))
+        print(f"tagged {args.output_dir} sidecar as domain "
+              f"{tag!r}"
+              + (f" (was {prev!r})" if prev else " (was untagged)"))
+        return
     from cyclegan_tpu.utils.platform import ensure_platform_from_env
 
     ensure_platform_from_env()
@@ -109,15 +165,35 @@ def main(args: argparse.Namespace) -> None:
     target_cfg = config.replace(
         model=dataclasses.replace(config.model, scan_blocks=not src_scanned)
     )
-    ckpt.save(state, next_epoch - 1, meta=target_cfg.model_meta())
+    # The rewritten sidecar records the TARGET layout; identity facts
+    # the source sidecar carried (domain key, transfer provenance) ride
+    # along — a layout conversion must not erase what pair the weights
+    # were trained on. Untagged legacy sidecars back-tag as the default
+    # domain (horse2zebra — the only pair that existed before keys).
+    from cyclegan_tpu.domains.registry import DEFAULT_DOMAIN
+
+    new_meta = target_cfg.model_meta()
+    new_meta["domain"] = str(meta.get("domain") or DEFAULT_DOMAIN)
+    if isinstance(meta.get("transfer"), dict):
+        new_meta["transfer"] = dict(meta["transfer"])
+    ckpt.save(state, next_epoch - 1, meta=new_meta)
     ckpt.close()
-    print(f"converted {ckpt.slot} to {args.to} trunk layout")
+    print(f"converted {ckpt.slot} to {args.to} trunk layout "
+          f"(domain {new_meta['domain']!r})")
 
 
 if __name__ == "__main__":
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--output_dir", default="runs")
-    p.add_argument("--to", required=True, choices=["scanned", "unrolled"])
+    p.add_argument("--to", default=None, choices=["scanned", "unrolled"])
+    p.add_argument("--tag_domain", nargs="?", const="horse2zebra",
+                   default=None, metavar="KEY",
+                   help="back-tag the sidecar with a domain key instead "
+                        "of converting (no KEY = horse2zebra, the only "
+                        "pair that existed before domain recording)")
+    p.add_argument("--force_domain", action="store_true",
+                   help="allow --tag_domain to overwrite a DIFFERENT "
+                        "already-recorded domain key")
     p.add_argument("--image_size", default=None, type=int,
                    help="override the size recorded in the checkpoint meta "
                         "(fully-convolutional nets: affects nothing but the "

@@ -36,7 +36,6 @@ def main(args: argparse.Namespace) -> None:
     cli_startup()  # local-compile workaround + relay diagnosis
     from cyclegan_tpu.config import (
         Config,
-        DataConfig,
         ModelConfig,
         ObsConfig,
         ParallelConfig,
@@ -68,6 +67,44 @@ def main(args: argparse.Namespace) -> None:
 
     from cyclegan_tpu.config import DiscriminatorConfig, GeneratorConfig
 
+    # Resolve the domain pair through the registry (domains/registry.py):
+    # `--domain <key>` is the ONLY thing a new pair needs — the spec
+    # carries dataset/source/sizes/augment policy, and explicit data
+    # flags below still override field-by-field.
+    import dataclasses
+
+    from cyclegan_tpu.domains import registry as domains
+
+    try:
+        dom_registry = domains.default_registry(args.domain_registry)
+        spec = dom_registry.resolve(args.domain)
+    except domains.DomainError as e:
+        raise SystemExit(str(e))
+
+    data_cfg = domains.data_config_for(spec)
+    data_overrides = {
+        "cache_augmented": not args.fresh_augment and spec.cache_augmented,
+        "synthetic_train_size": args.synthetic_train_size,
+        "synthetic_test_size": args.synthetic_test_size,
+    }
+    if args.dataset != "horse2zebra":
+        data_overrides["dataset"] = args.dataset
+    if args.data_dir is not None:
+        data_overrides["data_dir"] = args.data_dir
+    if args.data_source != "auto":
+        data_overrides["source"] = args.data_source
+    elif spec.source == "tfds":
+        # Preserve the historical default: 'auto' tries TFDS and falls
+        # back to synthetic in egress-free environments, instead of the
+        # spec's hard 'tfds' requirement. Pin --data_source to refuse
+        # the fallback.
+        data_overrides["source"] = "auto"
+    if args.image_size != spec.crop_size:
+        data_overrides["crop_size"] = args.image_size
+        data_overrides["resize_size"] = int(
+            args.image_size * spec.resize_size / spec.crop_size)
+    data_cfg = dataclasses.replace(data_cfg, **data_overrides)
+
     config = Config(
         model=ModelConfig(
             generator=GeneratorConfig(
@@ -84,16 +121,7 @@ def main(args: argparse.Namespace) -> None:
             image_size=args.image_size,
             trunk_impl=args.trunk_impl,
         ),
-        data=DataConfig(
-            dataset=args.dataset,
-            data_dir=args.data_dir,
-            source=args.data_source,
-            cache_augmented=not args.fresh_augment,
-            crop_size=args.image_size,
-            resize_size=int(args.image_size * 286 / 256),
-            synthetic_train_size=args.synthetic_train_size,
-            synthetic_test_size=args.synthetic_test_size,
-        ),
+        data=data_cfg,
         parallel=ParallelConfig(spatial_parallelism=args.spatial_parallelism),
         train=TrainConfig(
             output_dir=args.output_dir,
@@ -108,6 +136,9 @@ def main(args: argparse.Namespace) -> None:
             grad_impl=args.grad_impl,
             ckpt_keep=args.ckpt_keep,
             preempt_deadline_s=args.preempt_deadline_s,
+            init_from=args.init_from,
+            transfer_mode=args.transfer,
+            strict_domain=args.strict_domain,
         ),
         obs=ObsConfig(
             enabled=not args.no_obs,
@@ -256,6 +287,30 @@ def main(args: argparse.Namespace) -> None:
         print(f"Resumed from {ckpt.slot} at epoch {start_epoch}"
               + (f", step {resume_step}" if resume_step else ""))
 
+    # Mind2Mind transfer onboarding (domains/transfer.py): seed a FRESH
+    # run's params from the parent's verified ring. A run that already
+    # checkpointed keeps resuming from its own ring (the parent seed is
+    # an initialization, not a restore source) — its recorded provenance
+    # is re-read so subsequent sidecars keep carrying the lineage.
+    transfer_prov = None
+    if config.train.init_from:
+        from cyclegan_tpu.domains import transfer as domain_transfer
+
+        try:
+            if not resumed:
+                state, transfer_prov = domain_transfer.restore_parent(
+                    config, state, telemetry=tele,
+                    echo=print if primary else None)
+            else:
+                own_meta = ckpt.read_meta()
+                transfer_prov = (own_meta or {}).get("transfer") or {
+                    "parent_ckpt": config.train.init_from,
+                    "transfer_mode": config.train.transfer_mode,
+                    "domain": config.data.domain,
+                }
+        except (domain_transfer.TransferError, domains.DomainError) as e:
+            raise SystemExit(str(e))
+
     multi_step = None
     if config.train.grad_accum > 1:
         from cyclegan_tpu.parallel.dp import shard_accum_train_step
@@ -352,6 +407,7 @@ def main(args: argparse.Namespace) -> None:
                     tele, health, injector, guard, fid_eval, run_fid,
                     async_fid, ckpt, services, primary, flops_per_image,
                     peak_tflops, plot_cycle, start_step=this_start,
+                    transfer_prov=transfer_prov,
                 )
             except HealthFault as fault:
                 if rollback is None:
@@ -411,7 +467,8 @@ def _run_one_epoch(args, config, data, plan, train_step, test_step,
                    multi_step, cycle_step, state, summary, epoch, tracer,
                    tele, health, injector, guard, fid_eval, run_fid,
                    async_fid, ckpt, services, primary, flops_per_image,
-                   peak_tflops, plot_cycle, start_step=0):
+                   peak_tflops, plot_cycle, start_step=0,
+                   transfer_prov=None):
     """One full epoch body (train + test + rollups + FID + checkpoint),
     split out of main() so the rollback policy can wrap exactly this
     unit in its HealthFault handler. Returns (state, preempted).
@@ -446,7 +503,8 @@ def _run_one_epoch(args, config, data, plan, train_step, test_step,
             ckpt, state, config, plan, data, epoch,
             start_step + breaker.batches_done, guard,
             services=services, telemetry=tele,
-            echo=print if primary else None)
+            echo=print if primary else None,
+            transfer=transfer_prov)
         return state, True
     train_elapse = time() - start
     results = loop.test_epoch(
@@ -531,7 +589,8 @@ def _run_one_epoch(args, config, data, plan, train_step, test_step,
         # the writing mesh + batch decomposition + per-leaf sharding
         # specs, so this save restores onto a different mesh.
         ckpt.save(state, epoch,
-                  meta=elastic.save_meta(config, plan, state=state),
+                  meta=elastic.save_meta(config, plan, state=state,
+                                         transfer=transfer_prov),
                   services=services)
         if primary:
             print(f"saving checkpoint to {ckpt.slot} "
@@ -554,6 +613,47 @@ if __name__ == "__main__":
     parser.add_argument("--verbose", default=1, type=int, choices=[0, 1, 2])
     parser.add_argument("--clear_output_dir", action="store_true")
     # Framework extensions
+    parser.add_argument("--domain", default="horse2zebra",
+                        help="domain-pair registry key (domains/"
+                             "registry.py): resolves dataset/source/"
+                             "sizes/augment policy from the spec — a new "
+                             "pair needs only a registry entry, zero "
+                             "code. The key is recorded in every "
+                             "checkpoint sidecar and telemetry manifest "
+                             "and is the fleet tenant identity. Explicit "
+                             "data flags (--dataset, --data_dir, "
+                             "--data_source, --image_size) still "
+                             "override the spec field-by-field")
+    parser.add_argument("--domain_registry", default=None, metavar="JSON",
+                        help="extra domain specs merged OVER the "
+                             "builtins: {\"domains\": [{\"key\": ..., "
+                             "\"source\": \"tfds|folder|synthetic\", "
+                             "...}]} — how a new pair onboards with "
+                             "config only")
+    parser.add_argument("--init_from", default=None, metavar="RUN_DIR",
+                        help="Mind2Mind transfer onboarding (domains/"
+                             "transfer.py, arXiv:1906.11613): seed this "
+                             "run's four networks from the parent run's "
+                             "verified checkpoint ring (params only — "
+                             "optimizer state and step start fresh); "
+                             "provenance (parent, mode, domains) rides "
+                             "every sidecar this run writes")
+    parser.add_argument("--transfer", default="full_finetune",
+                        choices=["full_finetune", "encoder_freeze"],
+                        help="transfer mode under --init_from: "
+                             "'full_finetune' trains everything; "
+                             "'encoder_freeze' pins both generators' "
+                             "encoder trunks (c7s1 stem + downsampling "
+                             "blocks) by zeroing their gradients inside "
+                             "the jitted step — the frozen group is "
+                             "health-monitored (health/*_enc_frozen "
+                             "must pin at 0)")
+    parser.add_argument("--strict_domain", action="store_true",
+                        help="refuse (instead of warn) when a restored "
+                             "checkpoint's sidecar domain differs from "
+                             "--domain; applies to resume AND to "
+                             "--init_from (cross-domain transfer is "
+                             "deliberate, so this stays opt-in)")
     parser.add_argument("--dataset", default="horse2zebra",
                         help="TFDS cycle_gan/<name> dataset")
     parser.add_argument("--data_dir", default=None,

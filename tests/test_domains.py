@@ -1,0 +1,367 @@
+"""Domain subsystem (cyclegan_tpu/domains): the declarative registry
+that makes `--domain` a data lookup, the (domain, tier) tenant-key
+contract the fleet shares, and Mind2Mind transfer onboarding — parent
+restore through the verified ring, encoder-freeze gradient masking, and
+sidecar provenance.
+
+Registry tests are pure host-side (specs are data); the transfer tests
+run real tiny models on the CPU mesh because the freeze contract is
+bit-exactness of the frozen leaves through a real jitted step.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from cyclegan_tpu.domains.registry import (  # noqa: E402
+    BUILTIN_SPECS,
+    DEFAULT_DOMAIN,
+    DomainError,
+    DomainRegistry,
+    DomainSpec,
+    data_config_for,
+    default_registry,
+    load_registry_file,
+    split_tenant_key,
+    tenant_key,
+)
+from cyclegan_tpu.domains.transfer import (  # noqa: E402
+    ENCODER_MODULES,
+    TransferError,
+    apply_freeze,
+    check_domain_compat,
+    frozen_leaves,
+    mask_encoder_grads,
+    restore_parent,
+    sidecar_domain,
+    spec_summary,
+    validate_mode,
+)
+
+
+class _Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []
+
+    def event(self, kind, /, **fields):
+        with self._lock:
+            self.events.append(dict(fields, event=kind))
+
+    def of(self, kind):
+        with self._lock:
+            return [e for e in self.events if e["event"] == kind]
+
+
+# -- registry: spec validation ---------------------------------------------
+
+def test_bad_specs_fail_at_construction_with_field_named():
+    with pytest.raises(DomainError, match="key"):
+        DomainSpec(key="Horse2Zebra")  # uppercase breaks the grammar
+    with pytest.raises(DomainError, match="source"):
+        DomainSpec(key="pair", source="s3")
+    with pytest.raises(DomainError, match="data_dir"):
+        DomainSpec(key="pair", source="folder")  # folder needs a root
+    with pytest.raises(DomainError, match="data_dir"):
+        DomainSpec(key="pair", source="synthetic", data_dir="/x")
+    with pytest.raises(DomainError, match="crop_size"):
+        DomainSpec(key="pair", resize_size=128, crop_size=256)
+    with pytest.raises(DomainError, match="group"):
+        DomainSpec(key="pair", group="Bad Group")
+
+
+def test_registry_refuses_duplicates_and_mixed_group_resolutions():
+    with pytest.raises(DomainError, match="duplicate"):
+        DomainRegistry([DomainSpec(key="pair"), DomainSpec(key="pair")])
+    # One generator serves a shared group: crop sizes must agree.
+    with pytest.raises(DomainError, match="mixes crop sizes"):
+        DomainRegistry([
+            DomainSpec(key="a2b", group="shared", crop_size=256),
+            DomainSpec(key="c2d", group="shared", crop_size=128,
+                       resize_size=143),
+        ])
+
+
+def test_builtin_registry_resolves_default_and_refuses_unknown():
+    reg = default_registry()
+    assert DEFAULT_DOMAIN == "horse2zebra"
+    spec = reg.resolve(DEFAULT_DOMAIN)
+    assert spec.source == "tfds" and spec.tfds_dataset == "horse2zebra"
+    assert "apple2orange" in reg
+    # The art2photo shared-generator group is populated and sorted.
+    assert reg.group_members("art2photo") == [
+        "cezanne2photo", "monet2photo", "ukiyoe2photo", "vangogh2photo"]
+    with pytest.raises(DomainError, match="unknown domain"):
+        reg.resolve("zebra2horse")
+    with pytest.raises(DomainError, match="unknown shared-generator"):
+        reg.group_members("nope")
+    # Directional pairs must not mirror.
+    assert reg.resolve("maps").augment_flip is False
+
+
+def test_registry_file_merges_over_builtins_and_refuses_typos(tmp_path):
+    path = tmp_path / "domains.json"
+    path.write_text(json.dumps({"domains": [
+        # New local-dir pair ...
+        {"key": "scans2sketch", "source": "folder",
+         "data_dir": str(tmp_path), "augment_flip": False},
+        # ... and a redefinition of a built-in key (local mirror).
+        {"key": "horse2zebra", "source": "folder",
+         "data_dir": str(tmp_path)},
+    ]}))
+    reg = default_registry(str(path))
+    assert reg.resolve("scans2sketch").data_dir == str(tmp_path)
+    assert reg.resolve("horse2zebra").source == "folder"
+    assert "apple2orange" in reg  # built-ins survive the merge
+
+    bad = tmp_path / "typo.json"
+    bad.write_text(json.dumps(
+        {"domains": [{"key": "pair", "agument_flip": False}]}))
+    with pytest.raises(DomainError, match="agument_flip"):
+        load_registry_file(str(bad))
+    notalist = tmp_path / "shape.json"
+    notalist.write_text(json.dumps({"domains": {"key": "pair"}}))
+    with pytest.raises(DomainError, match="list"):
+        load_registry_file(str(notalist))
+
+
+def test_second_domain_is_config_only(tiny_config):
+    """The tentpole claim: onboarding apple2orange is a registry lookup
+    threaded into DataConfig — no code, and non-domain knobs (the tiny
+    synthetic sizes) survive the thread-through."""
+    reg = default_registry()
+    cfg = data_config_for(reg.resolve("apple2orange"),
+                          base=tiny_config.data)
+    assert cfg.domain == "apple2orange"
+    assert cfg.dataset == "apple2orange"
+    assert cfg.source == "tfds"
+    assert cfg.synthetic_train_size == tiny_config.data.synthetic_train_size
+    drill = data_config_for(reg.resolve("synthetic_drill"),
+                            base=tiny_config.data)
+    assert drill.source == "synthetic"
+    assert drill.synthetic_train_size == 64  # spec's own drill size wins
+
+
+def test_tenant_key_roundtrip_and_refusals():
+    assert tenant_key("horse2zebra", "int8") == "horse2zebra/int8"
+    assert split_tenant_key("horse2zebra/int8") == ("horse2zebra", "int8")
+    for bad in ("horse2zebra", "/int8", "horse2zebra/", ""):
+        with pytest.raises(DomainError):
+            split_tenant_key(bad)
+    with pytest.raises(DomainError):
+        tenant_key("Bad Domain", "base")
+    with pytest.raises(DomainError):
+        tenant_key("horse2zebra", "a/b")
+
+
+def test_builtin_specs_all_resolve_under_the_key_grammar():
+    reg = DomainRegistry(BUILTIN_SPECS)
+    for key in reg.keys():
+        tenant_key(key, "base")  # every built-in key is tenant-safe
+
+
+# -- transfer: mode + freeze mask ------------------------------------------
+
+def test_validate_mode_refuses_unknown():
+    assert validate_mode("encoder_freeze") == "encoder_freeze"
+    with pytest.raises(TransferError, match="freeze_encoder"):
+        validate_mode("freeze_encoder")  # the likely typo, named back
+
+
+def _gen_params(tiny_config):
+    import jax
+    import jax.numpy as jnp
+
+    from cyclegan_tpu.serve.engine import build_generator
+
+    gen = build_generator(tiny_config.model)
+    s = tiny_config.model.image_size
+    return gen.init(jax.random.PRNGKey(0),
+                    jnp.zeros((1, s, s, 3), jnp.float32))
+
+
+def test_mask_zeroes_exactly_the_encoder_trunk(tiny_config):
+    import jax
+
+    params = _gen_params(tiny_config)
+    masked = mask_encoder_grads(params)
+    flat = jax.tree_util.tree_flatten_with_path(masked)[0]
+    n_frozen = n_live = 0
+    for path, leaf in flat:
+        keys = {getattr(p, "key", None) for p in path}
+        if keys & set(ENCODER_MODULES):
+            assert not np.any(np.asarray(leaf)), f"unmasked {path}"
+            n_frozen += 1
+        else:
+            n_live += 1
+    assert n_frozen > 0 and n_live > 0
+    # frozen_leaves picks out the same set the mask zeroes.
+    assert len(frozen_leaves(params)) == n_frozen
+
+
+def test_apply_freeze_leaves_discriminators_alone(tiny_config):
+    params = _gen_params(tiny_config)
+    fake_disc = {"params": {"Conv_0": np.ones((2, 2), np.float32)}}
+    g, f, dx, dy = apply_freeze((params, params, fake_disc, fake_disc))
+    assert dx is fake_disc and dy is fake_disc  # untouched, not even copied
+    assert not np.any(np.asarray(frozen_leaves(g)[0]))
+    assert not np.any(np.asarray(frozen_leaves(f)[0]))
+
+
+def test_encoder_freeze_pins_params_through_a_real_step(tiny_config):
+    """The end-to-end freeze contract: one jitted train step under
+    transfer_mode='encoder_freeze' leaves both generators' encoder
+    trunks BIT-IDENTICAL while the rest of the model moves, and the
+    health metrics carry the enc_frozen group pinned at exactly 0."""
+    import jax
+    import jax.numpy as jnp
+
+    from cyclegan_tpu.train import create_state, make_train_step
+
+    cfg = dataclasses.replace(
+        tiny_config,
+        train=dataclasses.replace(tiny_config.train, init_from="/parent",
+                                  transfer_mode="encoder_freeze"),
+        obs=dataclasses.replace(tiny_config.obs, health=True),
+    )
+    state = create_state(cfg, jax.random.PRNGKey(0))
+    s = cfg.model.image_size
+    x = np.random.RandomState(0).rand(2, s, s, 3).astype(np.float32) * 2 - 1
+    step = jax.jit(make_train_step(cfg, 2))
+    new_state, metrics = step(state, jnp.asarray(x), jnp.asarray(x),
+                              jnp.ones((2,), jnp.float32))
+    for old_p, new_p in ((state.g_params, new_state.g_params),
+                         (state.f_params, new_state.f_params)):
+        for a, b in zip(frozen_leaves(old_p), frozen_leaves(new_p)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        moved = any(
+            not np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(old_p), jax.tree.leaves(new_p)))
+        assert moved, "freeze must not pin the whole generator"
+    assert float(metrics["health/gnorm_enc_frozen"]) == 0.0
+    assert float(metrics["health/upd_ratio_enc_frozen"]) == 0.0
+    # An unfrozen run must NOT emit the group (the health layer would
+    # report a phantom fifth network).
+    plain = jax.jit(make_train_step(tiny_config, 2))
+    _, plain_metrics = plain(state, jnp.asarray(x), jnp.asarray(x),
+                             jnp.ones((2,), jnp.float32))
+    assert "health/gnorm_enc_frozen" not in plain_metrics
+
+
+# -- transfer: domain compatibility + sidecars -----------------------------
+
+def test_sidecar_domain_back_tags_legacy_metadata():
+    assert sidecar_domain(None) == DEFAULT_DOMAIN
+    assert sidecar_domain({}) == DEFAULT_DOMAIN
+    assert sidecar_domain({"epoch": 3}) == DEFAULT_DOMAIN
+    assert sidecar_domain({"domain": "maps"}) == "maps"
+
+
+def test_check_domain_compat_warns_then_strict_refuses():
+    rec = _Recorder()
+    warnings = []
+    assert check_domain_compat({"domain": "maps"}, "maps", strict=True)
+    ok = check_domain_compat({"domain": "maps"}, "facades", strict=False,
+                             telemetry=rec, echo=warnings.append)
+    assert ok is False
+    assert warnings and "--strict_domain" in warnings[0]
+    (ev,) = rec.of("domain_mismatch")
+    assert ev["checkpoint_domain"] == "maps"
+    assert ev["run_domain"] == "facades"
+    assert ev["strict"] is False
+    with pytest.raises(DomainError, match="strict_domain"):
+        check_domain_compat({"domain": "maps"}, "facades", strict=True)
+
+
+# -- transfer: parent restore ----------------------------------------------
+
+def test_restore_parent_seeds_params_fresh_optimizer(tiny_config, tmp_path):
+    import jax
+
+    from cyclegan_tpu.train import create_state
+    from cyclegan_tpu.utils.checkpoint import Checkpointer
+
+    parent = create_state(tiny_config, jax.random.PRNGKey(0))
+    Checkpointer(str(tmp_path)).save(parent, epoch=7,
+                                     meta={"domain": DEFAULT_DOMAIN})
+    child_cfg = dataclasses.replace(
+        tiny_config,
+        data=dataclasses.replace(tiny_config.data, domain="apple2orange"),
+        train=dataclasses.replace(tiny_config.train,
+                                  init_from=str(tmp_path),
+                                  transfer_mode="encoder_freeze"),
+    )
+    rec = _Recorder()
+    template = create_state(child_cfg, jax.random.PRNGKey(1))
+    state, prov = restore_parent(child_cfg, template, telemetry=rec)
+    # Params came from the parent...
+    for a, b in zip(jax.tree.leaves(parent.g_params),
+                    jax.tree.leaves(state.g_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # ... but the optimizer state and step are the CHILD's fresh ones.
+    assert state.g_opt is template.g_opt
+    assert int(state.step) == 0
+    assert prov == {
+        "parent_ckpt": str(tmp_path),
+        "parent_epoch": 7,
+        "parent_domain": DEFAULT_DOMAIN,
+        "transfer_mode": "encoder_freeze",
+        "domain": "apple2orange",
+    }
+    (ev,) = rec.of("transfer_init")
+    assert ev["parent_domain"] == DEFAULT_DOMAIN
+    # Cross-domain is the POINT of transfer: the mismatch is recorded,
+    # not fatal (strict off by default).
+    (mm,) = rec.of("domain_mismatch")
+    assert mm["context"] == "transfer init"
+    assert spec_summary(child_cfg)["frozen_modules"] == list(ENCODER_MODULES)
+
+
+def test_restore_parent_refusals(tiny_config, tmp_path):
+    import jax
+
+    from cyclegan_tpu.train import create_state
+    from cyclegan_tpu.utils.checkpoint import Checkpointer
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    cfg = dataclasses.replace(
+        tiny_config,
+        train=dataclasses.replace(tiny_config.train,
+                                  init_from=str(empty)))
+    template = create_state(cfg, jax.random.PRNGKey(1))
+    with pytest.raises(TransferError, match="no checkpoint slots"):
+        restore_parent(cfg, template)
+    # Strict mode refuses a cross-domain parent before any restore.
+    ring = tmp_path / "ring"
+    Checkpointer(str(ring)).save(template, epoch=0,
+                                 meta={"domain": "maps"})
+    strict_cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, init_from=str(ring),
+                                       strict_domain=True))
+    with pytest.raises(DomainError, match="strict_domain"):
+        restore_parent(strict_cfg, template)
+
+
+# -- static discipline ------------------------------------------------------
+
+
+def test_no_sync_check_covers_domains_directory():
+    """The freeze mask runs inside the jitted step, so domains/ is
+    hot-path for the no-sync gate — with ZERO sanctioned fetch sites
+    (False), unlike serve/'s deferred-D2H allowance."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from check_no_sync import hot_path_entries, run_check
+
+    entries = dict(hot_path_entries())
+    for mod in ("registry", "transfer", "__init__"):
+        assert entries.get(f"cyclegan_tpu/domains/{mod}.py") is False
+    assert run_check() == []

@@ -30,6 +30,7 @@ from cyclegan_tpu.serve.fleet import (  # noqa: E402
     FleetExecutor,
     ReplicaCrashed,
     ShedError,
+    TenantSpec,
     class_map,
 )
 from cyclegan_tpu.serve.fleet.admission import FleetRequest  # noqa: E402
@@ -720,3 +721,166 @@ def test_circuit_breaker_opens_and_close_drains_stranded_queue():
     assert summary["recoveries"] == 2
     assert [e["respawned"] for e in rec.of("fleet_recovery")] == [True, False]
     assert fleet.stats()["circuits_open"] == 1
+
+
+# -- multi-tenant serving ---------------------------------------------------
+
+
+def test_tenant_spec_and_wiring_validation():
+    with pytest.raises(ValueError, match="domain"):
+        TenantSpec(domain="Bad Domain")
+    with pytest.raises(ValueError, match="slo_ms"):
+        TenantSpec(domain="maps", slo_ms=0)
+    with pytest.raises(ValueError, match="shed_budget"):
+        TenantSpec(domain="maps", shed_budget=1.5)
+    with pytest.raises(ValueError, match="duplicate tenant"):
+        FleetConfig(tenants=(TenantSpec(domain="maps"),
+                             TenantSpec(domain="maps")))
+    eng = FakeEngine()
+    cfg = FleetConfig(tenants=(TenantSpec(domain="maps"),))
+    # Every declared tenant needs its engine loaded up front ...
+    with pytest.raises(ValueError, match="tenant_engines"):
+        FleetExecutor(eng, cfg)
+    # ... engines for undeclared tenants are refused ...
+    with pytest.raises(ValueError, match="not declared"):
+        FleetExecutor(eng, cfg, tenant_engines={
+            "maps/base": FakeEngine(), "facades/base": FakeEngine()})
+    # ... as are engines without any tenant declaration ...
+    with pytest.raises(ValueError, match="cfg.tenants"):
+        FleetExecutor(eng, FleetConfig(),
+                      tenant_engines={"maps/base": FakeEngine()})
+    # ... and a tenant engine speaking a different bucket grammar.
+    with pytest.raises(ValueError, match="grammar"):
+        FleetExecutor(eng, cfg, tenant_engines={
+            "maps/base": FakeEngine(buckets=(1, 2))})
+
+
+def test_fleet_routes_each_tenant_to_its_resident_engine():
+    """Tenant routing: requests flush on the engine resident for their
+    tenant key — never the primary — and the first declared tenant is
+    the default for tenant-less submits."""
+    primary, eng_a, eng_b = FakeEngine(), FakeEngine(), FakeEngine()
+    cfg = FleetConfig(
+        n_replicas=1, capacity=16, max_batch=1, max_wait_ms=0.0,
+        tenants=(TenantSpec(domain="horse2zebra"),
+                 TenantSpec(domain="apple2orange")))
+    fleet = FleetExecutor(primary, cfg, tenant_engines={
+        "horse2zebra/base": eng_a, "apple2orange/base": eng_b})
+    img = np.zeros((32, 32, 3), np.float32)
+    futs = [fleet.submit(img),  # default tenant = first declared
+            fleet.submit(img, tenant="horse2zebra/base"),
+            fleet.submit(img, tenant="apple2orange/base")]
+    for f in futs:
+        assert f.result(timeout=30)["fake"].shape == (32, 32, 3)
+    with pytest.raises(KeyError, match="unknown tenant"):
+        fleet.submit(img, tenant="maps/base")
+    summary = fleet.close()
+    assert sum(n for n, _, _ in eng_a.flushes) == 2
+    assert sum(n for n, _, _ in eng_b.flushes) == 1
+    assert primary.flushes == []
+    tenants = summary["tenants"]
+    assert tenants["horse2zebra/base"]["n_images"] == 2
+    assert tenants["apple2orange/base"]["n_images"] == 1
+    assert tenants["horse2zebra/base"]["domain"] == "horse2zebra"
+    assert summary["tenant_swaps"] == 0
+    assert summary["tenant_admission"]["horse2zebra/base"]["admitted"] == 2
+    # A tenant-less fleet refuses tenant routing outright.
+    plain = FleetExecutor(FakeEngine(), FleetConfig(n_replicas=1))
+    with pytest.raises(KeyError, match="no\\s+tenants configured"):
+        plain.submit(img, tenant="maps/base")
+    plain.close()
+
+
+def test_tenant_slo_tightens_but_never_loosens_the_deadline():
+    img = np.zeros((32, 32, 3), np.float32)
+    tight = FleetRequest(img, 32, "base", INTERACTIVE, now=0.0,
+                         tenant="maps/base", slo_ms=5.0)
+    assert tight.deadline == pytest.approx(0.005)
+    loose = FleetRequest(img, 32, "base", INTERACTIVE, now=0.0,
+                         tenant="maps/base",
+                         slo_ms=10 * INTERACTIVE.deadline_ms)
+    assert loose.deadline == pytest.approx(
+        INTERACTIVE.deadline_ms / 1000.0)
+    # The hedge twin carries the tenant key and the TIGHTENED deadline
+    # verbatim (re-deriving from the class would silently loosen it).
+    twin = tight.twin()
+    assert twin.tenant == "maps/base"
+    assert twin.deadline == tight.deadline
+
+
+def test_shed_budget_protects_a_tenant_from_starvation():
+    """Per-tenant shed budgets bound the victim scan: 0.25 over four
+    admitted requests allows exactly ONE eviction, then the tenant
+    stops being pickable and overload rejects arrivals at the door
+    instead of starving the tenant to zero."""
+    img = np.zeros((32, 32, 3), np.float32)
+    adm = AdmissionController(capacity=4,
+                              shed_budgets={"maps/base": 0.25})
+    queued = [FleetRequest(img, 32, "base", BEST_EFFORT,
+                           tenant="maps/base") for _ in range(4)]
+    for r in queued:
+        adm.offer(r)
+    adm.offer(_req(INTERACTIVE))  # evicts one best_effort (in budget)
+    assert sum(r.shed for r in queued) == 1
+    with pytest.raises(ShedError):  # budget spent: arrival rejected
+        adm.offer(_req(INTERACTIVE))
+    assert sum(r.shed for r in queued) == 1  # still only one victim
+    stats = adm.stats()
+    assert stats["tenants"]["maps/base"] == {
+        "admitted": 4, "shed": 1, "shed_budget": 0.25}
+    adm.close()
+    # Contrast: without a budget the same pressure evicts twice.
+    unbudgeted = AdmissionController(capacity=4)
+    queued2 = [FleetRequest(img, 32, "base", BEST_EFFORT,
+                            tenant="maps/base") for _ in range(4)]
+    for r in queued2:
+        unbudgeted.offer(r)
+    unbudgeted.offer(_req(INTERACTIVE))
+    unbudgeted.offer(_req(INTERACTIVE))
+    assert sum(r.shed for r in queued2) == 2
+    unbudgeted.close()
+
+
+def test_hot_swap_under_load_drops_nothing():
+    """The acceptance pin: hot checkpoint swap with a loaded queue.
+    The in-flight flush resolves on the OLD engine (it keeps the
+    reference it was dispatched with), queued work picks up the NEW
+    engine at dispatch, and every submitted request resolves — zero
+    dropped."""
+    old, new, primary = FakeEngine(), FakeEngine(), FakeEngine()
+    old.gate = threading.Event()
+    rec = _Recorder()
+    cfg = FleetConfig(
+        n_replicas=1, capacity=64, max_batch=4, max_wait_ms=0.0,
+        tenants=(TenantSpec(domain="horse2zebra", slo_ms=60000.0),))
+    fleet = FleetExecutor(primary, cfg, logger=rec,
+                          tenant_engines={"horse2zebra/base": old})
+    img = np.zeros((32, 32, 3), np.float32)
+    futs = [fleet.submit(img, klass="batch") for _ in range(20)]
+    assert old.entered.wait(timeout=10)  # a flush is in flight on OLD
+    returned = fleet.swap_tenant("horse2zebra/base", new)
+    assert returned is old  # caller gets the old engine back to release
+    with pytest.raises(KeyError, match="unknown tenant"):
+        fleet.swap_tenant("maps/base", new)
+    with pytest.raises(ValueError, match="grammar"):
+        fleet.swap_tenant("horse2zebra/base", FakeEngine(buckets=(1, 2)))
+    snap = fleet.stats()
+    assert snap["tenant_swaps"] == 1
+    assert "horse2zebra/base" in snap["tenants"]
+    old.gate.set()
+    for f in futs:  # ZERO dropped: every future resolves with a result
+        assert f.result(timeout=30)["fake"].shape == (32, 32, 3)
+    summary = fleet.close()
+    n_old = sum(n for n, _, _ in old.flushes)
+    n_new = sum(n for n, _, _ in new.flushes)
+    assert n_old + n_new == 20
+    assert n_old >= 1  # in-flight work finished on the old engine
+    assert n_new >= 1  # queued work crossed over to the new engine
+    assert summary["shed"] == {}
+    tenants = summary["tenants"]
+    assert tenants["horse2zebra/base"]["n_images"] == 20
+    assert tenants["horse2zebra/base"]["slo_misses"] == 0
+    assert summary["tenant_swaps"] == 1
+    (ev,) = rec.of("fleet_tenant_swap")
+    assert ev["tenant"] == "horse2zebra/base"
+    assert ev["queue_depth"] >= 1  # swapped under genuine load

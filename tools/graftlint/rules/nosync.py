@@ -69,7 +69,12 @@ HOT_PATH_FILES: List[Tuple[str, bool]] = [
 #   separately because directory scans are deliberately non-recursive.
 # - resil (no sanctioned sites by default): recovery machinery is pure
 #   host-side orchestration; elastic.py alone carries a file entry.
+# - domains (no sanctioned sites): the registry is pure data, and the
+#   transfer freeze mask runs INSIDE the jitted step (trace-time tree
+#   surgery) — a host fetch there would sync every dispatch; the
+#   parent restore rides Checkpointer's already-policed path.
 HOT_PATH_DIRS: List[Tuple[str, bool]] = [
+    ("cyclegan_tpu/domains", False),
     ("cyclegan_tpu/obs", False),
     ("cyclegan_tpu/ops/pallas", False),
     ("cyclegan_tpu/serve", True),

@@ -105,6 +105,11 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
         "fleet_flushes": [],    # per-flush fleet dispatcher events
         "fleet_sheds": [],      # admission-control shed decisions
         "fleet_summary": None,  # FleetExecutor close() rollup
+        "fleet_tenant_swaps": [],  # hot checkpoint swaps (tenant table flips)
+        # Domain/transfer stream (cyclegan_tpu/domains): Mind2Mind
+        # onboarding provenance and sidecar-vs-config domain disputes.
+        "transfer_inits": [],
+        "domain_mismatches": [],
         # Self-driving fleet overlay (autoscaler + brownout cascade +
         # hedged dispatch + p95 quarantine): scale decisions, cascade
         # level moves, hedge dispatch/cancel pairs, shadow-probe
@@ -181,6 +186,12 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             report["fleet_sheds"].append(ev)
         elif kind == "fleet_summary":
             report["fleet_summary"] = ev
+        elif kind == "fleet_tenant_swap":
+            report["fleet_tenant_swaps"].append(ev)
+        elif kind == "transfer_init":
+            report["transfer_inits"].append(ev)
+        elif kind == "domain_mismatch":
+            report["domain_mismatches"].append(ev)
         elif kind == "fleet_autoscale":
             report["fleet_autoscales"].append(ev)
         elif kind == "fleet_brownout":
@@ -275,6 +286,34 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             "anomalies": anomalies,
         }
 
+    # Transfer-onboarding rollup: who this run fine-tuned from
+    # (transfer_init provenance), any sidecar-vs-config domain disputes
+    # along the way, and — for encoder_freeze runs — the frozen-trunk
+    # gradient envelope. The freeze is masking upstream of Adam, so the
+    # enc_frozen max MUST be exactly 0 over the whole run; any nonzero
+    # value is a finding (the mask regressed), surfaced as frozen_leak.
+    if report["transfer_inits"] or report["domain_mismatches"]:
+        init = report["transfer_inits"][0] if report["transfer_inits"] \
+            else {}
+        frozen_max = None
+        for ev in report["health"]:
+            env = (ev.get("gnorm") or {}).get("enc_frozen")
+            if isinstance(env, dict) and env.get("max") is not None:
+                v = float(env["max"])
+                frozen_max = v if frozen_max is None else max(frozen_max, v)
+        report["transfer_rollup"] = {
+            "mode": init.get("transfer_mode"),
+            "domain": init.get("domain"),
+            "parent_domain": init.get("parent_domain"),
+            "parent_epoch": init.get("parent_epoch"),
+            "parent_ckpt": init.get("parent_ckpt"),
+            "n_domain_mismatches": len(report["domain_mismatches"]),
+            "frozen_gnorm_max": frozen_max,
+            "frozen_leak": (init.get("transfer_mode") == "encoder_freeze"
+                            and frozen_max is not None
+                            and frozen_max > 0.0),
+        }
+
     # Serving rollup: trigger mix + fill factor quantify whether the
     # micro-batcher is running throughput-bound (full flushes) or
     # latency-bound (deadline flushes), queue-depth watermark shows how
@@ -335,6 +374,46 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             "shed_by_reason": shed_reason,
             "max_queue_depth": max(
                 (int(ev.get("queue_depth", 0)) for ev in ff), default=0),
+        }
+
+    # Multi-tenant census: per-(domain/tier) request/latency/shed view,
+    # stitched from the per-flush tenant field (flushes are
+    # tenant-homogeneous, so each event attributes cleanly), the shed
+    # events' tenant field, and — when the run closed cleanly — the
+    # authoritative fleet_summary tenants/tenant_admission rollups.
+    # Hot swaps are listed per tenant so a latency step change can be
+    # lined up against the checkpoint flip that caused it.
+    fsum = report["fleet_summary"] or {}
+    tenant_keys = sorted(
+        {str(ev["tenant"]) for ev in ff if ev.get("tenant")}
+        | {str(ev["tenant"]) for ev in report["fleet_sheds"]
+           if ev.get("tenant")}
+        | {str(ev["tenant"]) for ev in report["fleet_tenant_swaps"]
+           if ev.get("tenant")}
+        | set(fsum.get("tenants") or {})
+        | set(fsum.get("tenant_admission") or {}))
+    if tenant_keys:
+        tenants: Dict[str, dict] = {}
+        for key in tenant_keys:
+            mine = [ev for ev in ff if str(ev.get("tenant")) == key]
+            row = {
+                "n_flushes": len(mine),
+                "n_images": sum(int(ev.get("n", 0)) for ev in mine),
+                "n_shed": sum(1 for ev in report["fleet_sheds"]
+                              if str(ev.get("tenant")) == key),
+                "n_swaps": sum(1 for ev in report["fleet_tenant_swaps"]
+                               if str(ev.get("tenant")) == key),
+            }
+            summary_row = (fsum.get("tenants") or {}).get(key)
+            if isinstance(summary_row, dict):
+                row["summary"] = summary_row
+            adm_row = (fsum.get("tenant_admission") or {}).get(key)
+            if isinstance(adm_row, dict):
+                row["admission"] = adm_row
+            tenants[key] = row
+        report["tenant_rollup"] = {
+            "tenants": tenants,
+            "n_swaps": len(report["fleet_tenant_swaps"]),
         }
 
     # Self-driving-fleet rollup: the scale decision census, how deep
@@ -426,6 +505,10 @@ def render(report: dict) -> str:
         if host:
             w(f"processes: {host.get('process_count', 1)} "
               f"(this stream from index {host.get('process_index', 0)})")
+        domain = (((mani.get("config") or {}).get("data") or {})
+                  .get("domain"))
+        if domain:
+            w(f"domain: {domain}")
     else:
         w("-- manifest: MISSING (stream does not self-describe) --")
 
@@ -524,6 +607,33 @@ def render(report: dict) -> str:
                 f"{k}={v}" for k, v in sorted(hr["anomalies"].items())))
         else:
             w("anomalies: none")
+    tr = report.get("transfer_rollup")
+    if tr:
+        w("-- transfer onboarding --")
+        if tr.get("mode"):
+            w(f"fine-tuned ({tr['mode']}) onto {tr.get('domain', '?')} from "
+              f"{tr.get('parent_domain', '?')} @ epoch "
+              f"{tr.get('parent_epoch', '?')} ({tr.get('parent_ckpt', '?')})")
+        if tr["n_domain_mismatches"]:
+            w(f"DOMAIN MISMATCHES: {tr['n_domain_mismatches']} "
+              f"(checkpoint sidecar disagreed with the run's domain)")
+            for ev in report["domain_mismatches"][:5]:
+                w(f"  {ev.get('context', '?')}: checkpoint "
+                  f"{ev.get('checkpoint_domain', '?')} vs run "
+                  f"{ev.get('run_domain', '?')}"
+                  + ("  [strict]" if ev.get("strict") else ""))
+        if tr.get("mode") == "encoder_freeze":
+            if tr.get("frozen_gnorm_max") is None:
+                w("frozen trunk: no enc_frozen envelope recorded "
+                  "(health layer off?)")
+            elif tr["frozen_leak"]:
+                w(f"FROZEN-TRUNK LEAK: enc_frozen grad-norm max "
+                  f"{_fmt(tr['frozen_gnorm_max'], '.4g')} "
+                  f"(must be exactly 0 — the gradient mask regressed)")
+            else:
+                w("frozen trunk: enc_frozen grad-norm pinned at 0 over "
+                  "the whole run")
+
     if report["health_faults"]:
         w(f"-- health faults: {len(report['health_faults'])} --")
         for ev in report["health_faults"][:10]:
@@ -727,6 +837,37 @@ def render(report: dict) -> str:
             w(f"  class {name}: n={row.get('n', '?')} "
               f"p50 {_fmt(row.get('p50_s'))}s / p95 {_fmt(row.get('p95_s'))}s"
               f"  deadline misses: {row.get('deadline_misses', 0)}")
+
+    troll = report.get("tenant_rollup")
+    if troll:
+        w(f"-- multi-tenant fleet: {len(troll['tenants'])} tenant(s), "
+          f"{troll['n_swaps']} hot swap(s) --")
+        for key, row in sorted(troll["tenants"].items()):
+            parts = [f"{row['n_images']} images in {row['n_flushes']} "
+                     f"flushes, shed {row['n_shed']}"]
+            summ = row.get("summary") or {}
+            if summ:
+                slo = summ.get("slo_ms")
+                parts.append(
+                    f"p50 {_fmt(summ.get('p50_s'))}s / "
+                    f"p95 {_fmt(summ.get('p95_s'))}s, SLO "
+                    + (f"{_fmt(slo, '.0f')}ms" if slo is not None
+                       else "class-default")
+                    + f", misses {summ.get('slo_misses', 0)}")
+            adm = row.get("admission") or {}
+            if adm:
+                budget = adm.get("shed_budget")
+                parts.append(
+                    f"admitted {adm.get('admitted', '?')}"
+                    + (f", shed budget {_fmt(budget, '.2f')}"
+                       if budget is not None else ""))
+            if row["n_swaps"]:
+                parts.append(f"{row['n_swaps']} swap(s)")
+            w(f"  tenant {key}: " + "; ".join(parts))
+        for ev in report["fleet_tenant_swaps"][:10]:
+            w(f"  swap #{ev.get('swap', '?')} t={_fmt(ev.get('t'), '.2f')}s: "
+              f"{ev.get('tenant', '?')} (queue depth "
+              f"{ev.get('queue_depth', '?')} at flip)")
 
     aroll = report.get("autoscale_rollup")
     if aroll:

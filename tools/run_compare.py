@@ -29,6 +29,20 @@ Axes for a stream pair (each gated by its own threshold flag):
                from its uninterrupted base after the preemption seam
                FAILS, as does one whose step counts drifted (a skipped
                or repeated sample)
+  domain       streams carry the run's domain pair (manifest
+               config.data.domain) — a cross-domain pair SKIPs the
+               training axes (horse2zebra vs monet2photo trajectories
+               are not comparable), EXCEPT when the candidate is a
+               transfer-onboarded run (domains/transfer.py) whose
+               recorded parent_domain matches the base: then the
+               transfer axis alone engages
+  transfer     a fine-tune (`transfer_init` in the stream) is gated
+               against its parent run: final losses within
+               --max_loss_increase of the parent's, epoch count at most
+               --max_transfer_epoch_frac of the parent's (the onboarding
+               economics the registry promises), and for encoder_freeze
+               runs the frozen-trunk gradient envelope
+               (health/gnorm_enc_frozen) must be exactly zero
 
 For bench records the axis is per-config images/sec from the `all`
 sweep dict (intersection of configs) plus the headline value.
@@ -206,6 +220,23 @@ def stream_profile(events: List[dict], skipped: int = 0, name: str = "?") -> dic
     """Profile of one telemetry JSONL stream."""
     epochs = [e for e in events if e.get("event") == "epoch"]
     healths = [e for e in events if e.get("event") == "health"]
+    # Domain identity (PR-13): the manifest serializes the whole Config,
+    # so the run's domain key rides every stream for free; transfer
+    # provenance arrives as its own `transfer_init` event. Streams that
+    # predate domains profile as None and compare as before.
+    domain = None
+    manifest = next((e for e in events if e.get("event") == "manifest"),
+                    None)
+    if manifest is not None:
+        data_cfg = ((manifest.get("config") or {}).get("data") or {})
+        d = data_cfg.get("domain")
+        domain = str(d) if d else None
+    transfer = next((e for e in events
+                     if e.get("event") == "transfer_init"), None)
+    if transfer is not None:
+        transfer = {k: transfer.get(k)
+                    for k in ("parent_ckpt", "parent_epoch",
+                              "parent_domain", "transfer_mode", "domain")}
     faults = [e for e in events if e.get("event") == "health_fault"]
     stalls = sum(1 for e in events
                  if e.get("event") in ("stall", "loop_stall"))
@@ -277,6 +308,8 @@ def stream_profile(events: List[dict], skipped: int = 0, name: str = "?") -> dic
     return {
         "kind": "stream",
         "name": name,
+        "domain": domain,
+        "transfer": transfer,
         "n_events": len(events),
         "skipped_lines": skipped,
         "n_epochs": len(epochs),
@@ -445,6 +478,27 @@ def _compare_serve(base: dict, cand: dict, th) -> List[Check]:
 def _compare_streams(base: dict, cand: dict, th) -> List[Check]:
     checks: List[Check] = []
 
+    # Domain gate (mirrors the cross-platform SKIP for bench records):
+    # loss/gnorm trajectories of different domain pairs are not
+    # comparable — UNLESS the candidate is a transfer-onboarding run
+    # whose recorded parent domain is the base's domain, in which case
+    # the pair is exactly the Mind2Mind comparison the transfer axis
+    # gates (parent run -> fine-tune run).
+    b_dom, c_dom = base.get("domain"), cand.get("domain")
+    transfer = cand.get("transfer")
+    if b_dom and c_dom and b_dom != c_dom:
+        if transfer and transfer.get("parent_domain") == b_dom:
+            return _transfer_checks(base, cand, th)
+        return [(SKIP, "domain",
+                 f"domain changed {b_dom} -> {c_dom}: training "
+                 f"trajectories not comparable (transfer runs gate via "
+                 f"their recorded parent)")]
+    if transfer:
+        # Same-domain fine-tune (e.g. refreshing a pair from its own
+        # older checkpoint): the regular axes still apply, the transfer
+        # axis rides along.
+        checks.extend(_transfer_checks(base, cand, th))
+
     bt, ct = base.get("throughput"), cand.get("throughput")
     if bt is not None and ct is not None:
         drop = _rel_drop(bt, ct)
@@ -563,6 +617,57 @@ def _compare_streams(base: dict, cand: dict, th) -> List[Check]:
     return checks
 
 
+def _transfer_checks(base: dict, cand: dict, th) -> List[Check]:
+    """The transfer-onboarding axis: the candidate fine-tuned from the
+    base (Mind2Mind). Three claims under gate: the fine-tune's final
+    losses land within the usual loss slack of the parent's (transfer
+    must not END worse than where it started from), it gets there in at
+    most --max_transfer_epoch_frac of the parent's epochs (the whole
+    economic point of onboarding from a trained pair), and a frozen
+    encoder trunk really was frozen (its grad-norm envelope pins at
+    exactly 0 — any nonzero is masked-gradient machinery failing)."""
+    checks: List[Check] = []
+    t = cand.get("transfer") or {}
+    checks.append((INFO, "transfer provenance",
+                   f"mode {t.get('transfer_mode')!r}, parent "
+                   f"{t.get('parent_domain')!r} @ epoch "
+                   f"{t.get('parent_epoch')} ({t.get('parent_ckpt')})"))
+    common = sorted(set(base.get("final_losses") or {})
+                    & set(cand.get("final_losses") or {}))
+    for key in common:
+        bv, cv = base["final_losses"][key], cand["final_losses"][key]
+        limit = bv + th.max_loss_increase * max(abs(bv), 0.1)
+        status = FAIL if cv > limit else PASS
+        checks.append((status, f"transfer loss {key}",
+                       f"fine-tune final {cv:.4f} vs parent {bv:.4f} "
+                       f"(limit {limit:.4f})"))
+    if not common:
+        checks.append((SKIP, "transfer losses",
+                       "no common final loss means against the parent"))
+    b_ep, c_ep = base.get("n_epochs"), cand.get("n_epochs")
+    if b_ep and c_ep:
+        limit_ep = th.max_transfer_epoch_frac * b_ep
+        status = FAIL if c_ep > limit_ep else PASS
+        checks.append((status, "transfer epochs",
+                       f"fine-tune ran {c_ep} epoch(s) vs parent "
+                       f"{b_ep} (limit {limit_ep:.1f} = "
+                       f"{100 * th.max_transfer_epoch_frac:.0f}%)"))
+    else:
+        checks.append((SKIP, "transfer epochs",
+                       "epoch count missing in one stream"))
+    if t.get("transfer_mode") == "encoder_freeze":
+        frozen = (cand.get("gnorm_max") or {}).get("enc_frozen")
+        if frozen is None:
+            checks.append((SKIP, "transfer frozen-trunk",
+                           "no enc_frozen grad-norm envelope recorded"))
+        else:
+            checks.append((PASS if frozen == 0.0 else FAIL,
+                           "transfer frozen-trunk",
+                           f"frozen encoder grad-norm max envelope "
+                           f"{frozen:.4g} (must be exactly 0)"))
+    return checks
+
+
 def _fmt_kinds(kinds: Dict[str, int]) -> str:
     if not kinds:
         return "none"
@@ -621,6 +726,7 @@ def make_thresholds(
     max_bench_drop: float = 0.10,
     max_serve_p95_increase: float = 0.50,
     max_elastic_loss_diff: float = 1e-5,
+    max_transfer_epoch_frac: float = 0.25,
     json: bool = False,
 ) -> argparse.Namespace:
     """Programmatic threshold bundle (bench.py's end-of-run hook)."""
@@ -632,6 +738,7 @@ def make_thresholds(
         max_bench_drop=max_bench_drop,
         max_serve_p95_increase=max_serve_p95_increase,
         max_elastic_loss_diff=max_elastic_loss_diff,
+        max_transfer_epoch_frac=max_transfer_epoch_frac,
         json=json,
     )
 
@@ -662,6 +769,10 @@ def main(argv=None) -> int:
                         help="max elementwise |diff| of per-step loss "
                              "trajectories when the candidate resharded "
                              "or resumed mid-epoch (f32 equivalence)")
+    parser.add_argument("--max_transfer_epoch_frac", default=0.25, type=float,
+                        help="max epochs a transfer-onboarded fine-tune may "
+                             "run, as a fraction of its parent's from-scratch "
+                             "epoch count, while still reaching the loss gate")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable report")
     args = parser.parse_args(argv)
