@@ -221,7 +221,7 @@ def _default_config():
 def _config_for(compute_dtype: str, batch: int, image: int, norm_impl: str,
                 pad_mode: str = "reflect", pad_impl: str = "pad",
                 grad_accum: int = 1, grad_impl: str = "combined",
-                trunk_impl: str = "resnet"):
+                trunk_impl: str = "resnet", upsample_impl: str = "dense"):
     """The exact Config a bench measurement uses — shared with
     tools/cache_warm.py so the offline cache-warming compiles the SAME
     programs the driver-window bench will request (any drift here means
@@ -238,6 +238,7 @@ def _config_for(compute_dtype: str, batch: int, image: int, norm_impl: str,
             pad_mode=pad_mode,
             pad_impl=pad_impl,
             trunk_impl=trunk_impl,
+            upsample_impl=upsample_impl,
         ),
         train=TrainConfig(batch_size=batch, grad_accum=grad_accum,
                           grad_impl=grad_impl),
@@ -246,11 +247,13 @@ def _config_for(compute_dtype: str, batch: int, image: int, norm_impl: str,
 
 def _build(compute_dtype: str, batch: int, image: int, norm_impl: str,
            pad_mode: str = "reflect", pad_impl: str = "pad",
-           grad_impl: str = "combined", trunk_impl: str = "resnet"):
+           grad_impl: str = "combined", trunk_impl: str = "resnet",
+           upsample_impl: str = "dense"):
     from cyclegan_tpu.train import create_state, make_train_step
 
     cfg = _config_for(compute_dtype, batch, image, norm_impl, pad_mode,
-                      pad_impl, grad_impl=grad_impl, trunk_impl=trunk_impl)
+                      pad_impl, grad_impl=grad_impl, trunk_impl=trunk_impl,
+                      upsample_impl=upsample_impl)
     state = create_state(cfg, jax.random.PRNGKey(0))
     global _PLATFORM, _DEVICE_KIND
     _PLATFORM = jax.default_backend()  # backend is up once state exists
@@ -270,11 +273,13 @@ def _sync(metrics) -> float:
 
 def bench_steps(compute_dtype: str, batch: int, image: int = 256,
                 norm_impl: str = "auto", warmup: int = 2, iters: int = 10,
-                grad_impl: str = "combined", trunk_impl: str = "resnet"):
+                grad_impl: str = "combined", trunk_impl: str = "resnet",
+                upsample_impl: str = "dense"):
     """Python-dispatched per-step timing (epoch-loop semantics)."""
     state, step_fn, (x, y, w) = _build(compute_dtype, batch, image, norm_impl,
                                        grad_impl=grad_impl,
-                                       trunk_impl=trunk_impl)
+                                       trunk_impl=trunk_impl,
+                                       upsample_impl=upsample_impl)
     step = jax.jit(step_fn, donate_argnums=(0,))
     for _ in range(warmup):
         state, metrics = step(state, x, y, w)
@@ -311,7 +316,8 @@ def bench_dispatch(compute_dtype: str, batch: int, image: int = 256,
                    norm_impl: str = "auto", k: int = 1, warmup: int = 1,
                    iters: int = 10, pad_mode: str = "reflect",
                    pad_impl: str = "pad", prefetch: bool = False,
-                   grad_impl: str = "combined", trunk_impl: str = "resnet"):
+                   grad_impl: str = "combined", trunk_impl: str = "resnet",
+                   upsample_impl: str = "dense"):
     """Epoch-loop semantics INCLUDING the input pipeline's host->device
     transfer: every timed dispatch feeds fresh float32 NUMPY batches (the
     dtype the prefetch thread emits, data/pipeline.py), so each dispatch
@@ -327,7 +333,8 @@ def bench_dispatch(compute_dtype: str, batch: int, image: int = 256,
     as prefetch=False (host-side behavior only — no extra compile)."""
     state, step_fn, _ = _build(compute_dtype, batch, image, norm_impl,
                                pad_mode, pad_impl, grad_impl=grad_impl,
-                               trunk_impl=trunk_impl)
+                               trunk_impl=trunk_impl,
+                               upsample_impl=upsample_impl)
     rng = np.random.RandomState(1)
     lead = () if k == 1 else (k,)
     # Two host copies alternated so the runtime can't alias/cache one
@@ -376,12 +383,14 @@ def bench_dispatch(compute_dtype: str, batch: int, image: int = 256,
 def bench_scan(compute_dtype: str, batch: int, image: int = 256,
                norm_impl: str = "auto", warmup: int = 1, iters: int = 3,
                k: int = 8, pad_mode: str = "reflect", pad_impl: str = "pad",
-               grad_impl: str = "combined", trunk_impl: str = "resnet"):
+               grad_impl: str = "combined", trunk_impl: str = "resnet",
+               upsample_impl: str = "dense"):
     """Device-resident: K steps per jitted scan over K pre-staged batches."""
     state, step_fn, (x, y, w) = _build(compute_dtype, batch, image, norm_impl,
                                        pad_mode, pad_impl,
                                        grad_impl=grad_impl,
-                                       trunk_impl=trunk_impl)
+                                       trunk_impl=trunk_impl,
+                                       upsample_impl=upsample_impl)
     rng = np.random.RandomState(1)
     xs = jnp.asarray(rng.rand(k, batch, image, image, 3).astype(np.float32) * 2 - 1)
     ys = jnp.asarray(rng.rand(k, batch, image, image, 3).astype(np.float32) * 2 - 1)
@@ -403,7 +412,7 @@ def bench_accum(compute_dtype: str, micro: int, image: int = 512,
                 accum: int = 8, norm_impl: str = "auto", warmup: int = 1,
                 iters: int = 3, pad_mode: str = "reflect",
                 pad_impl: str = "pad", grad_impl: str = "combined",
-                trunk_impl: str = "resnet"):
+                trunk_impl: str = "resnet", upsample_impl: str = "dense"):
     """Gradient-accumulation step timing — the 512^2 HBM-relief config
     (TPU_RUNBOOK item 5): `accum` microbatches of `micro` per optimizer
     update, peak activation memory tracking the MICRObatch
@@ -417,7 +426,7 @@ def bench_accum(compute_dtype: str, micro: int, image: int = 512,
     effective = micro * accum
     cfg = _config_for(compute_dtype, effective, image, norm_impl, pad_mode,
                       pad_impl, grad_accum=accum, grad_impl=grad_impl,
-                      trunk_impl=trunk_impl)
+                      trunk_impl=trunk_impl, upsample_impl=upsample_impl)
     state = create_state(cfg, jax.random.PRNGKey(0))
     global _PLATFORM, _DEVICE_KIND
     _PLATFORM = jax.default_backend()
@@ -714,6 +723,11 @@ def _flops_accounting(best_ips: float, platform: str,
             cfg = dataclasses.replace(
                 cfg, model=dataclasses.replace(cfg.model, trunk_impl="perturb")
             )
+        if "/zskip" in best_key:  # matches /zskipf too — same MAC model
+            cfg = dataclasses.replace(
+                cfg,
+                model=dataclasses.replace(cfg.model, upsample_impl="zeroskip"),
+            )
         flops_img = train_step_flops_per_image(cfg)
     except Exception:  # accounting must never break the emission contract
         return {}
@@ -869,6 +883,13 @@ def _config_key(c: dict) -> str:
         key += "/fusedprop"
     if c.get("trunk_impl", "resnet") == "perturb":
         key += "/perturb"
+    # Zero-skip upsample tiers: fp-tolerance parity with dense (same
+    # params, same outputs — tests/test_zeroskip.py), so BOTH stay
+    # headline-eligible (the _emit filter excludes only /zero+/perturb).
+    if c.get("upsample_impl", "dense") == "zeroskip":
+        key += "/zskip"
+    if c.get("upsample_impl", "dense") == "zeroskip_fused":
+        key += "/zskipf"
     if c.get("pad_mode", "reflect") == "zero":
         key += "/zero"
     return key
@@ -918,7 +939,9 @@ def _run_configs(results: dict, configs, t_start: float, on_result=None,
             pad_mode = c.get("pad_mode", "reflect")
             grad_impl = c.get("grad_impl", "combined")
             trunk_impl = c.get("trunk_impl", "resnet")
-            if pad_impl == "epilogue" and _mosaic_compile_blocked():
+            upsample_impl = c.get("upsample_impl", "dense")
+            if ((pad_impl == "epilogue" or upsample_impl == "zeroskip_fused")
+                    and _mosaic_compile_blocked()):
                 print(f"[{tag}] {key}: skipped (Mosaic program; compiles "
                       "would cross the remote-compile leg — ground rule "
                       "2b; runs under local-compile windows)",
@@ -933,6 +956,7 @@ def _run_configs(results: dict, configs, t_start: float, on_result=None,
                     dtype, batch, image=image, warmup=1 if on_cpu else 2,
                     iters=1 if on_cpu else 10,
                     grad_impl=grad_impl, trunk_impl=trunk_impl,
+                    upsample_impl=upsample_impl,
                 )
             elif mode == "dispatch":
                 k = c.get("k", 1)
@@ -943,6 +967,7 @@ def _run_configs(results: dict, configs, t_start: float, on_result=None,
                     pad_mode=pad_mode, pad_impl=pad_impl,
                     prefetch=bool(c.get("prefetch")),
                     grad_impl=grad_impl, trunk_impl=trunk_impl,
+                    upsample_impl=upsample_impl,
                 )
             else:
                 ips = bench_scan(
@@ -950,6 +975,7 @@ def _run_configs(results: dict, configs, t_start: float, on_result=None,
                     iters=1 if on_cpu else 3, k=2 if on_cpu else 8,
                     pad_mode=pad_mode, pad_impl=pad_impl,
                     grad_impl=grad_impl, trunk_impl=trunk_impl,
+                    upsample_impl=upsample_impl,
                 )
             results[key] = ips
             if on_result is not None:
@@ -1003,6 +1029,12 @@ TPU_CONFIGS = [
     # cannot claim the headline.
     {"mode": "scan", "dtype": "bfloat16", "batch": 16,
      "grad_impl": "fusedprop"},
+    # GANAX zero-skip upsample (ISSUE 14): headline-ELIGIBLE — same
+    # params and outputs as dense to fp tolerance
+    # (tests/test_zeroskip.py) with ~4x fewer upsample MACs. Pure XLA,
+    # so it runs under any compile mode.
+    {"mode": "scan", "dtype": "bfloat16", "batch": 16,
+     "upsample_impl": "zeroskip"},
     # The zero-pad lever (compiler-certified −32.4% step traffic,
     # quality-cleared at toy scale — docs/RESULTS.md pad A/B): carried
     # in the OFFICIAL record so the driver window captures it. Placed
@@ -1017,6 +1049,11 @@ TPU_CONFIGS = [
     # local-compile windows and the chip_autorun epilogue_sweep step.
     {"mode": "scan", "dtype": "bfloat16", "batch": 16,
      "pad_impl": "epilogue"},
+    # The fused zero-skip tier (Pallas phase-conv + IN + ReLU kernel,
+    # ops/pallas/upsample_kernel.py): Mosaic-gated like the epilogue
+    # row; measures under local-compile windows / upsample_sweep.
+    {"mode": "scan", "dtype": "bfloat16", "batch": 16,
+     "upsample_impl": "zeroskip_fused"},
     # Perturb cheap-trunk tier (ISSUE 7): excluded from the headline by
     # _emit like /zero (different architecture — a quality tier, not a
     # parity config), but carried in the official record so the first
@@ -1037,6 +1074,11 @@ CPU_CONFIGS = [
     {"mode": "steps", "dtype": "float32", "batch": 1},
     {"mode": "steps", "dtype": "float32", "batch": 1,
      "grad_impl": "fusedprop"},
+    # zeroskip twin of the anchor row (ISSUE 14 acceptance: the
+    # dense/zeroskip pair measured in ONE window, zeroskip >= dense,
+    # run_compare-paired via the /zskip key).
+    {"mode": "steps", "dtype": "float32", "batch": 1,
+     "upsample_impl": "zeroskip"},
     {"mode": "scan", "dtype": "bfloat16", "batch": 16},
 ]
 

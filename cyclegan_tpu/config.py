@@ -88,6 +88,23 @@ class ModelConfig:
     #             Quality (not parity) tier — A/B-gated by the health
     #             monitor + run_compare, never silently swapped in.
     trunk_impl: str = "resnet"  # "resnet" | "perturb"
+    # Upsample engine for the generator's stride-2 3x3 ConvTranspose
+    # blocks (GANAX output decomposition — PAPERS.md arXiv:1806.01107):
+    # "dense"          = nn.ConvTranspose, lowered as an lhs-dilated
+    #                    conv that multiplies the inserted zeros —
+    #                    parity baseline;
+    # "zeroskip"       = 4 per-phase dense sub-kernel convs on the
+    #                    UNexpanded input, interleaved depth-to-space
+    #                    (ops/upsample.py): same math to fp tolerance,
+    #                    same param tree (checkpoints interchange),
+    #                    ~4x fewer upsample MACs, pure XLA;
+    # "zeroskip_fused" = zeroskip phase convs + the IN>ReLU (and
+    #                    last-upsample reflect-pad) epilogue in ONE
+    #                    Pallas VMEM residency
+    #                    (ops/pallas/upsample_kernel.py), eligibility-
+    #                    gated per shape/dtype with the XLA zeroskip
+    #                    path as fallback.
+    upsample_impl: str = "dense"  # "dense" | "zeroskip" | "zeroskip_fused"
 
     def __post_init__(self):
         # A typo like "Reflect" would otherwise silently select zero/SAME
@@ -112,6 +129,20 @@ class ModelConfig:
             raise ValueError(
                 f"trunk_impl must be 'resnet' or 'perturb', got "
                 f"{self.trunk_impl!r}"
+            )
+        if self.upsample_impl not in ("dense", "zeroskip", "zeroskip_fused"):
+            raise ValueError(
+                "upsample_impl must be 'dense', 'zeroskip' or "
+                f"'zeroskip_fused', got {self.upsample_impl!r}"
+            )
+        if (self.upsample_impl == "zeroskip_fused"
+                and self.instance_norm_impl == "xla"):
+            raise ValueError(
+                "upsample_impl='zeroskip_fused' embeds a Pallas instance "
+                "norm in the fused upsample kernel; "
+                "instance_norm_impl='xla' contradicts it — use 'auto' (or "
+                "'pallas'), or upsample_impl='zeroskip' for the pure-XLA "
+                "decomposition"
             )
         if self.trunk_impl == "perturb" and self.scan_blocks:
             raise ValueError(

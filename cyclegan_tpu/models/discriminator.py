@@ -26,6 +26,11 @@ class PatchGANDiscriminator(nn.Module):
     config: DiscriminatorConfig = DiscriminatorConfig()
     dtype: Optional[Any] = None
     norm_impl: str = "auto"
+    # "epilogue" fuses each trunk block's IN > LeakyReLU(0.2) tail into
+    # one op (the Pallas epilogue kernel where VMEM-eligible — every
+    # trunk slab is at the default 256^2 sizes). Same param tree as
+    # "pad"; numerics agree to fp tolerance.
+    pad_impl: str = "pad"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -59,6 +64,7 @@ class PatchGANDiscriminator(nn.Module):
                 activation=leaky,
                 dtype=self.dtype,
                 norm_impl=self.norm_impl,
+                fuse_epilogue=self.pad_impl == "epilogue",
             )(y)
 
         # Patch logits head (model.py:207-211): bias on, no activation

@@ -69,6 +69,11 @@ class ResNetGenerator(nn.Module):
     # DIFFERENT param tree (checkpoints record it via model_meta);
     # requires the unrolled trunk (per-block mask salts).
     trunk_impl: str = "resnet"
+    # Transposed-conv engine for the two upsample blocks (GANAX output
+    # decomposition — ops/upsample.py): "dense" | "zeroskip" |
+    # "zeroskip_fused". All three share one param tree (checkpoints
+    # interchange); model_meta records the setting for provenance.
+    upsample_impl: str = "dense"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -184,7 +189,8 @@ class ResNetGenerator(nn.Module):
             filters //= 2
             last = i == cfg.num_upsample_blocks - 1
             y = Upsample(filters, dtype=self.dtype, norm_impl=self.norm_impl,
-                         pad_after=tail_pad_after if last else 0)(y)
+                         pad_after=tail_pad_after if last else 0,
+                         upsample_impl=self.upsample_impl)(y)
 
         # Final block (model.py:164-167): bias on, tanh
         if tail_pad_after:

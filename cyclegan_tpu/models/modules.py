@@ -12,14 +12,16 @@ reference quirk reproduced deliberately), biases/betas zero.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from cyclegan_tpu.ops.norm import instance_norm, instance_norm_relu_pad
+from cyclegan_tpu.ops.norm import instance_norm, instance_norm_act_pad
 from cyclegan_tpu.ops.padding import reflect_conv, reflect_pad
+from cyclegan_tpu.ops.upsample import conv_transpose_up2, upsample_norm_relu_pad
 
 Dtype = Any
 
@@ -112,10 +114,12 @@ class InstanceNorm(nn.Module):
 
 
 class FusedNormReluPad(nn.Module):
-    """The residual-block epilogue as ONE op: instance-norm -> ReLU ->
-    reflect-pad(pad), emitting the consumer conv's padded input
-    directly (ops/norm.py:instance_norm_relu_pad — Pallas kernel when
-    the slab is VMEM-eligible, XLA composition otherwise).
+    """A conv epilogue as ONE op: instance-norm -> LeakyReLU(slope) ->
+    reflect-pad(pad), emitting the consumer's input directly
+    (ops/norm.py:instance_norm_act_pad — Pallas kernel when the slab is
+    VMEM-eligible, XLA composition otherwise). negative_slope=0.0 is
+    the residual-block ReLU form; 0.2 with pad=0 is the discriminator
+    trunk tail.
 
     Same "scale"/"bias" param names, shapes, and init as InstanceNorm,
     so a module given the name the unfused layout auto-assigns
@@ -126,14 +130,16 @@ class FusedNormReluPad(nn.Module):
     pad: int
     eps: float = 1e-3
     impl: str = "auto"
+    negative_slope: float = 0.0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         ch = x.shape[-1]
         scale = self.param("scale", init_normal, (ch,), jnp.float32)
         bias = self.param("bias", nn.initializers.zeros_init(), (ch,), jnp.float32)
-        return instance_norm_relu_pad(
-            x, scale, bias, pad=self.pad, eps=self.eps, impl=self.impl
+        return instance_norm_act_pad(
+            x, scale, bias, pad=self.pad, eps=self.eps, impl=self.impl,
+            negative_slope=self.negative_slope,
         )
 
 
@@ -260,21 +266,37 @@ class PerturbBlock(nn.Module):
         return x + y
 
 
-def _norm_act_epilogue(y, *, pad_after, norm_impl, activation):
+def _fusable_slope(activation) -> Optional[float]:
+    """LeakyReLU slope of an activation the fused epilogue can serve:
+    0.0 for nn.relu, the bound negative_slope for a
+    functools.partial(nn.leaky_relu, ...), None for anything else."""
+    if activation is nn.relu:
+        return 0.0
+    if (isinstance(activation, functools.partial)
+            and activation.func is nn.leaky_relu):
+        return float(activation.keywords.get("negative_slope", 0.01))
+    return None
+
+
+def _norm_act_epilogue(y, *, pad_after, norm_impl, activation, fuse=False):
     """Shared IN > activation tail of Downsample/Upsample. pad_after > 0
     fuses the chain into FusedNormReluPad (reflect-padded output for a
     downstream VALID conv — e.g. the generator's tail Conv7x7 consuming
-    the last upsample); the module is named "InstanceNorm_0", the name
-    the unfused layout auto-assigns, so the param tree is identical.
-    Only a ReLU epilogue has a fused form (the reference uses nothing
-    else before a pad site)."""
-    if pad_after:
-        if activation is not nn.relu:
+    the last upsample); fuse=True requests the same one-op form without
+    a pad (the discriminator's IN > LeakyReLU trunk tails), engaging
+    whenever the activation has a fused form (ReLU or a bound
+    leaky_relu — _fusable_slope) and quietly staying unfused otherwise.
+    Either way the module is named "InstanceNorm_0", the name the
+    unfused layout auto-assigns, so the param tree is identical."""
+    slope = _fusable_slope(activation)
+    if pad_after or (fuse and slope is not None):
+        if slope is None:
             raise ValueError(
-                "pad_after requires a ReLU epilogue (got "
-                f"{activation!r}); only IN>ReLU>reflect-pad has a fused form"
+                "pad_after requires a ReLU/LeakyReLU epilogue (got "
+                f"{activation!r}); only IN>act>reflect-pad has a fused form"
             )
         return FusedNormReluPad(pad=pad_after, impl=norm_impl,
+                                negative_slope=slope,
                                 name="InstanceNorm_0")(y)
     y = InstanceNorm(impl=norm_impl, name="InstanceNorm_0")(y)
     if activation is not None:
@@ -285,7 +307,9 @@ def _norm_act_epilogue(y, *, pad_after, norm_impl, activation):
 class Downsample(nn.Module):
     """Conv (stride 2 default, SAME, no bias) > IN > optional activation
     (reference model.py:77-100). pad_after > 0 fuses the IN > ReLU tail
-    with a reflect-pad of the output (see _norm_act_epilogue).
+    with a reflect-pad of the output; fuse_epilogue=True fuses an
+    unpadded IN > (Leaky)ReLU tail into one op (see _norm_act_epilogue)
+    — the discriminator's pad_impl="epilogue" trunk layout.
     """
 
     filters: int
@@ -295,6 +319,7 @@ class Downsample(nn.Module):
     dtype: Optional[Dtype] = None
     norm_impl: str = "auto"
     pad_after: int = 0
+    fuse_epilogue: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -309,8 +334,42 @@ class Downsample(nn.Module):
         )(x)
         return _norm_act_epilogue(
             y, pad_after=self.pad_after, norm_impl=self.norm_impl,
-            activation=self.activation,
+            activation=self.activation, fuse=self.fuse_epilogue,
         )
+
+
+class ZeroSkipKernel(nn.Module):
+    """Param holder for the zero-skip Upsample tiers: declares the SAME
+    "kernel" param — (3, 3, Cin, features), N(0, 0.02) init, float32 —
+    that nn.ConvTranspose would. Callers pin it to the name the dense
+    layout auto-assigns ("ConvTranspose_0"), so all three upsample_impl
+    tiers share one checkpoint tree and checkpoints interchange."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return self.param(
+            "kernel", init_normal, (3, 3, x.shape[-1], self.features),
+            jnp.float32,
+        )
+
+
+class NormParams(nn.Module):
+    """Param holder declaring InstanceNorm's "scale"/"bias" (same names,
+    shapes, init) without applying the op — for fused kernels that
+    consume the raw params. Callers pin it to the name the unfused
+    layout auto-assigns ("InstanceNorm_0")."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        scale = self.param("scale", init_normal, (self.features,), jnp.float32)
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (self.features,), jnp.float32
+        )
+        return scale, bias
 
 
 class Upsample(nn.Module):
@@ -321,6 +380,20 @@ class Upsample(nn.Module):
     output (see _norm_act_epilogue) — the generator uses it on the last
     upsample under pad_impl="epilogue" so the tail Conv7x7 consumes the
     padded slab VALID, with no materialized pad copy.
+
+    upsample_impl selects the transposed-conv engine (GANAX output
+    decomposition — ops/upsample.py):
+      "dense":          nn.ConvTranspose on the zero-dilated input (the
+                        parity reference; ~4x the live MACs).
+      "zeroskip":       four per-phase dense convs + depth-to-space
+                        interleave, pure XLA.
+      "zeroskip_fused": the Pallas kernel fusing phase convs > IN > ReLU
+                        (> reflect-pad) in one VMEM residency
+                        (ops/pallas/upsample_kernel.py), XLA zeroskip
+                        fallback where the slab is ineligible.
+    The zero-skip tiers require the default 3x3/stride-2 geometry and
+    declare the identical param tree via ZeroSkipKernel/NormParams, so
+    checkpoints interchange across all three.
     """
 
     filters: int
@@ -330,19 +403,53 @@ class Upsample(nn.Module):
     dtype: Optional[Dtype] = None
     norm_impl: str = "auto"
     pad_after: int = 0
+    upsample_impl: str = "dense"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        y = nn.ConvTranspose(
-            self.filters,
-            self.kernel_size,
-            strides=self.strides,
-            padding="SAME",
-            use_bias=False,
-            kernel_init=init_normal,
-            dtype=self.dtype,
-        )(x)
-        return _norm_act_epilogue(
-            y, pad_after=self.pad_after, norm_impl=self.norm_impl,
-            activation=self.activation,
+        if self.upsample_impl == "dense":
+            y = nn.ConvTranspose(
+                self.filters,
+                self.kernel_size,
+                strides=self.strides,
+                padding="SAME",
+                use_bias=False,
+                kernel_init=init_normal,
+                dtype=self.dtype,
+            )(x)
+            return _norm_act_epilogue(
+                y, pad_after=self.pad_after, norm_impl=self.norm_impl,
+                activation=self.activation,
+            )
+        if self.upsample_impl not in ("zeroskip", "zeroskip_fused"):
+            raise ValueError(
+                f"unknown upsample_impl {self.upsample_impl!r}"
+            )
+        if self.kernel_size != (3, 3) or self.strides != (2, 2):
+            raise ValueError(
+                "zero-skip upsampling is specialized to the reference "
+                "3x3/stride-2 geometry; got kernel_size="
+                f"{self.kernel_size}, strides={self.strides}"
+            )
+        kernel = ZeroSkipKernel(self.filters, name="ConvTranspose_0")(x)
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+            kernel = kernel.astype(self.dtype)
+        if self.upsample_impl == "zeroskip":
+            y = conv_transpose_up2(x, kernel, impl="zeroskip")
+            return _norm_act_epilogue(
+                y, pad_after=self.pad_after, norm_impl=self.norm_impl,
+                activation=self.activation,
+            )
+        # zeroskip_fused: the whole block — phase convs, IN, ReLU, and
+        # any trailing reflect-pad — is one op.
+        if self.activation is not nn.relu:
+            raise ValueError(
+                "upsample_impl='zeroskip_fused' requires the ReLU "
+                f"epilogue (got {self.activation!r})"
+            )
+        scale, bias = NormParams(self.filters, name="InstanceNorm_0")()
+        return upsample_norm_relu_pad(
+            x, kernel, scale, bias, pad=self.pad_after, eps=1e-3,
+            impl="zeroskip_fused",
         )

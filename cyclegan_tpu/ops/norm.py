@@ -160,28 +160,34 @@ def instance_norm(
     return _instance_norm_xla(x, scale, bias, eps)
 
 
-@functools.partial(jax.jit, static_argnames=("pad", "eps", "impl"))
-def instance_norm_relu_pad(
+@functools.partial(
+    jax.jit, static_argnames=("pad", "eps", "impl", "negative_slope")
+)
+def instance_norm_act_pad(
     x: jnp.ndarray,
     scale: jnp.ndarray,
     bias: jnp.ndarray,
     pad: int,
     eps: float = 1e-3,
     impl: str = "auto",
+    negative_slope: float = 0.0,
 ) -> jnp.ndarray:
-    """Fused residual-block epilogue: instance_norm -> ReLU ->
-    reflect-pad(pad), [N, H, W, C] -> [N, H+2p, W+2p, C].
+    """Fused conv epilogue: instance_norm -> LeakyReLU(negative_slope)
+    -> reflect-pad(pad), [N, H, W, C] -> [N, H+2p, W+2p, C].
 
-    The padded output is exactly tf.pad REFLECT over the ReLU'd norm
+    negative_slope=0.0 is the residual-block ReLU epilogue
+    (`instance_norm_relu_pad` below); 0.2 with pad=0 is the PatchGAN
+    discriminator's strided-trunk tail (models/discriminator.py). The
+    padded output is exactly tf.pad REFLECT over the activated norm
     (the reference's ReflectionPadding2D composition), so the consumer
     conv runs VALID on it. Unlike the standalone norm — where "auto"
     resolves to XLA because the norm fuses into its producer/consumer
-    HBM passes — the epilogue's whole point is the materialized pad
-    copy XLA cannot elide, so "auto" (and "pallas") dispatch to the
-    Pallas epilogue kernel whenever the slab is VMEM-eligible under the
-    input dtype (ops/pallas/epilogue_kernel.py; interpret mode
-    off-TPU). Ineligible shapes — e.g. the generator's outermost
-    layers — and impl="xla" compose the XLA reference path.
+    HBM passes — this dispatch exists for the chains XLA leaves
+    crossing HBM, so "auto" (and "pallas") dispatch to the Pallas
+    epilogue kernel whenever the slab is VMEM-eligible under the input
+    dtype (ops/pallas/epilogue_kernel.py; interpret mode off-TPU).
+    Ineligible shapes — e.g. the generator's outermost layers — and
+    impl="xla" compose the XLA reference path.
     """
     if impl != "xla":
         from cyclegan_tpu.ops.pallas.epilogue_kernel import (
@@ -192,8 +198,24 @@ def instance_norm_relu_pad(
         if epilogue_eligible(x.shape, x.dtype, pad):
             interpret = jax.default_backend() != "tpu"
             return instance_norm_relu_pad_pallas(
-                x, scale, bias, pad=pad, eps=eps, interpret=interpret
+                x, scale, bias, pad=pad, eps=eps,
+                negative_slope=negative_slope, interpret=interpret,
             )
     from cyclegan_tpu.ops.padding import reflect_pad
 
-    return reflect_pad(jax.nn.relu(_instance_norm_xla(x, scale, bias, eps)), pad)
+    y = _instance_norm_xla(x, scale, bias, eps)
+    y = jax.nn.leaky_relu(y, negative_slope) if negative_slope else jax.nn.relu(y)
+    return reflect_pad(y, pad) if pad else y
+
+
+def instance_norm_relu_pad(
+    x: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    pad: int,
+    eps: float = 1e-3,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """The residual-block epilogue: `instance_norm_act_pad` at the ReLU
+    slope (the only form the generator uses)."""
+    return instance_norm_act_pad(x, scale, bias, pad, eps=eps, impl=impl)

@@ -1,4 +1,4 @@
-"""Fused residual-block epilogue: instance-norm -> ReLU -> reflect-pad.
+"""Fused conv-epilogue: instance-norm -> (Leaky)ReLU -> reflect-pad.
 
 Motivation (docs/BENCHMARKS.md "what does reflection padding cost"): the
 22 materialized reflect-pads per generator apply are ~32% of the fused
@@ -29,6 +29,13 @@ BACKWARD's three slabs, so forward eligibility implies backward
 eligibility: true for the generator trunk at 256^2 input (64x64 slab,
 f32 or bf16), false for the outermost layers; ops/norm.py composes the
 XLA fallback (reflect_pad . relu . instance_norm) there.
+
+The activation generalizes to LeakyReLU via `negative_slope` (act =
+max(y, 0) + slope * min(y, 0), exactly ReLU at slope 0), and pad == 0
+degenerates the reflect stage to identity — together these serve the
+PatchGAN discriminator's IN->LeakyReLU(0.2) strided-trunk tails
+(models/discriminator.py, pad_impl="epilogue"), where the win is the
+single VMEM residency for the norm+activation, not a pad copy.
 """
 
 from __future__ import annotations
@@ -88,7 +95,7 @@ def _reflect_transpose_2d(g: jnp.ndarray, h: int, w: int, pad: int):
 
 
 def _fwd_kernel(x_ref, scale_ref, bias_ref, y_ref, mean_ref, inv_ref,
-                *, eps, pad):
+                *, eps, pad, slope):
     x = x_ref[0].astype(jnp.float32)  # [H, W, Cb]
     hw = x.shape[0] * x.shape[1]
     mean = jnp.sum(x, axis=(0, 1), keepdims=True) / hw  # [1, 1, Cb]
@@ -98,14 +105,15 @@ def _fwd_kernel(x_ref, scale_ref, bias_ref, y_ref, mean_ref, inv_ref,
     scale = scale_ref[0].astype(jnp.float32)  # [Cb]
     bias = bias_ref[0].astype(jnp.float32)
     y = centered * inv * scale[None, None, :] + bias[None, None, :]
-    y = jnp.maximum(y, 0.0)
+    # slope == 0.0 is exactly ReLU (0 * min(y, 0) == 0 for finite y).
+    y = jnp.maximum(y, 0.0) + slope * jnp.minimum(y, 0.0)
     y_ref[0] = _reflect_2d(y, pad).astype(y_ref.dtype)
     mean_ref[0] = mean[0]
     inv_ref[0] = inv[0]
 
 
 def _bwd_kernel(x_ref, scale_ref, bias_ref, g_ref, mean_ref, inv_ref,
-                dx_ref, dscale_ref, dbias_ref, *, pad):
+                dx_ref, dscale_ref, dbias_ref, *, pad, slope):
     x = x_ref[0].astype(jnp.float32)  # [H, W, Cb]
     h, w = x.shape[0], x.shape[1]
     hw = h * w
@@ -116,10 +124,11 @@ def _bwd_kernel(x_ref, scale_ref, bias_ref, g_ref, mean_ref, inv_ref,
     scale = scale_ref[0].astype(jnp.float32)  # [Cb]
     bias = bias_ref[0].astype(jnp.float32)
     xhat = (x - mean) * inv
-    # ReLU mask from the recomputed pre-ReLU output (cheap: the slab is
-    # already resident; saving the mask would cost another HBM tensor).
+    # Activation mask from the recomputed pre-activation output (cheap:
+    # the slab is already resident; saving the mask would cost another
+    # HBM tensor). slope == 0.0 is the ReLU mask.
     pre = xhat * scale[None, None, :] + bias[None, None, :]
-    g = jnp.where(pre > 0.0, g, 0.0)
+    g = jnp.where(pre > 0.0, g, slope * g)
     gsum = jnp.sum(g, axis=(0, 1), keepdims=True)  # [1, 1, Cb]
     gxsum = jnp.sum(g * xhat, axis=(0, 1), keepdims=True)
     dx = scale[None, None, :] * inv * (g - gsum / hw - xhat * (gxsum / hw))
@@ -128,13 +137,13 @@ def _bwd_kernel(x_ref, scale_ref, bias_ref, g_ref, mean_ref, inv_ref,
     dbias_ref[0] = gsum[0]
 
 
-def _forward(x, scale, bias, eps, pad, interpret):
+def _forward(x, scale, bias, eps, pad, slope, interpret):
     n, h, w, c = x.shape
     hp, wp = h + 2 * pad, w + 2 * pad
     c_blk = min(c, C_BLK)
     grid = (n, pl.cdiv(c, c_blk))
     y, mean, inv = pl.pallas_call(
-        functools.partial(_fwd_kernel, eps=eps, pad=pad),
+        functools.partial(_fwd_kernel, eps=eps, pad=pad, slope=slope),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, h, w, c_blk), lambda i, j: (i, 0, 0, j)),
@@ -159,13 +168,13 @@ def _forward(x, scale, bias, eps, pad, interpret):
     return y, mean, inv
 
 
-def _backward(x, scale, bias, mean, inv, g, pad, interpret):
+def _backward(x, scale, bias, mean, inv, g, pad, slope, interpret):
     n, h, w, c = x.shape
     hp, wp = h + 2 * pad, w + 2 * pad
     c_blk = min(c, C_BLK)
     grid = (n, pl.cdiv(c, c_blk))
     dx, dscale_nc, dbias_nc = pl.pallas_call(
-        functools.partial(_bwd_kernel, pad=pad),
+        functools.partial(_bwd_kernel, pad=pad, slope=slope),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, h, w, c_blk), lambda i, j: (i, 0, 0, j)),
@@ -192,23 +201,24 @@ def _backward(x, scale, bias, mean, inv, g, pad, interpret):
 
 
 @functools.lru_cache(maxsize=None)
-def _build(eps: float, pad: int, interpret: bool):
+def _build(eps: float, pad: int, slope: float, interpret: bool):
     @jax.custom_vjp
     def op(x, scale, bias):
-        y, _, _ = _forward(x, scale, bias, eps, pad, interpret)
+        y, _, _ = _forward(x, scale, bias, eps, pad, slope, interpret)
         return y
 
     def op_fwd(x, scale, bias):
-        y, mean, inv = _forward(x, scale, bias, eps, pad, interpret)
+        y, mean, inv = _forward(x, scale, bias, eps, pad, slope, interpret)
         # bias is saved (tiny [C]) so dbias comes back in bias's OWN
-        # dtype and the ReLU mask can be recomputed in the backward —
-        # same residual set as the norm paths plus nothing extra.
+        # dtype and the activation mask can be recomputed in the
+        # backward — same residual set as the norm paths plus nothing
+        # extra.
         return y, (x, scale, bias, mean, inv)
 
     def op_bwd(res, g):
         x, scale, bias, mean, inv = res
         dx, dscale_nc, dbias_nc = _backward(
-            x, scale, bias, mean, inv, g, pad, interpret)
+            x, scale, bias, mean, inv, g, pad, slope, interpret)
         dscale = jnp.sum(dscale_nc, axis=(0, 1)).astype(scale.dtype)
         dbias = jnp.sum(dbias_nc, axis=(0, 1)).astype(bias.dtype)
         return dx, dscale, dbias
@@ -223,14 +233,19 @@ def instance_norm_relu_pad_pallas(
     bias: jnp.ndarray,
     pad: int,
     eps: float = 1e-3,
+    negative_slope: float = 0.0,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Fused IN -> ReLU -> reflect-pad(pad): [N, H, W, C] ->
-    [N, H+2p, W+2p, C]. Raises NotImplementedError when the slab cannot
-    stay VMEM-resident (caller composes the XLA fallback)."""
+    """Fused IN -> LeakyReLU(negative_slope) -> reflect-pad(pad):
+    [N, H, W, C] -> [N, H+2p, W+2p, C]. negative_slope=0.0 is the exact
+    ReLU epilogue; pad=0 skips the pad stage (the discriminator form).
+    Raises NotImplementedError when the slab cannot stay VMEM-resident
+    (caller composes the XLA fallback)."""
     if not epilogue_eligible(x.shape, x.dtype, pad):
         raise NotImplementedError(
             f"shape {x.shape} dtype {x.dtype} pad {pad} exceeds the "
             f"epilogue slab budget ({vmem.EPILOGUE_BUDGET_BYTES} bytes)"
         )
-    return _build(float(eps), int(pad), bool(interpret))(x, scale, bias)
+    return _build(
+        float(eps), int(pad), float(negative_slope), bool(interpret)
+    )(x, scale, bias)

@@ -1,0 +1,218 @@
+"""Fused zero-skip upsample: phase convs -> IN -> ReLU (-> reflect-pad).
+
+The Pallas tier of the GANAX output decomposition (ops/upsample.py —
+the math and its derivation live there and in docs/DESIGN.md). The XLA
+zeroskip path already buys the ~4x MAC cut; what it cannot buy is the
+residency: XLA materializes the interleaved upsample output in HBM,
+reads it back for the instance-norm moments, and writes the activated
+(possibly padded) tensor again. This kernel computes the four phase
+convolutions as MXU dots over the resident input slab, interleaves
+in-register, and runs the whole Upsample-block epilogue — IN -> ReLU,
+plus the last-upsample reflect-pad(3) under pad_impl="epilogue" — in
+the SAME VMEM residency: one HBM read of the input, one write of the
+tensor the next layer consumes.
+
+Layout: grid (N, C_out/C_BLK), channels on lanes. The input block
+carries ALL C_in channels (every output-channel block consumes every
+input channel) and is constant in the channel grid index; the kernel
+block slices C_out. Stats are float32 [N, 1, C] slivers, mirroring
+epilogue_kernel. The interleave is stack+reshape on the non-lane dims
+(channels never move lanes) — no gathers, no dynamic slicing.
+
+Backward: custom VJP composed in XLA, not a second Pallas kernel. The
+pullback's heavy terms are the transposed phase convolutions for dx and
+the weight gradients for dkernel — exactly the conv emitters XLA is
+best at — while the forward's win (the epilogue residency) has no
+backward counterpart: the cotangent arrives from HBM regardless. One
+`jax.vjp` through the zeroskip forward provides the recompute AND the
+pullback; the activation mask and IN backward reuse the shared math in
+ops/norm.py. This also keeps the kernel interpret-mode testable
+end-to-end on CPU (tests/test_zeroskip.py).
+
+Eligibility (ops/pallas/vmem.py upsample_fits) is sized by the
+FORWARD's residents — input slab, kernel block, four phase results,
+padded output. At the default 256^2 bf16 generator the first upsample
+(64^2, 256ch) is eligible and the second (128^2, 128ch) is not;
+ops/upsample.py composes the XLA fallback there, so a zeroskip_fused
+run exercises both tiers every step by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from cyclegan_tpu.ops.pallas import vmem
+from cyclegan_tpu.ops.pallas.epilogue_kernel import (
+    _reflect_2d,
+    _reflect_transpose_2d,
+)
+
+C_BLK = vmem.C_BLK
+
+
+def upsample_eligible(shape: Tuple[int, ...], dtype, pad: int) -> bool:
+    """True if an [N, H, W, C_in] input can run the fused zero-skip
+    upsample kernel: the forward's residents (vmem.upsample_bytes) must
+    fit the budget under the ACTUAL input itemsize."""
+    if len(shape) != 4:
+        return False
+    _, h, w, c_in = shape
+    return vmem.upsample_fits(h, w, c_in, int(pad), np.dtype(dtype).itemsize)
+
+
+def _fwd_kernel(x_ref, k_ref, scale_ref, bias_ref, y_ref, mean_ref, inv_ref,
+                *, eps, pad):
+    x = x_ref[0]  # [H, W, Cin], activation dtype
+    h, w, cin = x.shape
+    cb = k_ref.shape[-1]
+    # Leading zero row/col realizes the x[-1] boundary taps
+    # (ops/upsample.py derivation). Concatenate, not jnp.pad — the
+    # static-concat form is what Mosaic lowers well (pallas guide).
+    zrow = jnp.zeros((1, w, cin), x.dtype)
+    zcol = jnp.zeros((h + 1, 1, cin), x.dtype)
+    xp = jnp.concatenate([zcol, jnp.concatenate([zrow, x], axis=0)], axis=1)
+
+    def tap(slab, a, b):
+        """[h, w, Cin] slab (.) K[a, b] -> [h*w, cb] f32 MXU dot."""
+        return jax.lax.dot_general(
+            slab.reshape(h * w, cin), k_ref[a, b],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    # Four output phases from disjoint sub-kernels; offsets into the
+    # zero-extended slab select x[p-1]/x[p] taps (all static slices).
+    ee = tap(xp[0:h, 0:w], 0, 0) + tap(xp[0:h, 1:1 + w], 0, 2) \
+        + tap(xp[1:1 + h, 0:w], 2, 0) + tap(xp[1:1 + h, 1:1 + w], 2, 2)
+    eo = tap(xp[0:h, 1:1 + w], 0, 1) + tap(xp[1:1 + h, 1:1 + w], 2, 1)
+    oe = tap(xp[1:1 + h, 0:w], 1, 0) + tap(xp[1:1 + h, 1:1 + w], 1, 2)
+    oo = tap(xp[1:1 + h, 1:1 + w], 1, 1)
+    # Cast phases back to the activation dtype BEFORE the stats, so the
+    # fused path sees exactly what the unfused zeroskip path's conv
+    # output would be (bf16 under mixed precision) — parity across
+    # tiers, and half the accumulator residency (vmem.upsample_bytes).
+    phases = [p.reshape(h, w, cb).astype(x.dtype) for p in (ee, eo, oe, oo)]
+    ee, eo, oe, oo = phases
+    # Depth-to-space interleave on the non-lane dims:
+    # rows of even output parity hold [ee|eo] column-interleaved, odd
+    # parity [oe|oo]; then row-interleave the two.
+    even_rows = jnp.stack([ee, eo], axis=2).reshape(h, 2 * w, cb)
+    odd_rows = jnp.stack([oe, oo], axis=2).reshape(h, 2 * w, cb)
+    y = jnp.stack([even_rows, odd_rows], axis=1).reshape(2 * h, 2 * w, cb)
+
+    yf = y.astype(jnp.float32)
+    hw = 4 * h * w
+    mean = jnp.sum(yf, axis=(0, 1), keepdims=True) / hw  # [1, 1, cb]
+    centered = yf - mean
+    var = jnp.sum(centered * centered, axis=(0, 1), keepdims=True) / hw
+    inv = jax.lax.rsqrt(var + eps)
+    scale = scale_ref[0].astype(jnp.float32)
+    bias = bias_ref[0].astype(jnp.float32)
+    out = centered * inv * scale[None, None, :] + bias[None, None, :]
+    out = jnp.maximum(out, 0.0)
+    y_ref[0] = _reflect_2d(out, pad).astype(y_ref.dtype)
+    mean_ref[0] = mean[0]
+    inv_ref[0] = inv[0]
+
+
+def _forward(x, kernel, scale, bias, eps, pad, interpret):
+    n, h, w, cin = x.shape
+    cout = kernel.shape[-1]
+    hp, wp = 2 * h + 2 * pad, 2 * w + 2 * pad
+    c_blk = min(cout, C_BLK)
+    grid = (n, pl.cdiv(cout, c_blk))
+    y, mean, inv = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps, pad=pad),
+        grid=grid,
+        in_specs=[
+            # Full input slab, constant in the output-channel index.
+            pl.BlockSpec((1, h, w, cin), lambda i, j: (i, 0, 0, 0)),
+            pl.BlockSpec((3, 3, cin, c_blk), lambda i, j: (0, 0, 0, j)),
+            pl.BlockSpec((1, c_blk), lambda i, j: (0, j)),
+            pl.BlockSpec((1, c_blk), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, hp, wp, c_blk), lambda i, j: (i, 0, 0, j)),
+            pl.BlockSpec((1, 1, c_blk), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, c_blk), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, hp, wp, cout), x.dtype),
+            jax.ShapeDtypeStruct((n, 1, cout), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1, cout), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, kernel, scale.reshape(1, cout), bias.reshape(1, cout))
+    return y, mean, inv
+
+
+@functools.lru_cache(maxsize=None)
+def _build(eps: float, pad: int, interpret: bool):
+    @jax.custom_vjp
+    def op(x, kernel, scale, bias):
+        y, _, _ = _forward(x, kernel, scale, bias, eps, pad, interpret)
+        return y
+
+    def op_fwd(x, kernel, scale, bias):
+        y, mean, inv = _forward(x, kernel, scale, bias, eps, pad, interpret)
+        # Residuals mirror the norm paths: inputs + tiny f32 stats. The
+        # conv output is NOT saved — the backward recomputes it through
+        # jax.vjp, which also provides the pullback for dx/dkernel.
+        return y, (x, kernel, scale, bias, mean, inv)
+
+    def op_bwd(res, g):
+        from cyclegan_tpu.ops.norm import instance_norm_backward
+        from cyclegan_tpu.ops.upsample import conv_transpose_zeroskip
+
+        x, kernel, scale, bias, mean, inv = res
+        n, h, w, _ = x.shape
+        c = kernel.shape[-1]
+        if pad:
+            g = jax.vmap(
+                functools.partial(
+                    _reflect_transpose_2d, h=2 * h, w=2 * w, pad=pad
+                )
+            )(g)
+        conv, pull = jax.vjp(conv_transpose_zeroskip, x, kernel)
+        mean_b = mean.reshape(n, 1, 1, c)
+        inv_b = inv.reshape(n, 1, 1, c)
+        # ReLU mask from the recomputed pre-activation (saved stats make
+        # this one fused elementwise pass over the recomputed conv).
+        pre = (conv.astype(jnp.float32) - mean_b) * inv_b \
+            * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+        g = jnp.where(pre > 0.0, g, jnp.zeros((), g.dtype))
+        dconv, dscale, dbias = instance_norm_backward(
+            conv, scale, mean_b, inv_b, g, bias.dtype
+        )
+        dx, dkernel = pull(dconv)
+        return dx, dkernel, dscale, dbias
+
+    op.defvjp(op_fwd, op_bwd)
+    return op
+
+
+def upsample_norm_relu_pad_pallas(
+    x: jnp.ndarray,
+    kernel: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    pad: int = 0,
+    eps: float = 1e-3,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused zero-skip upsample -> IN -> ReLU -> reflect-pad(pad):
+    [N, H, W, Cin] x [3, 3, Cin, Cout] -> [N, 2H+2p, 2W+2p, Cout].
+    Raises NotImplementedError when the forward's residents cannot stay
+    in VMEM (caller composes the XLA zeroskip fallback)."""
+    if not upsample_eligible(x.shape, x.dtype, pad):
+        raise NotImplementedError(
+            f"shape {x.shape} dtype {x.dtype} pad {pad} exceeds the "
+            f"upsample slab budget ({vmem.UPSAMPLE_BUDGET_BYTES} bytes)"
+        )
+    return _build(float(eps), int(pad), bool(interpret))(x, kernel, scale, bias)

@@ -17,6 +17,11 @@ headroom for that plus register spill:
 - epilogue fwd/bwd: x + padded-out (+ dx) slabs       -> 12 MB budget
   (the backward is the worst case — x [HW], padded cotangent [HpWp],
   and dx [HW] — and gates eligibility so fwd and bwd always agree)
+- zero-skip upsample: x slab (full C_in) + 3x3 kernel block + the four
+  phase accumulators + the padded doubled-resolution output -> 12 MB
+  budget (the fused kernel's backward runs in XLA, so the FORWARD's
+  residents are what the budget sizes — see
+  ops/pallas/upsample_kernel.py)
 
 The original norm budget assumed 4 B/element even for bfloat16 inputs;
 these helpers take the actual itemsize, which doubles the eligible H*W
@@ -31,6 +36,7 @@ C_BLK = 128  # channel tile = TPU lane width
 NORM_FWD_BUDGET_BYTES = 8 * 1024 * 1024
 NORM_BWD_BUDGET_BYTES = 12 * 1024 * 1024
 EPILOGUE_BUDGET_BYTES = 12 * 1024 * 1024
+UPSAMPLE_BUDGET_BYTES = 12 * 1024 * 1024
 
 _ITEMSIZE_BY_NAME = {
     "float32": 4,
@@ -70,9 +76,38 @@ def epilogue_bytes(h: int, w: int, pad: int, itemsize: int) -> int:
 
 
 def epilogue_fits(h: int, w: int, pad: int, itemsize: int) -> bool:
-    """Whether [*, h, w, *] can run the fused epilogue kernel. Also
+    """Whether [*, h, w, *] can run the fused epilogue kernel. pad == 0
+    is the discriminator's IN->LeakyReLU fusion (no pad stage — the
+    reflect slices degenerate to identity); pad > 0 additionally
     enforces the reflect constraint pad < min(h, w) (tf.pad REFLECT
     taps up to `pad` interior rows/cols past each border)."""
-    if pad < 1 or min(h, w) <= pad:
+    if pad < 0 or min(h, w) < 1 or (pad and min(h, w) <= pad):
         return False
     return epilogue_bytes(h, w, pad, itemsize) <= EPILOGUE_BUDGET_BYTES
+
+
+def upsample_bytes(h: int, w: int, c_in: int, pad: int, itemsize: int) -> int:
+    """Resident bytes per grid step for the fused zero-skip upsample
+    (ops/pallas/upsample_kernel.py), grid (N, C_out/C_BLK): the
+    zero-extended input slab carrying ALL input channels (every C_out
+    block consumes every C_in), the 3x3 kernel block, the four phase
+    results (cast to the activation dtype — together one unpadded
+    doubled-resolution slab), and the padded interleaved output. The
+    f32 stats slivers are negligible."""
+    x_slab = (h + 1) * (w + 1) * c_in
+    kernel = 9 * c_in * C_BLK
+    phases = 4 * h * w * C_BLK
+    out_padded = (2 * h + 2 * pad) * (2 * w + 2 * pad) * C_BLK
+    return (x_slab + kernel + phases + out_padded) * itemsize
+
+
+def upsample_fits(h: int, w: int, c_in: int, pad: int, itemsize: int) -> bool:
+    """Whether a [*, h, w, c_in] input can run the fused zero-skip
+    upsample kernel. The reflect constraint applies to the DOUBLED
+    output resolution (the pad stage runs after the interleave). At the
+    default 256^2 bf16 generator the first upsample (64^2, 256ch) fits
+    and the second (128^2, 128ch) does not — the XLA zeroskip fallback
+    covers it (ops/upsample.py)."""
+    if min(h, w) < 1 or pad < 0 or (pad and min(2 * h, 2 * w) <= pad):
+        return False
+    return upsample_bytes(h, w, c_in, pad, itemsize) <= UPSAMPLE_BUDGET_BYTES

@@ -1,0 +1,150 @@
+"""Zero-skip stride-2 transposed convolution (GANAX output decomposition).
+
+The generator's two Upsample blocks are 3x3/s2/SAME `nn.ConvTranspose`
+layers, which XLA lowers as an lhs-dilated convolution: the input is
+expanded with inserted zeros (dilation 2) and the full 3x3 kernel slides
+over the expanded tensor. Three quarters of those MACs multiply inserted
+zeros. GANAX (PAPERS.md, arXiv:1806.01107 §3) decomposes the OUTPUT by
+phase instead: with stride 2 each output pixel's row/col parity fixes
+which kernel taps can ever see a real input value, so the transposed
+conv splits into 4 dense sub-kernel convolutions on the UNexpanded
+input whose results interleave (depth-to-space) into the doubled-
+resolution output — the exact same sums, ~4x fewer MACs.
+
+Derivation (docs/DESIGN.md §zero-skip output decomposition). Flax
+`nn.ConvTranspose((3,3), strides=(2,2), padding="SAME")` is
+`conv_general_dilated(lhs_dilation=2, padding=(2,1))` per spatial dim
+with NO kernel flip, so in 1-D with output index o and kernel K[0..2]:
+
+  out[o] = sum_j K[j] * dilated[o + j - 2],   dilated[2t] = x[t]
+
+  even o = 2p:  K[0]*x[p-1] + K[2]*x[p]        (x[-1] = 0)
+  odd  o = 2p+1:                K[1]*x[p]
+
+In 2-D the four (row, col) parity phases use disjoint sub-kernels:
+
+  ee (even,even): 2x2 kernel K[{0,2},{0,2}]  taps x[p-1..p, q-1..q]
+  eo (even,odd):  2x1 kernel K[{0,2},  1  ]  taps x[p-1..p, q]
+  oe (odd, even): 1x2 kernel K[  1 ,{0,2}]   taps x[p,      q-1..q]
+  oo (odd, odd):  1x1 kernel K[  1 ,  1  ]   taps x[p,      q]
+
+The x[-1] boundary is one leading zero row/col, so every phase is a
+plain VALID convolution — dense, MXU-shaped, no gathers. Adding exact
+zeros is IEEE-exact; the only numerical difference from the dilated
+form is channel-reduction order, hence the 1e-5 f32 parity target
+(tests/test_zeroskip.py), not bitwise equality.
+
+Two dispatch tiers mirroring ops/norm.py:
+- "zeroskip": the pure-XLA decomposition below — works on every
+  backend, gradients via plain autodiff through the 4 convs.
+- "zeroskip_fused": ops/pallas/upsample_kernel.py fuses the phase
+  convs with the Upsample block's IN->ReLU (and last-upsample
+  reflect-pad) epilogue in one VMEM residency, eligibility-gated by
+  ops/pallas/vmem.py with this module's XLA path as fallback.
+
+Both consume the SAME (3, 3, C_in, C_out) kernel parameter
+nn.ConvTranspose declares, so checkpoints interchange across impls
+(models/modules.py pins the module names).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def conv_transpose_up2_dense(x: jnp.ndarray, kernel: jnp.ndarray) -> jnp.ndarray:
+    """Reference path: flax/TF Conv2DTranspose SAME semantics, 3x3/s2.
+    [N, H, W, Cin] x [3, 3, Cin, Cout] -> [N, 2H, 2W, Cout]."""
+    return jax.lax.conv_transpose(
+        x, kernel, strides=(2, 2), padding="SAME", dimension_numbers=_DIMS
+    )
+
+
+def conv_transpose_zeroskip(x: jnp.ndarray, kernel: jnp.ndarray) -> jnp.ndarray:
+    """The 4-phase zero-skip rewrite of `conv_transpose_up2_dense`:
+    identical math (module docstring), ~4x fewer MACs — every conv below
+    runs on the unexpanded [H, W] grid.
+
+    Works for any H, W >= 1 (odd sizes included: SAME/s2 output is
+    exactly (2H, 2W) regardless of parity).
+    """
+    n, h, w, _ = x.shape
+    cout = kernel.shape[-1]
+    # One leading zero row/col realizes the x[-1] = 0 boundary taps.
+    xp = jnp.pad(x, ((0, 0), (1, 0), (1, 0), (0, 0)))
+    conv = functools.partial(
+        jax.lax.conv_general_dilated,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=_DIMS,
+    )
+    ee = conv(xp, kernel[0::2, 0::2])          # [h+1, w+1] (*) 2x2 -> [h, w]
+    eo = conv(xp[:, :, 1:], kernel[0::2, 1:2])  # [h+1, w  ] (*) 2x1 -> [h, w]
+    oe = conv(xp[:, 1:, :], kernel[1:2, 0::2])  # [h,   w+1] (*) 1x2 -> [h, w]
+    oo = conv(x, kernel[1:2, 1:2])              # [h,   w  ] (*) 1x1 -> [h, w]
+    # Depth-to-space interleave: out[n, 2p+r, 2q+s, c] = phase[r][s][n, p, q, c].
+    y = jnp.stack([ee, eo, oe, oo], axis=-1).reshape(n, h, w, cout, 2, 2)
+    return jnp.transpose(y, (0, 1, 4, 2, 5, 3)).reshape(n, 2 * h, 2 * w, cout)
+
+
+@functools.partial(jax.jit, static_argnames=("impl",))
+def conv_transpose_up2(
+    x: jnp.ndarray, kernel: jnp.ndarray, impl: str = "dense"
+) -> jnp.ndarray:
+    """Stride-2 3x3 SAME transposed conv, impl-dispatched.
+
+    impl: "dense" = lhs-dilated conv (the nn.ConvTranspose lowering);
+    "zeroskip" = the 4-phase decomposition (same result to fp
+    tolerance, ~4x fewer MACs). The fused tier has its own entry
+    (`upsample_norm_relu_pad`) because it consumes the norm params too.
+    """
+    if impl == "zeroskip":
+        return conv_transpose_zeroskip(x, kernel)
+    return conv_transpose_up2_dense(x, kernel)
+
+
+@functools.partial(jax.jit, static_argnames=("pad", "eps", "impl"))
+def upsample_norm_relu_pad(
+    x: jnp.ndarray,
+    kernel: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    pad: int = 0,
+    eps: float = 1e-3,
+    impl: str = "zeroskip",
+) -> jnp.ndarray:
+    """The whole Upsample-block compute as one op: zero-skip upsample ->
+    instance-norm -> ReLU (-> reflect-pad(pad) when pad > 0, the
+    pad_impl="epilogue" last-upsample form). [N, H, W, Cin] ->
+    [N, 2H+2p, 2W+2p, Cout].
+
+    impl="zeroskip_fused" dispatches to the Pallas kernel
+    (ops/pallas/upsample_kernel.py — phase convs + epilogue in one VMEM
+    residency, custom VJP) whenever the slab is VMEM-eligible under the
+    input dtype, in interpret mode off-TPU; everything else — including
+    ineligible shapes, by design the SECOND upsample at 256^2 — composes
+    the XLA zeroskip path with ops/norm.py, so the fallback is exercised
+    in every full-generator run, not just in tests.
+    """
+    if impl == "zeroskip_fused":
+        from cyclegan_tpu.ops.pallas.upsample_kernel import (
+            upsample_eligible,
+            upsample_norm_relu_pad_pallas,
+        )
+
+        if upsample_eligible(x.shape, x.dtype, pad):
+            interpret = jax.default_backend() != "tpu"
+            return upsample_norm_relu_pad_pallas(
+                x, kernel, scale, bias, pad=pad, eps=eps, interpret=interpret
+            )
+    from cyclegan_tpu.ops.norm import instance_norm, instance_norm_relu_pad
+
+    y = conv_transpose_zeroskip(x, kernel)
+    if pad:
+        return instance_norm_relu_pad(y, scale, bias, pad=pad, eps=eps)
+    return jax.nn.relu(instance_norm(y, scale, bias, eps=eps))

@@ -83,6 +83,7 @@ def build_generator(model_cfg):
         pad_mode=model_cfg.pad_mode,
         pad_impl=model_cfg.pad_impl,
         trunk_impl=model_cfg.trunk_impl,
+        upsample_impl=model_cfg.upsample_impl,
     )
 
 
@@ -486,14 +487,16 @@ def preprocess_request(img: np.ndarray, size: int) -> np.ndarray:
     return preprocess_test(np.asarray(img), size)
 
 
-def serve_model_config(dtype: str = "float32", image: int = 256):
+def serve_model_config(dtype: str = "float32", image: int = 256,
+                       upsample_impl: str = "dense"):
     """Default-architecture ModelConfig for serve program identity —
     shared with tools/cache_warm.py (the bench._config_for contract):
     what cache_warm warms must be byte-for-byte what bench_serve.py and
     a default checkpoint's engine request."""
     from cyclegan_tpu.config import ModelConfig
 
-    return ModelConfig(compute_dtype=dtype, image_size=image)
+    return ModelConfig(compute_dtype=dtype, image_size=image,
+                       upsample_impl=upsample_impl)
 
 
 def param_specs(model_cfg, sizes: Sequence[int]):

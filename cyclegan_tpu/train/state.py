@@ -68,9 +68,11 @@ def build_models(
         pad_mode=m.pad_mode,
         pad_impl=m.pad_impl,
         trunk_impl=m.trunk_impl,
+        upsample_impl=m.upsample_impl,
     )
     disc = PatchGANDiscriminator(
-        config=m.discriminator, dtype=dtype, norm_impl=m.instance_norm_impl
+        config=m.discriminator, dtype=dtype, norm_impl=m.instance_norm_impl,
+        pad_impl=m.pad_impl if m.pad_impl == "epilogue" else "pad",
     )
     return gen, disc
 

@@ -61,16 +61,25 @@ def generator_layers(
     in_channels: int = 3,
     out_channels: int = 3,
     trunk_impl: str = "resnet",
+    upsample_impl: str = "dense",
 ) -> List[_Layer]:
     """Conv shapes of ResNetGenerator (models/generator.py:57-134).
 
     trunk_impl="perturb" swaps each residual block's two 3x3 convs for
     the PerturbBlock 1x1 convs (the fixed-mask add and ReLU are
     bandwidth-bound, like norms — not counted).
+
+    upsample_impl selects the transposed-conv MAC model (ops/upsample.py):
+    "dense" counts what nn.ConvTranspose EXECUTES — a full 3x3 window
+    per OUTPUT pixel over the zero-dilated input, 3/4 of whose taps land
+    on inserted zeros — i.e. out_h*out_w*c_in*c_out*9. "zeroskip" /
+    "zeroskip_fused" count only the live taps the phase decomposition
+    performs: in_h*in_w*c_in*c_out*9, a 4x cut per upsample.
     """
     s = image_size
     f = filters
     trunk_k = 1 if trunk_impl == "perturb" else 3
+    up_mult = 1 if upsample_impl in ("zeroskip", "zeroskip_fused") else 2
     layers: List[_Layer] = [(s, s, in_channels, f, 7, 7)]  # c7s1, reflect+valid
     for _ in range(num_downsampling_blocks):  # Conv3x3 s2 SAME
         s //= 2
@@ -80,10 +89,10 @@ def generator_layers(
         layers.append((s, s, f, f, trunk_k, trunk_k))
         layers.append((s, s, f, f, trunk_k, trunk_k))
     for _ in range(num_upsample_blocks):
-        # ConvTranspose 3x3 s2: each INPUT pixel multiplies the full
-        # kernel, so MACs = in_h*in_w*c_in*c_out*k*k; record via output
-        # dims scaled back (out = 2*in).
-        layers.append((s, s, f, f // 2, 3, 3))
+        # ConvTranspose 3x3 s2. zeroskip: 9 live taps per INPUT pixel
+        # (in_h*in_w grid). dense: 9 taps per OUTPUT pixel of the
+        # zero-dilated conv ((2*in_h)*(2*in_w) grid) — 4x the MACs.
+        layers.append((up_mult * s, up_mult * s, f, f // 2, 3, 3))
         s *= 2
         f //= 2
     layers.append((s, s, f, out_channels, 7, 7))
@@ -120,6 +129,7 @@ def generator_fwd_flops(config: Config) -> int:
             num_downsampling_blocks=g.num_downsampling_blocks,
             num_upsample_blocks=g.num_upsample_blocks,
             trunk_impl=config.model.trunk_impl,
+            upsample_impl=config.model.upsample_impl,
         )
     )
 

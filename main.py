@@ -120,6 +120,7 @@ def main(args: argparse.Namespace) -> None:
             instance_norm_impl=args.norm_impl,
             image_size=args.image_size,
             trunk_impl=args.trunk_impl,
+            upsample_impl=args.upsample_impl,
         ),
         data=data_cfg,
         parallel=ParallelConfig(spatial_parallelism=args.spatial_parallelism),
@@ -730,6 +731,21 @@ if __name__ == "__main__":
                              "quality-gated by the health monitor + "
                              "run_compare rather than parity-pinned; "
                              "requires the unrolled trunk (no --scan_blocks)")
+    parser.add_argument("--upsample_impl", default="dense",
+                        choices=["dense", "zeroskip", "zeroskip_fused"],
+                        help="generator transposed-conv engine (GANAX "
+                             "output decomposition, ops/upsample.py): "
+                             "'dense' is nn.ConvTranspose on the "
+                             "zero-dilated input (parity baseline); "
+                             "'zeroskip' computes only the live taps — "
+                             "four per-phase 'dense' convs + depth-to-space "
+                             "interleave, ~4x fewer upsample MACs, same "
+                             "results to fp tolerance; 'zeroskip_fused' "
+                             "runs the phase convs + IN + ReLU (+ trailing "
+                             "reflect-pad) as ONE Pallas kernel where "
+                             "VMEM-eligible, XLA zeroskip elsewhere "
+                             "(incompatible with --norm_impl xla). "
+                             "Checkpoints interchange across all values",)
     parser.add_argument("--norm_impl", default="auto",
                         choices=["auto", "xla", "pallas"],
                         help="instance-norm implementation: 'auto' resolves "

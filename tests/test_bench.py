@@ -144,6 +144,25 @@ def test_config_key_format():
         {"mode": "steps", "dtype": "float32", "batch": 1,
          "grad_impl": "combined", "trunk_impl": "resnet"}
     ) == "steps/float32/b1"
+    # upsample_impl segments: default adds nothing, zeroskip -> /zskip,
+    # zeroskip_fused -> /zskipf (both headline-eligible parity tiers;
+    # run_compare pairs them against the matching dense rows)
+    assert bench._config_key(
+        {"mode": "steps", "dtype": "float32", "batch": 1,
+         "upsample_impl": "dense"}
+    ) == "steps/float32/b1"
+    assert bench._config_key(
+        {"mode": "steps", "dtype": "float32", "batch": 1,
+         "upsample_impl": "zeroskip"}
+    ) == "steps/float32/b1/zskip"
+    assert bench._config_key(
+        {"mode": "scan", "dtype": "bfloat16", "batch": 16,
+         "upsample_impl": "zeroskip_fused"}
+    ) == "scan/bfloat16/b16/zskipf"
+    assert bench._config_key(
+        {"mode": "scan", "dtype": "bfloat16", "batch": 16,
+         "grad_impl": "fusedprop", "upsample_impl": "zeroskip"}
+    ) == "scan/bfloat16/b16/fusedprop/zskip"
 
 
 def test_emit_headline_excludes_perturb_rows(capsys):
@@ -212,7 +231,7 @@ def test_bench_dispatch_smoke(monkeypatch):
 
     def fake_build(dtype, batch, image, norm, pad_mode="reflect",
                    pad_impl="pad", grad_impl="combined",
-                   trunk_impl="resnet"):
+                   trunk_impl="resnet", upsample_impl="dense"):
         state = jnp.zeros(())
 
         def step_fn(st, x, y, w):

@@ -56,8 +56,8 @@ def test_queue_order_and_budgets():
     # lever, 512^2 rows, the serving sweep, trace, e2e run.
     assert names == ["graftlint", "diag", "bench_cold", "bench_warm",
                      "pad_sweep", "epilogue_sweep", "grad_sweep",
-                     "accum512", "scan512", "serve_sweep", "trace",
-                     "chaos_drill", "timed_main"]
+                     "upsample_sweep", "accum512", "scan512",
+                     "serve_sweep", "trace", "chaos_drill", "timed_main"]
     by = {s.name: s for s in q}
     assert by["diag"].abort_queue_on_fail  # diag failing = relay sick
     # lint failing = known bug class in the code about to burn the
@@ -96,7 +96,7 @@ def test_local_compile_mode_sets_env_on_every_step():
         assert s.env["PALLAS_AXON_POOL_IPS"] == ""
         assert s.env["CYCLEGAN_AXON_LOCAL_COMPILE"] == "1"
     for s in build_queue("remote"):
-        if s.name == "epilogue_sweep":
+        if s.name in ("epilogue_sweep", "upsample_sweep"):
             continue  # deliberately local-compile in BOTH modes (below)
         assert "CYCLEGAN_AXON_LOCAL_COMPILE" not in s.env
 
@@ -110,6 +110,18 @@ def test_epilogue_sweep_always_forces_local_compile():
         assert s.env["CYCLEGAN_AXON_LOCAL_COMPILE"] == "1"
         assert s.env["PALLAS_AXON_POOL_IPS"] == ""
         assert "scan:b16epi" in s.argv
+
+
+def test_upsample_sweep_always_forces_local_compile():
+    """The zeroskip_fused row is a Mosaic program like the epilogue
+    (ground rule 2b): the upsample_sweep step pins local compile in
+    BOTH modes and carries the zs/zsf/fpzs grid."""
+    for mode in ("remote", "local_compile"):
+        s = {st.name: st for st in build_queue(mode)}["upsample_sweep"]
+        assert s.env["CYCLEGAN_AXON_LOCAL_COMPILE"] == "1"
+        assert s.env["PALLAS_AXON_POOL_IPS"] == ""
+        for spec in ("scan:b16zs", "scan:b16zsf", "scan:b16fpzs"):
+            assert spec in s.argv
 
 
 def test_serve_sweep_keeps_the_one_json_line_contract():

@@ -123,9 +123,10 @@ def test_accum_spec_routes_to_bench_accum(tmp_path, monkeypatch):
     stub = types.ModuleType("bench")
 
     def fake_accum(dtype, micro, image, accum, norm_impl, pad_mode,
-                   pad_impl, grad_impl, trunk_impl):
+                   pad_impl, grad_impl, trunk_impl, upsample_impl):
         calls.update(micro=micro, image=image, accum=accum,
-                     pad_mode=pad_mode, grad_impl=grad_impl)
+                     pad_mode=pad_mode, grad_impl=grad_impl,
+                     upsample_impl=upsample_impl)
         return 12.34
 
     stub.bench_accum = fake_accum
@@ -135,7 +136,8 @@ def test_accum_spec_routes_to_bench_accum(tmp_path, monkeypatch):
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     chip_sweep.run_spec("accum:b2k4zeroi512")
     assert calls == {"micro": 2, "image": 512, "accum": 4,
-                     "pad_mode": "zero", "grad_impl": "combined"}
+                     "pad_mode": "zero", "grad_impl": "combined",
+                     "upsample_impl": "dense"}
     rows = json.loads((tmp_path / "rec.json").read_text())
     assert rows[0]["key"] == "accum:b2k4zeroi512"
     assert rows[0]["img_per_sec"] == 12.34
@@ -218,75 +220,90 @@ def test_corrupt_record_aborts_before_measuring(tmp_path):
 
 @pytest.mark.parametrize("spec,expect", [
     ("scan:b8",
-     ("scan", 8, 8, False, "reflect", "pad", "combined", "resnet",
+     ("scan", 8, 8, False, "reflect", "pad", "combined", "resnet", "dense",
       False, 256)),
     ("scan:b16k16",
-     ("scan", 16, 16, False, "reflect", "pad", "combined", "resnet",
+     ("scan", 16, 16, False, "reflect", "pad", "combined", "resnet", "dense",
       False, 256)),
     ("dispatch:b16",
-     ("dispatch", 16, 1, False, "reflect", "pad", "combined", "resnet",
+     ("dispatch", 16, 1, False, "reflect", "pad", "combined", "resnet", "dense",
       False, 256)),
     ("dispatch:b1k1i64",
-     ("dispatch", 1, 1, False, "reflect", "pad", "combined", "resnet",
+     ("dispatch", 1, 1, False, "reflect", "pad", "combined", "resnet", "dense",
       False, 64)),
     ("scan:b16pallasi512",
-     ("scan", 16, 8, True, "reflect", "pad", "combined", "resnet",
+     ("scan", 16, 8, True, "reflect", "pad", "combined", "resnet", "dense",
       False, 512)),
     ("scan:b16zero",
-     ("scan", 16, 8, False, "zero", "pad", "combined", "resnet",
+     ("scan", 16, 8, False, "zero", "pad", "combined", "resnet", "dense",
       False, 256)),
     ("dispatch:b16k8zeroi512",
-     ("dispatch", 16, 8, False, "zero", "pad", "combined", "resnet",
+     ("dispatch", 16, 8, False, "zero", "pad", "combined", "resnet", "dense",
       False, 512)),
     ("scan:b16fused",
-     ("scan", 16, 8, False, "reflect", "fused", "combined", "resnet",
+     ("scan", 16, 8, False, "reflect", "fused", "combined", "resnet", "dense",
       False, 256)),
     ("dispatch:b16k8fusedi512",
-     ("dispatch", 16, 8, False, "reflect", "fused", "combined", "resnet",
+     ("dispatch", 16, 8, False, "reflect", "fused", "combined", "resnet", "dense",
       False, 512)),
     # epi = pad_impl="epilogue" (Pallas trunk epilogue; local-compile only)
     ("scan:b16epi",
-     ("scan", 16, 8, False, "reflect", "epilogue", "combined", "resnet",
+     ("scan", 16, 8, False, "reflect", "epilogue", "combined", "resnet", "dense",
       False, 256)),
     ("dispatch:b16k8epii512",
-     ("dispatch", 16, 8, False, "reflect", "epilogue", "combined", "resnet",
+     ("dispatch", 16, 8, False, "reflect", "epilogue", "combined", "resnet", "dense",
       False, 512)),
     ("dispatch:b16k8pf",
-     ("dispatch", 16, 8, False, "reflect", "pad", "combined", "resnet",
+     ("dispatch", 16, 8, False, "reflect", "pad", "combined", "resnet", "dense",
       True, 256)),
     ("dispatch:b16k8zeropfi512",
-     ("dispatch", 16, 8, False, "zero", "pad", "combined", "resnet",
+     ("dispatch", 16, 8, False, "zero", "pad", "combined", "resnet", "dense",
       True, 512)),
     # fp = grad_impl="fusedprop" (shared-forward gradient engine);
     # pb = trunk_impl="perturb" (cheap trunk tier) — composable with the
     # pad words and with each other.
     ("scan:b16fp",
-     ("scan", 16, 8, False, "reflect", "pad", "fusedprop", "resnet",
+     ("scan", 16, 8, False, "reflect", "pad", "fusedprop", "resnet", "dense",
       False, 256)),
     ("scan:b16pb",
-     ("scan", 16, 8, False, "reflect", "pad", "combined", "perturb",
+     ("scan", 16, 8, False, "reflect", "pad", "combined", "perturb", "dense",
       False, 256)),
     ("scan:b16fppb",
-     ("scan", 16, 8, False, "reflect", "pad", "fusedprop", "perturb",
+     ("scan", 16, 8, False, "reflect", "pad", "fusedprop", "perturb", "dense",
       False, 256)),
     ("scan:b16fusedfp",
-     ("scan", 16, 8, False, "reflect", "fused", "fusedprop", "resnet",
+     ("scan", 16, 8, False, "reflect", "fused", "fusedprop", "resnet", "dense",
       False, 256)),
     ("dispatch:b16k8zerofppbpfi512",
-     ("dispatch", 16, 8, False, "zero", "pad", "fusedprop", "perturb",
+     ("dispatch", 16, 8, False, "zero", "pad", "fusedprop", "perturb", "dense",
       True, 512)),
     ("accum:b1k8fpi512",
-     ("accum", 1, 8, False, "reflect", "pad", "fusedprop", "resnet",
+     ("accum", 1, 8, False, "reflect", "pad", "fusedprop", "resnet", "dense",
       False, 512)),
+    # zs = upsample_impl="zeroskip" (GANAX output decomposition, pure
+    # XLA); zsf = "zeroskip_fused" (Pallas phase-conv kernel —
+    # local-compile only, like epi/pallas) — after fp/pb, before pf.
+    ("scan:b16zs",
+     ("scan", 16, 8, False, "reflect", "pad", "combined", "resnet",
+      "zeroskip", False, 256)),
+    ("scan:b16zsf",
+     ("scan", 16, 8, False, "reflect", "pad", "combined", "resnet",
+      "zeroskip_fused", False, 256)),
+    ("scan:b16fpzs",
+     ("scan", 16, 8, False, "reflect", "pad", "fusedprop", "resnet",
+      "zeroskip", False, 256)),
+    ("dispatch:b16k8zspfi512",
+     ("dispatch", 16, 8, False, "reflect", "pad", "combined", "resnet",
+      "zeroskip", True, 512)),
     # accum mode: b = MICRObatch, k = microbatches per update (default 8)
     ("accum:b1k8i512",
-     ("accum", 1, 8, False, "reflect", "pad", "combined", "resnet",
+     ("accum", 1, 8, False, "reflect", "pad", "combined", "resnet", "dense",
       False, 512)),
     ("accum:b1i512",
-     ("accum", 1, 8, False, "reflect", "pad", "combined", "resnet",
+     ("accum", 1, 8, False, "reflect", "pad", "combined", "resnet", "dense",
       False, 512)),
     ("accum:b2k4zeroi512",
-     ("accum", 2, 4, False, "zero", "pad", "combined", "resnet",
+     ("accum", 2, 4, False, "zero", "pad", "combined", "resnet", "dense",
       False, 512)),
 ])
 def test_spec_grammar(spec, expect):
@@ -301,8 +318,11 @@ def test_spec_grammar(spec, expect):
                                  "scan:b16pf",
                                  "dispatch:b16pfk8", "accum:b1pf",
                                  "accum:b0k8", "accum:b1k0",
-                                 # order is fixed: fp before pb before pf
+                                 # order is fixed: fp before pb before
+                                 # zs/zsf before pf
                                  "scan:b16pbfp", "dispatch:b16k8pffp",
+                                 "scan:b16zsfp", "scan:b16pfzs",
+                                 "scan:b16zszsf",
                                  "scan:b16fpfused",
                                  # pb has no epilogue trunk to fuse
                                  "scan:b16epipb"])

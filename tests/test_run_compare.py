@@ -323,3 +323,62 @@ def test_cli_exit_codes(capsys):
     assert run_compare.main([BASE, BAD]) == 1
     assert run_compare.main(["/nonexistent.jsonl", BASE]) == 2
     capsys.readouterr()
+
+
+# ------------------------------------------------- upsample-impl axis
+
+
+def _impl_stream(impl, loss=2.8):
+    events = [
+        {"event": "manifest",
+         "config": {"data": {"domain": "horse2zebra"},
+                    "model": {"upsample_impl": impl}}},
+        {"event": "epoch", "train_images_per_sec": 100.0},
+        {"event": "health", "loss": {"loss_G/total": loss}},
+        {"event": "end", "status": "completed"},
+    ]
+    return stream_profile(events, name=f"run_{impl}.jsonl")
+
+
+def test_stream_profile_extracts_upsample_impl():
+    assert _impl_stream("zeroskip")["upsample_impl"] == "zeroskip"
+    # streams predating the engine profile as None and stay off the axis
+    p = stream_profile([{"event": "epoch", "train_images_per_sec": 1.0}])
+    assert p["upsample_impl"] is None
+    checks = compare_profiles(p, p, make_thresholds())
+    assert not [c for c in checks if c[1] == "upsample-impl"]
+
+
+def test_upsample_impl_change_gates_losses():
+    base = _impl_stream("dense")
+    # equivalent trajectories: the impl change PASSes the axis
+    ok = compare_profiles(base, _impl_stream("zeroskip"), make_thresholds())
+    row = next(c for c in ok if c[1] == "upsample-impl")
+    assert row[0] == PASS and "dense -> zeroskip" in row[2]
+    # a drifted loss FAILs the axis (plus the regular loss gate)
+    bad = compare_profiles(base, _impl_stream("zeroskip_fused", loss=9.9),
+                           make_thresholds())
+    assert next(c for c in bad if c[1] == "upsample-impl")[0] == FAIL
+
+
+def test_upsample_impl_change_never_skips_silently():
+    """An impl change with nothing to gate against must FAIL, not SKIP:
+    a divergent kernel shipping behind a missing trajectory is exactly
+    what the axis exists to catch."""
+    base = _impl_stream("dense")
+    cand = stream_profile([
+        {"event": "manifest",
+         "config": {"data": {"domain": "horse2zebra"},
+                    "model": {"upsample_impl": "zeroskip"}}},
+        {"event": "epoch", "train_images_per_sec": 100.0},
+    ], name="no_losses.jsonl")
+    checks = compare_profiles(base, cand, make_thresholds())
+    row = next(c for c in checks if c[1] == "upsample-impl")
+    assert row[0] == FAIL and "never skip" in row[2]
+
+
+def test_same_upsample_impl_reports_info():
+    checks = compare_profiles(_impl_stream("zeroskip"),
+                              _impl_stream("zeroskip"), make_thresholds())
+    row = next(c for c in checks if c[1] == "upsample-impl")
+    assert row[0] == "INFO"

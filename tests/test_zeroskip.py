@@ -1,0 +1,351 @@
+"""GANAX zero-skip transposed-conv engine (ops/upsample.py,
+ops/pallas/upsample_kernel.py) vs the dense nn.ConvTranspose lowering —
+forward and backward parity, odd/ragged shapes, the VMEM eligibility
+boundary with its XLA fallback, checkpoint interchange across the three
+Upsample tiers, and the fused discriminator tail.
+
+The decomposition's claim is exactness: the four phase convolutions
+compute the SAME sums as the lhs-dilated conv minus the multiplies
+against inserted zeros, so f32 parity is gated at 1e-5 (channel
+reduction order is the only legal difference) and bf16 at 1e-2.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cyclegan_tpu.config import (
+    DiscriminatorConfig,
+    GeneratorConfig,
+    ModelConfig,
+)
+from cyclegan_tpu.models import PatchGANDiscriminator, ResNetGenerator
+from cyclegan_tpu.ops.norm import instance_norm, instance_norm_relu_pad
+from cyclegan_tpu.ops.pallas import vmem
+from cyclegan_tpu.ops.pallas.upsample_kernel import (
+    upsample_eligible,
+    upsample_norm_relu_pad_pallas,
+)
+from cyclegan_tpu.ops.upsample import (
+    conv_transpose_up2,
+    conv_transpose_up2_dense,
+    conv_transpose_zeroskip,
+    upsample_norm_relu_pad,
+)
+
+
+def _rand(shape, seed=0, dtype=jnp.float32):
+    k = jax.random.PRNGKey(seed)
+    return (jax.random.normal(k, shape) * 2 + 0.5).astype(dtype)
+
+
+# Shapes chosen to break a decomposition that only works on friendly
+# tiles: batch > 1, non-square H != W (axis mix-ups in the interleave),
+# odd extents (the SAME/s2 output is (2H, 2W) regardless of parity),
+# H or W of 1 (every tap hits the zero boundary), and Cin != Cout.
+SHAPES = [
+    ((2, 8, 8, 16), 8),
+    ((1, 16, 16, 4), 8),
+    ((1, 5, 9, 3), 6),
+    ((2, 7, 4, 5), 3),
+    ((1, 1, 6, 2), 4),
+    ((1, 3, 1, 2), 2),
+]
+
+
+@pytest.mark.parametrize("shape,cout", SHAPES)
+def test_zeroskip_forward_matches_dense(shape, cout):
+    x = _rand(shape)
+    kernel = _rand((3, 3, shape[-1], cout), 1)
+    got = conv_transpose_zeroskip(x, kernel)
+    want = conv_transpose_up2_dense(x, kernel)
+    assert got.shape == want.shape == (
+        shape[0], 2 * shape[1], 2 * shape[2], cout
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("shape,cout", SHAPES)
+def test_zeroskip_backward_matches_dense(shape, cout):
+    x = _rand(shape)
+    kernel = _rand((3, 3, shape[-1], cout), 1)
+
+    def loss(fn):
+        return lambda x, k: jnp.sum(jnp.sin(fn(x, k)) * fn(x, k))
+
+    g_z = jax.grad(loss(conv_transpose_zeroskip), argnums=(0, 1))(x, kernel)
+    g_d = jax.grad(loss(conv_transpose_up2_dense), argnums=(0, 1))(x, kernel)
+    # sin(y)*y amplifies the reduction-order noise, and near-cancelling
+    # gradient elements can land ~2e-4 off in absolute terms; the
+    # element-wise distributions otherwise agree to 1e-5 like the
+    # forward.
+    for a, b, name in zip(g_z, g_d, ["dx", "dkernel"]):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4, err_msg=name
+        )
+
+
+def test_zeroskip_bfloat16_parity():
+    x = _rand((2, 8, 8, 8), dtype=jnp.bfloat16)
+    kernel = _rand((3, 3, 8, 16), 1, dtype=jnp.bfloat16)
+    got = conv_transpose_zeroskip(x, kernel)
+    want = conv_transpose_up2_dense(x, kernel)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=1e-2, atol=1e-2,
+    )
+
+
+def test_dispatch_impl_selects_engine():
+    x = _rand((1, 6, 6, 4))
+    kernel = _rand((3, 3, 4, 8), 1)
+    for impl in ("dense", "zeroskip"):
+        got = conv_transpose_up2(x, kernel, impl=impl)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(conv_transpose_up2_dense(x, kernel)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
+# ------------------------------------------------- fused Pallas kernel
+
+
+def _fused_reference(x, kernel, scale, bias, pad, eps=1e-3):
+    """The unfused composition the kernel must match: dense transposed
+    conv -> IN -> ReLU (-> reflect-pad)."""
+    from cyclegan_tpu.ops.padding import reflect_pad
+
+    y = conv_transpose_up2_dense(x, kernel)
+    y = jax.nn.relu(instance_norm(y, scale, bias, eps=eps, impl="xla"))
+    return reflect_pad(y, pad) if pad else y
+
+
+FUSED_SHAPES = [
+    ((2, 8, 8, 16), 8, 0),
+    ((1, 6, 10, 4), 8, 0),
+    ((1, 8, 8, 8), 16, 3),   # the pad_impl="epilogue" last-upsample form
+    ((2, 5, 7, 3), 4, 1),
+]
+
+
+@pytest.mark.parametrize("shape,cout,pad", FUSED_SHAPES)
+def test_fused_forward_matches_reference(shape, cout, pad):
+    x = _rand(shape)
+    kernel = _rand((3, 3, shape[-1], cout), 1)
+    scale = _rand((cout,), 2)
+    bias = _rand((cout,), 3)
+    got = upsample_norm_relu_pad_pallas(
+        x, kernel, scale, bias, pad=pad, interpret=True
+    )
+    want = _fused_reference(x, kernel, scale, bias, pad)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("shape,cout,pad", FUSED_SHAPES)
+def test_fused_backward_matches_reference(shape, cout, pad):
+    x = _rand(shape)
+    kernel = _rand((3, 3, shape[-1], cout), 1)
+    scale = _rand((cout,), 2)
+    bias = _rand((cout,), 3)
+
+    def loss(fn):
+        def inner(x, k, s, b):
+            y = fn(x, k, s, b)
+            return jnp.sum(jnp.sin(y) * y)
+        return inner
+
+    g_p = jax.grad(
+        loss(lambda x, k, s, b: upsample_norm_relu_pad_pallas(
+            x, k, s, b, pad=pad, interpret=True)),
+        argnums=(0, 1, 2, 3),
+    )(x, kernel, scale, bias)
+    g_r = jax.grad(
+        loss(lambda x, k, s, b: _fused_reference(x, k, s, b, pad)),
+        argnums=(0, 1, 2, 3),
+    )(x, kernel, scale, bias)
+    for a, b, name in zip(g_p, g_r, ["dx", "dkernel", "dscale", "dbias"]):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=5e-5, err_msg=name
+        )
+
+
+def test_fused_bfloat16_forward():
+    x = _rand((1, 8, 8, 8), dtype=jnp.bfloat16)
+    kernel = _rand((3, 3, 8, 16), 1, dtype=jnp.bfloat16)
+    scale = _rand((16,), 2)
+    bias = _rand((16,), 3)
+    got = upsample_norm_relu_pad_pallas(
+        x, kernel, scale, bias, pad=0, interpret=True
+    )
+    assert got.dtype == jnp.bfloat16
+    want = _fused_reference(x, kernel, scale, bias, 0)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=1e-2, atol=1e-2,
+    )
+
+
+# --------------------------------------------------- eligibility gate
+
+
+def test_upsample_eligibility_is_dtype_aware():
+    # the generator's FIRST upsample at 256^2 (64^2 input, 256ch):
+    # eligible under bf16, past the budget under f32
+    assert upsample_eligible((1, 64, 64, 256), jnp.bfloat16, 0)
+    assert not upsample_eligible((1, 64, 64, 256), jnp.float32, 0)
+    # the SECOND upsample (128^2 input, 128ch): ineligible either way —
+    # the XLA zeroskip fallback covers it by construction
+    assert not upsample_eligible((1, 128, 128, 128), jnp.bfloat16, 0)
+    assert not upsample_eligible((1, 128, 128, 128), jnp.float32, 0)
+    # reflect constraint applies to the DOUBLED output resolution
+    assert upsample_eligible((1, 2, 8, 4), jnp.float32, 3)   # 4 > pad
+    assert not upsample_eligible((1, 1, 8, 4), jnp.float32, 3)  # 2 <= pad
+    assert not upsample_eligible((1, 64, 64), jnp.float32, 0)  # not 4-D
+
+
+def test_upsample_vmem_accounting():
+    h = w = 8
+    c_in = 4
+    got = vmem.upsample_bytes(h, w, c_in, 1, 4)
+    want = (
+        (h + 1) * (w + 1) * c_in          # zero-extended input slab
+        + 9 * c_in * vmem.C_BLK           # kernel block
+        + 4 * h * w * vmem.C_BLK          # four phase results
+        + (2 * h + 2) * (2 * w + 2) * vmem.C_BLK  # padded output
+    ) * 4
+    assert got == want
+    # the budget boundary really is the budget
+    assert vmem.upsample_fits(64, 64, 256, 0, 2)
+    assert not vmem.upsample_fits(64, 64, 256, 0, 4)
+
+
+def test_fused_ineligible_shape_raises():
+    x = _rand((1, 128, 128, 8))
+    with pytest.raises(NotImplementedError):
+        upsample_norm_relu_pad_pallas(
+            x, _rand((3, 3, 8, 8), 1), jnp.ones(8), jnp.zeros(8),
+            interpret=True,
+        )
+
+
+def test_dispatch_falls_back_across_the_boundary():
+    """upsample_norm_relu_pad(impl='zeroskip_fused') must serve BOTH
+    dispatch arms with the same math: one VMEM-eligible shape (Pallas
+    interpret path off-TPU) and one past the budget (XLA composition)."""
+    for shape, cout in [((1, 8, 8, 8), 8), ((1, 128, 128, 8), 8)]:
+        x = _rand(shape)
+        kernel = _rand((3, 3, shape[-1], cout), 1)
+        scale = _rand((cout,), 2)
+        bias = _rand((cout,), 3)
+        got = upsample_norm_relu_pad(
+            x, kernel, scale, bias, pad=0, impl="zeroskip_fused"
+        )
+        want = _fused_reference(x, kernel, scale, bias, 0)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+        )
+
+
+# ------------------------------------- model tiers share one param tree
+
+SMALL_GEN = GeneratorConfig(
+    filters=8, num_downsampling_blocks=2, num_residual_blocks=1
+)
+
+
+def _gen(upsample_impl, **kw):
+    return ResNetGenerator(
+        config=SMALL_GEN, upsample_impl=upsample_impl, **kw
+    )
+
+
+def test_upsample_tiers_share_param_tree_and_outputs():
+    """The acceptance claim behind checkpoint interchange: init under
+    any tier, apply under any other — identical tree structure AND
+    shapes, near-identical outputs."""
+    x = _rand((1, 32, 32, 3))
+    params = _gen("dense").init(jax.random.PRNGKey(0), x)
+    ref = _gen("dense").apply(params, x)
+    for impl in ("zeroskip", "zeroskip_fused"):
+        p2 = jax.eval_shape(_gen(impl).init, jax.random.PRNGKey(0), x)
+        assert jax.tree_util.tree_structure(p2) \
+            == jax.tree_util.tree_structure(params)
+        assert jax.tree_util.tree_map(lambda l: l.shape, p2) \
+            == jax.tree_util.tree_map(lambda l: l.shape, params)
+        out = _gen(impl).apply(params, x)  # dense-initialized checkpoint
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4,
+            err_msg=impl,
+        )
+
+
+def test_upsample_tiers_interchange_under_epilogue_pad():
+    """pad_impl='epilogue' routes the LAST upsample through the fused
+    tail (pad_after=3); the engines must still agree there."""
+    x = _rand((1, 32, 32, 3))
+    kw = dict(pad_mode="reflect", pad_impl="epilogue")
+    params = _gen("dense", **kw).init(jax.random.PRNGKey(0), x)
+    ref = _gen("dense", **kw).apply(params, x)
+    for impl in ("zeroskip", "zeroskip_fused"):
+        out = _gen(impl, **kw).apply(params, x)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4,
+            err_msg=impl,
+        )
+
+
+def test_generator_rejects_unknown_upsample_impl():
+    x = jnp.zeros((1, 32, 32, 3))
+    with pytest.raises(ValueError, match="upsample_impl"):
+        _gen("bogus").init(jax.random.PRNGKey(0), x)
+
+
+# ------------------------------------------ fused discriminator tails
+
+
+def test_discriminator_fused_tail_matches_plain():
+    """pad_impl='epilogue' collapses each trunk block's IN ->
+    LeakyReLU(0.2) into instance_norm_act_pad; same params, same
+    logits."""
+    cfg = DiscriminatorConfig(filters=8)
+    x = _rand((1, 64, 64, 3))
+    plain = PatchGANDiscriminator(config=cfg, pad_impl="pad")
+    fused = PatchGANDiscriminator(config=cfg, pad_impl="epilogue")
+    params = plain.init(jax.random.PRNGKey(0), x)
+    assert jax.tree_util.tree_structure(
+        jax.eval_shape(fused.init, jax.random.PRNGKey(0), x)
+    ) == jax.tree_util.tree_structure(params)
+    np.testing.assert_allclose(
+        np.asarray(fused.apply(params, x)),
+        np.asarray(plain.apply(params, x)),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+# ------------------------------------------------- config validation
+
+
+def test_config_rejects_unknown_upsample_impl():
+    with pytest.raises(ValueError, match="upsample_impl"):
+        ModelConfig(upsample_impl="bogus")
+
+
+def test_config_rejects_fused_upsample_with_xla_norm():
+    with pytest.raises(ValueError, match="zeroskip_fused"):
+        ModelConfig(upsample_impl="zeroskip_fused", instance_norm_impl="xla")
+
+
+def test_config_accepts_all_tiers():
+    for impl in ("dense", "zeroskip", "zeroskip_fused"):
+        cfg = ModelConfig(upsample_impl=impl)
+        assert cfg.upsample_impl == impl
+        assert dataclasses.replace(cfg, upsample_impl="dense")

@@ -70,11 +70,12 @@ def official_programs() -> list:
 
     def add(key, mode, dtype, batch, image=256, k=1, pad_mode="reflect",
             pad_impl="pad", accum=None, grad_impl="combined",
-            trunk_impl="resnet"):
+            trunk_impl="resnet", upsample_impl="dense"):
         # program signature: pf changes nothing (host-side staging);
         # steps ≡ dispatch-k1 (plain per-step jit); scan ≡ dispatch-k>1
-        # (both run bench._fused_k_step's scanned program). grad_impl and
-        # trunk_impl change the traced HLO, so they are part of identity.
+        # (both run bench._fused_k_step's scanned program). grad_impl,
+        # trunk_impl, and upsample_impl change the traced HLO, so they
+        # are part of identity.
         if mode == "accum":
             prog_mode = "accum"
         elif mode == "steps" or (mode == "dispatch" and k == 1):
@@ -82,7 +83,8 @@ def official_programs() -> list:
         else:
             prog_mode = "fused_k"
         sig = (prog_mode, dtype, batch, image, k if prog_mode != "step"
-               else 1, pad_mode, pad_impl, accum, grad_impl, trunk_impl)
+               else 1, pad_mode, pad_impl, accum, grad_impl, trunk_impl,
+               upsample_impl)
         if sig in seen:
             seen[sig]["covers"].append(key)
             return
@@ -90,7 +92,8 @@ def official_programs() -> list:
                  "batch": batch, "image": image, "k": k,
                  "pad_mode": pad_mode, "pad_impl": pad_impl,
                  "accum": accum, "grad_impl": grad_impl,
-                 "trunk_impl": trunk_impl, "covers": [key]}
+                 "trunk_impl": trunk_impl, "upsample_impl": upsample_impl,
+                 "covers": [key]}
         seen[sig] = entry
         progs.append(entry)
 
@@ -101,7 +104,8 @@ def official_programs() -> list:
             pad_mode=c.get("pad_mode", "reflect"),
             pad_impl=c.get("pad_impl", "pad"),
             grad_impl=c.get("grad_impl", "combined"),
-            trunk_impl=c.get("trunk_impl", "resnet"))
+            trunk_impl=c.get("trunk_impl", "resnet"),
+            upsample_impl=c.get("upsample_impl", "dense"))
     # chip_autorun queue rows (tools/chip_autorun.py build_queue).
     # k=8 matches chip_sweep's scan default (parse_spec) — the k the
     # sweep will actually compile; omitting it would warm k=1 programs
@@ -127,6 +131,16 @@ def official_programs() -> list:
         trunk_impl="perturb")
     add("sweep scan:b16fppb", "scan", "bfloat16", 16, k=8,
         grad_impl="fusedprop", trunk_impl="perturb")
+    # chip_autorun's upsample_sweep step (ISSUE 14): the zero-skip
+    # upsample tiers at the headline geometry. zs/zsf dedup against the
+    # TPU_CONFIGS /zskip and /zskipf rows by signature; the fp+zs combo
+    # is the sweep's stacked-levers row.
+    add("sweep scan:b16zs", "scan", "bfloat16", 16, k=8,
+        upsample_impl="zeroskip")
+    add("sweep scan:b16zsf", "scan", "bfloat16", 16, k=8,
+        upsample_impl="zeroskip_fused")
+    add("sweep scan:b16fpzs", "scan", "bfloat16", 16, k=8,
+        grad_impl="fusedprop", upsample_impl="zeroskip")
     add("sweep accum:b1k8i512", "accum", "bfloat16", 1, image=512, k=8,
         accum=8)
     add("sweep scan:b4k2i512", "scan", "bfloat16", 4, image=512, k=2)
@@ -160,6 +174,18 @@ def serve_programs() -> list:
                     "image": size, "k": 1, "pad_mode": "reflect",
                     "pad_impl": "pad", "accum": None, "with_cycle": False,
                     "covers": [f"serve/{dtype}/b{batch}/i{size}"],
+                })
+                # Zero-skip serving twin (ISSUE 14): a checkpoint whose
+                # model_meta records upsample_impl="zeroskip" compiles a
+                # DIFFERENT forward — warm it so such a lease answers
+                # its first request compile-free too.
+                progs.append({
+                    "key": f"serve {short}zs:b{batch}i{size}",
+                    "mode": "serve", "dtype": dtype, "batch": batch,
+                    "image": size, "k": 1, "pad_mode": "reflect",
+                    "pad_impl": "pad", "accum": None, "with_cycle": False,
+                    "upsample_impl": "zeroskip",
+                    "covers": [f"serve/{dtype}/b{batch}/i{size}/zskip"],
                 })
         # The int8 weight-quantized tier (server --int8 / fleet class
         # routing): f32 accumulate over per-channel-dequantized weights,
@@ -208,7 +234,9 @@ def _lower(prog: dict):
             serve_model_config,
         )
 
-        model_cfg = serve_model_config(prog["dtype"], image)
+        model_cfg = serve_model_config(
+            prog["dtype"], image,
+            upsample_impl=prog.get("upsample_impl", "dense"))
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
             if prog.get("quantized"):
                 # The int8 tier's params enter as the quantized tree
@@ -227,11 +255,12 @@ def _lower(prog: dict):
 
         accum, micro = prog["accum"], batch
         effective = accum * micro
-        cfg = bench._config_for(prog["dtype"], effective, image, "auto",
-                                prog["pad_mode"], prog["pad_impl"],
-                                grad_accum=accum,
-                                grad_impl=prog.get("grad_impl", "combined"),
-                                trunk_impl=prog.get("trunk_impl", "resnet"))
+        cfg = bench._config_for(
+            prog["dtype"], effective, image, "auto",
+            prog["pad_mode"], prog["pad_impl"], grad_accum=accum,
+            grad_impl=prog.get("grad_impl", "combined"),
+            trunk_impl=prog.get("trunk_impl", "resnet"),
+            upsample_impl=prog.get("upsample_impl", "dense"))
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
             state = create_state(cfg, jax.random.PRNGKey(0))
         step = make_accum_train_step(cfg, effective, accum)
@@ -243,7 +272,8 @@ def _lower(prog: dict):
     cfg = bench._config_for(prog["dtype"], batch, image, "auto",
                             prog["pad_mode"], prog["pad_impl"],
                             grad_impl=prog.get("grad_impl", "combined"),
-                            trunk_impl=prog.get("trunk_impl", "resnet"))
+                            trunk_impl=prog.get("trunk_impl", "resnet"),
+                            upsample_impl=prog.get("upsample_impl", "dense"))
     with jax.default_device(jax.local_devices(backend="cpu")[0]):
         state = create_state(cfg, jax.random.PRNGKey(0))
     step_fn = make_train_step(cfg, batch)

@@ -204,6 +204,19 @@ def build_queue(mode: str, round_tag: str = ROUND_TAG) -> list:
         Step("grad_sweep",
              [py, "tools/chip_sweep.py", "scan:b16fp", "scan:b16pb",
               "scan:b16fppb"], 3600.0, env=env, artifacts=[sweeps]),
+        # The GANAX zero-skip upsample tiers (ISSUE 14): zs is the pure
+        # XLA phase decomposition (~4x fewer upsample MACs), zsf the
+        # fused Pallas kernel, fpzs the stacked-levers row (fusedprop +
+        # zeroskip). zsf is a Mosaic program, so like epilogue_sweep the
+        # step forces local-compile registration (ground rule 2b); the
+        # dense baselines these rows pair against are bench_warm's scan
+        # b16 and fp rows; cache_warm pre-warms all three programs.
+        Step("upsample_sweep",
+             [py, "tools/chip_sweep.py", "scan:b16zs", "scan:b16zsf",
+              "scan:b16fpzs"], 3600.0,
+             env={**env, "PALLAS_AXON_POOL_IPS": "",
+                  "CYCLEGAN_AXON_LOCAL_COMPILE": "1"},
+             artifacts=[sweeps]),
         # 512^2 HBM-relief rows (runbook item 5): accum 8x1 (the
         # certified memory contract) and the plain/zero 512 scans.
         Step("accum512", [py, "tools/chip_sweep.py", "accum:b1k8i512"],

@@ -9,8 +9,8 @@ so the record is regenerable:
     python tools/chip_sweep.py scan:b8 scan:b24 scan:b32 scan:b16k16
 
 Spec grammar:
-<scan|dispatch|accum>:b<batch>[k<K>][pallas][zero|fused|epi][fp][pb][pf]
-[i<image>]
+<scan|dispatch|accum>:b<batch>[k<K>][pallas][zero|fused|epi][fp][pb]
+[zs|zsf][pf][i<image>]
 — parts in that order; k defaults to 8 for scan / 1 for dispatch, image
 to 256; `zero` selects pad_mode="zero" (conv built-in SAME padding, the
 compiler-certified −32% traffic variant — docs/BENCHMARKS.md pad-probe);
@@ -25,6 +25,12 @@ materialized pads — the parity-preserving variant of the same lever);
 IN>ReLU>reflect-pad chains collapsed into the Pallas epilogue kernel —
 ops/pallas/epilogue_kernel.py; a Mosaic program, so it is gated like
 `pallas` specs below);
+`zs` selects upsample_impl="zeroskip" (GANAX output decomposition —
+four per-phase dense convs + depth-to-space interleave, ~4x fewer
+upsample MACs, pure XLA; ops/upsample.py);
+`zsf` selects upsample_impl="zeroskip_fused" (the Pallas phase-conv +
+IN + ReLU kernel, ops/pallas/upsample_kernel.py — a Mosaic program,
+gated like `pallas`/`epi` specs);
 `pf` (dispatch only) stages inputs via the device-prefetch worker — the
 round-4 real-loop contract (`--prefetch_batches`), same XLA program as
 the plain dispatch spec.
@@ -74,12 +80,12 @@ RECORD_PATH = os.environ.get("CYCLEGAN_SWEEP_RECORD") or os.path.join(
 
 SPEC_RE = re.compile(
     r"(scan|dispatch|accum):b(\d+)(?:k(\d+))?(pallas)?(zero|fused|epi)?"
-    r"(fp)?(pb)?(pf)?(?:i(\d+))?")
+    r"(fp)?(pb)?(zsf|zs)?(pf)?(?:i(\d+))?")
 
 
 def parse_spec(spec: str):
     """spec -> (mode, batch, k, pallas, pad_mode, pad_impl, grad_impl,
-    trunk_impl, prefetch, image).
+    trunk_impl, upsample_impl, prefetch, image).
     Raises SystemExit on a malformed spec or zero batch/k/image (the
     regex's \\d+ admits 0, which `k or default` would silently coerce to
     the default — a mislabeled record in a file the docs treat as ground
@@ -91,12 +97,14 @@ def parse_spec(spec: str):
     mode, batch, k, pallas, prefetch, image = (
         m.group(1), int(m.group(2)),
         int(m.group(3)) if m.group(3) else None,
-        bool(m.group(4)), bool(m.group(8)),
-        int(m.group(9)) if m.group(9) else 256)
+        bool(m.group(4)), bool(m.group(9)),
+        int(m.group(10)) if m.group(10) else 256)
     pad_mode = "zero" if pad_word == "zero" else "reflect"
     pad_impl = {"fused": "fused", "epi": "epilogue"}.get(pad_word, "pad")
     grad_impl = "fusedprop" if m.group(6) else "combined"
     trunk_impl = "perturb" if m.group(7) else "resnet"
+    upsample_impl = {"zs": "zeroskip", "zsf": "zeroskip_fused"}.get(
+        m.group(8), "dense")
     if batch < 1 or image < 1 or (k is not None and k < 1):
         raise SystemExit(f"bad spec: {spec} (batch/k/image must be >= 1)")
     if prefetch and mode != "dispatch":
@@ -108,7 +116,7 @@ def parse_spec(spec: str):
     if k is None:
         k = 1 if mode == "dispatch" else 8
     return (mode, batch, k, pallas, pad_mode, pad_impl, grad_impl,
-            trunk_impl, prefetch, image)
+            trunk_impl, upsample_impl, prefetch, image)
 
 
 def _load_records() -> list:
@@ -201,7 +209,7 @@ def run_spec(spec: str) -> bool:
     infrastructure (nothing recorded, caller should exit nonzero)."""
     # abort BEFORE compile
     (mode, batch, k, pallas, pad_mode, pad_impl, grad_impl, trunk_impl,
-     prefetch, image) = parse_spec(spec)
+     upsample_impl, prefetch, image) = parse_spec(spec)
     # Honor JAX_PLATFORMS=cpu (the axon sitecustomize overrides the env
     # var; main.py re-asserts it the same way) so the tool is drivable
     # off-chip and fails fast instead of hanging when the relay is down.
@@ -210,10 +218,11 @@ def run_spec(spec: str) -> bool:
 
     t0 = time.perf_counter()
     rec = {"key": spec, "ts": time.strftime("%Y-%m-%dT%H:%MZ", time.gmtime())}
-    # `epi` specs compile the Mosaic epilogue kernel — same refusal gate
-    # as explicit `pallas` specs (ground rule 2b).
+    # `epi`/`zsf` specs compile Mosaic kernels — same refusal gate as
+    # explicit `pallas` specs (ground rule 2b).
     blocked = (_pallas_blocked()
-               if (pallas or pad_impl == "epilogue") else None)
+               if (pallas or pad_impl == "epilogue"
+                   or upsample_impl == "zeroskip_fused") else None)
     if blocked:
         # A refusal is a RECORDED result, like an OOM: it costs no
         # compile, and aborting here would silently drop the remaining
@@ -231,13 +240,15 @@ def run_spec(spec: str) -> bool:
             ips = bench.bench_scan("bfloat16", batch, image=image,
                                    norm_impl=norm, k=k, pad_mode=pad_mode,
                                    pad_impl=pad_impl, grad_impl=grad_impl,
-                                   trunk_impl=trunk_impl)
+                                   trunk_impl=trunk_impl,
+                                   upsample_impl=upsample_impl)
         elif mode == "accum":
             ips = bench.bench_accum("bfloat16", micro=batch, image=image,
                                     accum=k, norm_impl=norm,
                                     pad_mode=pad_mode, pad_impl=pad_impl,
                                     grad_impl=grad_impl,
-                                    trunk_impl=trunk_impl)
+                                    trunk_impl=trunk_impl,
+                                    upsample_impl=upsample_impl)
         else:
             ips = bench.bench_dispatch("bfloat16", batch, image=image,
                                        norm_impl=norm, k=k,
@@ -245,7 +256,8 @@ def run_spec(spec: str) -> bool:
                                        pad_impl=pad_impl,
                                        prefetch=prefetch,
                                        grad_impl=grad_impl,
-                                       trunk_impl=trunk_impl)
+                                       trunk_impl=trunk_impl,
+                                       upsample_impl=upsample_impl)
         rec["img_per_sec"] = round(ips, 2)
         print(f"[sweep] {spec}: {ips:.2f} img/s "
               f"({time.perf_counter() - t0:.0f}s incl. compile)", flush=True)

@@ -225,12 +225,19 @@ def stream_profile(events: List[dict], skipped: int = 0, name: str = "?") -> dic
     # provenance arrives as its own `transfer_init` event. Streams that
     # predate domains profile as None and compare as before.
     domain = None
+    upsample_impl = None
     manifest = next((e for e in events if e.get("event") == "manifest"),
                     None)
     if manifest is not None:
         data_cfg = ((manifest.get("config") or {}).get("data") or {})
         d = data_cfg.get("domain")
         domain = str(d) if d else None
+        # Upsample tier (PR-14): dense vs zeroskip vs zeroskip_fused.
+        # Streams that predate the GANAX engine profile as None and the
+        # upsample axis stays out of the report.
+        model_cfg = ((manifest.get("config") or {}).get("model") or {})
+        u = model_cfg.get("upsample_impl")
+        upsample_impl = str(u) if u else None
     transfer = next((e for e in events
                      if e.get("event") == "transfer_init"), None)
     if transfer is not None:
@@ -309,6 +316,7 @@ def stream_profile(events: List[dict], skipped: int = 0, name: str = "?") -> dic
         "kind": "stream",
         "name": name,
         "domain": domain,
+        "upsample_impl": upsample_impl,
         "transfer": transfer,
         "n_events": len(events),
         "skipped_lines": skipped,
@@ -498,6 +506,43 @@ def _compare_streams(base: dict, cand: dict, th) -> List[Check]:
         # older checkpoint): the regular axes still apply, the transfer
         # axis rides along.
         checks.extend(_transfer_checks(base, cand, th))
+
+    # Upsample-impl axis (PR-14): when the two streams ran different
+    # generator upsample tiers (dense vs zeroskip vs zeroskip_fused),
+    # the pair IS the GANAX equivalence experiment — the decomposed
+    # engine claims bit-compatible training, so the loss trajectories
+    # must land inside the usual relative-with-floor slack. Unlike the
+    # domain gate this axis never SKIPs: an impl change that cannot
+    # demonstrate equivalence (no common loss means) FAILS, because a
+    # silent skip is exactly how a divergent kernel would ship.
+    b_up, c_up = base.get("upsample_impl"), cand.get("upsample_impl")
+    if b_up and c_up and b_up != c_up:
+        common = sorted(set(base["final_losses"])
+                        & set(cand["final_losses"]))
+        if not common:
+            checks.append((FAIL, "upsample-impl",
+                           f"upsample changed {b_up} -> {c_up} with no "
+                           f"common loss trajectories: an impl change "
+                           f"must prove loss equivalence, never skip it"))
+        else:
+            worst_key, worst_excess = None, None
+            for key in common:
+                bv = base["final_losses"][key]
+                cv = cand["final_losses"][key]
+                limit = bv + th.max_loss_increase * max(abs(bv), 0.1)
+                excess = cv - limit
+                if worst_excess is None or excess > worst_excess:
+                    worst_excess, worst_key = excess, key
+            status = FAIL if worst_excess > 0 else PASS
+            checks.append((status, "upsample-impl",
+                           f"upsample changed {b_up} -> {c_up}: "
+                           f"{len(common)} loss trajectories gated, "
+                           f"worst margin {worst_key} "
+                           f"{'+' if worst_excess > 0 else ''}"
+                           f"{worst_excess:.4f} vs limit"))
+    elif b_up and c_up:
+        checks.append((INFO, "upsample-impl",
+                       f"both streams ran upsample_impl={b_up}"))
 
     bt, ct = base.get("throughput"), cand.get("throughput")
     if bt is not None and ct is not None:

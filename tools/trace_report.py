@@ -287,9 +287,21 @@ def classify(meta: dict) -> str:
         ("copy", "transpose", "bitcast")
     ):
         return "layout-copy"
+    # The fused zero-skip upsample kernel (ops/pallas/upsample_kernel.py)
+    # surfaces as a Mosaic custom-call (or a fusion wrapping one) whose
+    # provenance is the upsample_norm_relu_pad scope: it IS the
+    # transposed-conv work (phase MXU dots + interleave; the IN/ReLU
+    # epilogue rides along), so it rolls into conv-transpose — the
+    # bucket its unfused counterpart's convs land in.
+    if "upsamplenormrelupad" in squashed_prov or "zeroskip" in squashed_prov:
+        return "conv-transpose"
     if "instancenorm" in squashed_prov or (
         ("reduce" in cat or name.startswith(("reduce", "variance", "mean"))) and "norm" in prov
     ):
+        # Includes the Pallas epilogue custom-call sites (residual-trunk
+        # AND the discriminator's fused IN>LeakyReLU tails — the
+        # instance_norm_act_pad scope), keeping them out of
+        # fusion-other/other.
         return "in-stats"
     if "fusion" in cat:
         # Fusions rooted in a ConvTranspose scope are part of the
