@@ -237,15 +237,22 @@ def bench_engine_open_loop(executor, images, rate: float) -> dict:
 
 
 def bench_fleet_saturated(fleet, images, klass: str = "batch",
-                          tier=None) -> dict:
+                          tier=None, tracer=None) -> dict:
     """Closed-loop saturation through the fleet: same discipline as
     bench_engine_saturated, but requests carry a deadline class and may
-    route to a program tier (tier="int8" measures the quantized tier)."""
+    route to a program tier (tier="int8" measures the quantized tier).
+    With ``tracer``, every request carries a span-graph TraceContext —
+    the trace_overhead phase uses this to price the tracing hot path at
+    sample=0 vs sample=1."""
     lats = []
     done = []
     t0 = time.perf_counter()
     for im in images:
-        fut = fleet.submit_raw(im, klass=klass, tier=tier)
+        if tracer is not None:
+            ctx = tracer.trace("request")
+            fut = fleet.submit_raw(im, klass=klass, tier=tier, trace=ctx)
+        else:
+            fut = fleet.submit_raw(im, klass=klass, tier=tier)
         done.append((fut, time.perf_counter()))
     for fut, t_sub in done:
         res = fut.result(timeout=600)
@@ -609,6 +616,45 @@ def main(argv=None) -> int:
                    images_per_sec=round(fsat["images_per_sec"], 4),
                    platform=platform)
 
+        # Tracing overhead: the same closed-loop saturation with a
+        # request-scoped tracer minting a span graph per request.
+        # sample=0.0 still mints contexts and records spans (the
+        # tail-keep contract: a shed/missed request must be emittable
+        # retroactively), so the comparison prices exactly what head
+        # sampling adds — per-span folding + JSONL emission. Runs are
+        # interleaved best-of-2 to damp closed-loop jitter; run_compare
+        # gates overhead_frac at 3%.
+        trace_line = None
+        if time.perf_counter() - t_start <= TIME_BUDGET_S:
+            from cyclegan_tpu.obs import Tracer
+
+            t_ips = {0.0: 0.0, 1.0: 0.0}
+            t_stats = {}
+            for _rep in range(2):
+                for sample in (0.0, 1.0):
+                    tracer = Tracer(_OBS_LOGGER, sample=sample)
+                    row = bench_fleet_saturated(fleet, images,
+                                                tracer=tracer)
+                    t_ips[sample] = max(t_ips[sample],
+                                        row["images_per_sec"])
+                    t_stats[sample] = tracer.stats()
+            overhead = 1.0 - t_ips[1.0] / max(t_ips[0.0], 1e-9)
+            say(f"{key}: trace overhead sample0 {t_ips[0.0]:.2f} -> "
+                f"sample1 {t_ips[1.0]:.2f} images/sec "
+                f"({overhead * 100:+.2f}%)")
+            _obs_event("bench", key=key + "/trace_overhead",
+                       images_per_sec=round(t_ips[1.0], 4),
+                       overhead_frac=round(overhead, 4),
+                       platform=platform)
+            trace_line = {
+                "images_per_sec_sample0": round(t_ips[0.0], 2),
+                "images_per_sec_sample1": round(t_ips[1.0], 2),
+                "overhead_frac": round(overhead, 4),
+                "traces_emitted": t_stats[1.0].get("emitted"),
+                "untraced_images_per_sec": round(
+                    fsat["images_per_sec"], 2),
+            }
+
         # int8 tier: throughput through the quantized programs + the
         # output delta vs the base tier on one bucket (weight-only
         # per-channel symmetric, f32 accumulate — the delta should be
@@ -756,6 +802,8 @@ def main(argv=None) -> int:
             "shed": fleet_summary.get("shed"),
             "max_queue_depth": fleet_summary.get("max_queue_depth"),
         }
+        if trace_line is not None:
+            fleet_line["trace_overhead"] = trace_line
         if overload is not None:
             fleet_line["overload"] = {
                 k: (round(v, 3) if isinstance(v, float) else v)

@@ -37,6 +37,14 @@ from cyclegan_tpu.obs.telemetry import (
     Telemetry,
     make_telemetry,
 )
+from cyclegan_tpu.obs.trace import (
+    NULL_TRACE,
+    NullTraceContext,
+    NullTracer,
+    Span,
+    TraceContext,
+    Tracer,
+)
 from cyclegan_tpu.obs.watchdog import StallWatchdog
 
 __all__ = [
@@ -56,4 +64,10 @@ __all__ = [
     "NullTelemetry",
     "NULL_TELEMETRY",
     "make_telemetry",
+    "Tracer",
+    "NullTracer",
+    "TraceContext",
+    "NullTraceContext",
+    "NULL_TRACE",
+    "Span",
 ]

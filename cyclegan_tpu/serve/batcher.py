@@ -34,17 +34,22 @@ class Request:
     bucket, the future the caller holds, and the enqueue timestamp the
     latency accounting starts from. ``tier`` tags the engine program
     set the flush must run on ("base"/None or "int8") — flushes are
-    homogeneous in (size, tier)."""
+    homogeneous in (size, tier). ``trace`` optionally carries the
+    request's TraceContext; the executor records per-hop spans on it
+    from timestamps it already takes."""
 
-    __slots__ = ("image", "size", "future", "t_submit", "meta", "tier")
+    __slots__ = ("image", "size", "future", "t_submit", "meta", "tier",
+                 "trace")
 
-    def __init__(self, image, size: int, meta=None, tier=None):
+    def __init__(self, image, size: int, meta=None, tier=None,
+                 trace=None):
         self.image = image
         self.size = size
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         self.meta = meta
         self.tier = tier
+        self.trace = trace
 
 
 _STOP = object()

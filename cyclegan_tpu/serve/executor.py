@@ -96,25 +96,31 @@ class PipelinedExecutor:
         self._t_last: Optional[float] = None
 
     # -- submission (decode stage runs on the caller's thread) ------------
-    def submit_raw(self, img: np.ndarray, tier: Optional[str] = None) \
-            -> Future:
+    def submit_raw(self, img: np.ndarray, tier: Optional[str] = None,
+                   trace=None) -> Future:
         """Decode-side entry: uint8/float HWC image of any size ->
         preprocess into its resolution bucket, then queue."""
         size = self.engine.size_bucket(img.shape[0], img.shape[1])
-        return self.submit(preprocess_request(img, size), tier=tier)
+        return self.submit(preprocess_request(img, size), tier=tier,
+                           trace=trace)
 
-    def submit(self, image: np.ndarray, tier: Optional[str] = None) \
-            -> Future:
+    def submit(self, image: np.ndarray, tier: Optional[str] = None,
+               trace=None) -> Future:
         """Queue one preprocessed float32 [s, s, 3] image (s must be a
         resolution bucket). Returns a Future resolving to {"fake": ...}
         (+ "cycled" when the engine fuses the cycle pass). ``tier``
-        routes to an engine program set ("int8" = the quantized tier)."""
+        routes to an engine program set ("int8" = the quantized tier).
+        ``trace`` optionally carries a TraceContext; per-hop spans are
+        recorded on it from timestamps this pipeline already takes."""
         if self._closed:
             raise RuntimeError("executor is closed")
         size = int(image.shape[0])
         tier = self.engine.resolve_tier(tier)
-        return self._batcher_for(size, tier).submit(
-            Request(image, size, tier=tier))
+        req = Request(image, size, tier=tier, trace=trace)
+        if trace is not None:
+            # Ingress hop: mint -> enqueue (decode/preprocess/routing).
+            trace.span_done("admit", None, req.t_submit)
+        return self._batcher_for(size, tier).submit(req)
 
     def _batcher_for(self, size: int, tier: str = "base") -> MicroBatcher:
         with self._batcher_lock:
@@ -141,13 +147,15 @@ class PipelinedExecutor:
         try:
             t0 = time.perf_counter()
             x = np.stack([r.image for r in batch])
+            t_stacked = time.perf_counter()
             outs, n = self.engine.run(x, size=batch[0].size,
                                       tier=batch[0].tier)
             t_dispatched = time.perf_counter()
         except BaseException:
             self._inflight.release()
             raise
-        self._pending.put((batch, outs, n, trigger, t0, t_dispatched))
+        self._pending.put(
+            (batch, outs, n, trigger, t0, t_stacked, t_dispatched))
 
     # -- completion stage (D2H + future resolution) -----------------------
     def _complete_loop(self) -> None:
@@ -157,7 +165,7 @@ class PipelinedExecutor:
             item = self._pending.get()
             if item is _STOP:
                 return
-            batch, outs, n, trigger, t0, t_dispatched = item
+            batch, outs, n, trigger, t0, t_stacked, t_dispatched = item
             try:
                 t_fetch = time.perf_counter()
                 host = jax.device_get(outs)  # sanctioned-fetch: the pipeline's one deferred D2H per flush
@@ -167,6 +175,8 @@ class PipelinedExecutor:
                 for r in batch:
                     if not r.future.done():
                         r.future.set_exception(e)
+                    if r.trace is not None:
+                        r.trace.finish("error")
                 continue
             self._inflight.release()
             fake = host[0]
@@ -178,6 +188,24 @@ class PipelinedExecutor:
                     result["cycled"] = cycled[i]
                 if not r.future.done():
                     r.future.set_result(result)
+            t_resolved = time.perf_counter()
+            for r in batch:
+                if r.trace is None:
+                    continue
+                # Pure-host span recording from timestamps the pipeline
+                # took anyway; the "device" hop is t_dispatched->t_done,
+                # proven by the deferred fetch completing (stepclock
+                # argument) — zero extra syncs or dispatches.
+                ctx = r.trace
+                ctx.span_done("queue", r.t_submit, t0)
+                ctx.span_done("stack", t0, t_stacked)
+                ctx.span_done("submit", t_stacked, t_dispatched,
+                              n=n, trigger=trigger,
+                              tier=r.tier or "base")
+                ctx.span_done("device", t_dispatched, t_done,
+                              fetch_block_s=round(t_done - t_fetch, 6))
+                ctx.span_done("resolve", t_done, t_resolved)
+                ctx.finish("ok", t_end=t_resolved)
             # Rollup + per-flush event. Latency anchors at submit time,
             # so queue wait + batching wait + device + fetch all count.
             lats = [now - r.t_submit for r in batch]

@@ -81,7 +81,8 @@ class FleetRequest:
 
     __slots__ = ("image", "size", "tier", "tenant", "klass", "future",
                  "t_submit", "deadline", "shed", "attempts", "hedged",
-                 "is_hedge", "won", "result", "probe", "degraded_from")
+                 "is_hedge", "won", "result", "probe", "degraded_from",
+                 "trace")
 
     def __init__(self, image, size: int, tier: str,
                  klass: DeadlineClass, now: Optional[float] = None,
@@ -129,6 +130,10 @@ class FleetRequest:
         self.result = None
         self.probe = False
         self.degraded_from: Optional[str] = None
+        # Optional TraceContext minted at ingress; a hedge twin SHARES
+        # it (same trace_id), so both dispatch attempts land on one
+        # span graph.
+        self.trace = None
 
     def twin(self) -> "FleetRequest":
         """The hedge copy: same image, routing key (tenant included),
@@ -142,6 +147,7 @@ class FleetRequest:
         t.deadline = self.deadline
         t.future = self.future
         t.is_hedge = True
+        t.trace = self.trace
         return t
 
 
@@ -208,6 +214,11 @@ class AdmissionController:
                                 reason="rejected", depth=self._live,
                                 tenant=req.tenant or None,
                                 retry_after_s=round(retry, 3))
+                    if req.trace is not None:
+                        req.trace.event(
+                            "shed", reason="rejected", depth=self._live,
+                            retry_after_s=round(retry, 3))
+                        req.trace.finish("shed")
                     raise ShedError("rejected", retry, req.klass.name)
                 victim.shed = True
                 self._live -= 1
@@ -220,6 +231,11 @@ class AdmissionController:
                             tenant=victim.tenant or None,
                             hedge=victim.is_hedge,
                             retry_after_s=round(retry, 3))
+                if victim.trace is not None:
+                    victim.trace.event(
+                        "shed", reason="evicted", depth=self._live,
+                        evicted_for=req.klass.name,
+                        hedge=victim.is_hedge)
                 # A hedge twin shares its future with a primary that is
                 # still in flight — evicting the twin must only reclaim
                 # the slot, never fail the caller. Same for a future a
@@ -227,6 +243,8 @@ class AdmissionController:
                 if not victim.is_hedge and not victim.future.done():
                     victim.future.set_exception(
                         ShedError("evicted", retry, victim.klass.name))
+                    if victim.trace is not None:
+                        victim.trace.finish("shed")
             heapq.heappush(self._heap, (req.deadline, self._seq, req))
             self._seq += 1
             self._live += 1
@@ -350,6 +368,14 @@ class AdmissionController:
                 self._count_cancel("won_elsewhere")
                 self._event("fleet_hedge_cancel", klass=req.klass.name,
                             reason="won_elsewhere", depth=self._live)
+                if req.trace is not None:
+                    # The cancelled loser's queue residency, closed with
+                    # its outcome. Often arrives AFTER the winner already
+                    # finished the trace — trace.py then emits it as a
+                    # late supplement on the same trace_id.
+                    req.trace.span_done(
+                        "queued", req.t_submit, now,
+                        outcome="won_elsewhere", hedge=req.is_hedge)
                 continue
             if req.is_hedge and now > req.deadline:
                 # The expiry-asymmetry fix: a hedged request whose
@@ -361,6 +387,13 @@ class AdmissionController:
                 self._count_cancel("hedge_expired")
                 self._event("fleet_hedge_cancel", klass=req.klass.name,
                             reason="hedge_expired", depth=self._live)
+                if req.trace is not None:
+                    # Failure-shaped edge on an otherwise-ok request:
+                    # tail-keep so the expired twin is never invisible.
+                    req.trace.mark_tail()
+                    req.trace.span_done(
+                        "queued", req.t_submit, now,
+                        outcome="hedge_expired", hedge=True)
                 continue
             if now > req.deadline and req.klass.shed_rank > 0:
                 self._live -= 1
@@ -372,6 +405,10 @@ class AdmissionController:
                     req.future.set_exception(DeadlineExceeded(
                         f"class {req.klass.name} deadline passed while "
                         f"queued ({now - req.deadline:.3f}s late)"))
+                if req.trace is not None:
+                    req.trace.span_done(
+                        "queued", req.t_submit, now, outcome="expired")
+                    req.trace.finish("expired")
                 continue
             if (req.size, req.tier, req.tenant) != \
                     (head.size, head.tier, head.tenant):

@@ -496,16 +496,16 @@ class FleetExecutor:
     # -- submission --------------------------------------------------------
     def submit_raw(self, img: np.ndarray, klass: Optional[str] = None,
                    tier: Optional[str] = None,
-                   tenant: Optional[str] = None) -> Future:
+                   tenant: Optional[str] = None, trace=None) -> Future:
         """Decode-side entry: raw HWC image of any size -> bucket
         preprocess, class lookup, admission."""
         size = self.engine.size_bucket(img.shape[0], img.shape[1])
         return self.submit(preprocess_request(img, size), klass=klass,
-                           tier=tier, tenant=tenant)
+                           tier=tier, tenant=tenant, trace=trace)
 
     def submit(self, image: np.ndarray, klass: Optional[str] = None,
                tier: Optional[str] = None,
-               tenant: Optional[str] = None) -> Future:
+               tenant: Optional[str] = None, trace=None) -> Future:
         """Admit one preprocessed [s, s, 3] image under a deadline
         class. Raises ShedError when admission rejects it (HTTP 429 at
         the front-end); raises KeyError for an unknown class or tenant.
@@ -560,6 +560,21 @@ class FleetExecutor:
                     ck = census_key(k.name, browned)
                     self._degraded_census[ck] = \
                         self._degraded_census.get(ck, 0) + 1
+        if trace is not None:
+            req.trace = trace
+            trace.set("class", k.name)
+            trace.set("tier", req.tier)
+            if tkey:
+                trace.set("tenant", tkey)
+            if req.degraded_from is not None:
+                trace.set("degraded_from", req.degraded_from)
+                if self._brownout is not None:
+                    trace.set("brownout_level",
+                              self._brownout.snapshot().get("level"))
+            # Ingress hop: mint -> admission (decode, preprocess, class
+            # and tenant resolution) — so the hop chain tiles the whole
+            # request and per-hop sums reconcile with e2e latency.
+            trace.span_done("admit", None, req.t_submit)
         return self.admission.offer(req)
 
     # -- hot tenant swap ---------------------------------------------------
@@ -840,6 +855,11 @@ class FleetExecutor:
                 self.admission.offer(req.twin())
             except Exception:  # noqa: BLE001 — queue full/closed: the primary rides alone
                 continue
+            if req.trace is not None:
+                req.trace.event(
+                    "hedge", replica=replica.replica_id,
+                    age_ms=round((now - req.t_submit) * 1000.0, 3),
+                    hedge_ms=h_ms)
             with self._stats_lock:
                 self._n_hedges += 1
             if self._logger is not None:
@@ -970,13 +990,22 @@ class FleetExecutor:
                     f"burned {req.attempts}/"
                     f"{self.cfg.max_request_attempts} attempts"))
                 failed += 1
+                if req.trace is not None:
+                    req.trace.finish("error")
                 continue
             try:
                 self.admission.offer(req)
                 requeued += 1
+                if req.trace is not None:
+                    req.trace.event(
+                        "requeued", reason=reason,
+                        replica=replica.replica_id,
+                        attempts=req.attempts)
             except Exception as e:  # ShedError, or queue closed
                 req.future.set_exception(e)
                 failed += 1
+                if req.trace is not None:
+                    req.trace.finish("error")
         open_circuit = consecutive >= self.cfg.max_replica_failures
         respawned = False
         if open_circuit or self._closed:

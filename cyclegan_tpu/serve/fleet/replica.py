@@ -166,6 +166,7 @@ class ReplicaWorker:
             t0 = _now()
             try:
                 x = np.stack([r.image for r in batch])
+                t_stacked = _now()
                 outs, n = engine.run(x, size=batch[0].size,
                                      tier=batch[0].tier)
                 t_dispatched = _now()
@@ -178,6 +179,8 @@ class ReplicaWorker:
                 for r in batch:
                     if not r.future.done():
                         r.future.set_exception(e)
+                        if r.trace is not None:
+                            r.trace.finish("error")
                 continue
             t_done = _now()
             self.last_beat = t_done
@@ -198,11 +201,39 @@ class ReplicaWorker:
             # stage the next flush while this thread resolves futures.
             self._on_free(self)
             self._resolve(batch, host)
+            t_resolved = _now()
+            self._record_traces(batch, n, trigger, t0, t_stacked,
+                                t_dispatched, t_done, t_resolved)
             self.n_flushes += 1
             self.n_images += n
             if self._on_done is not None:
                 self._on_done(self, batch, n, trigger,
                               t0, t_dispatched, t_done)
+
+    def _record_traces(self, batch, n, trigger, t0, t_stacked,
+                       t_dispatched, t_done, t_resolved) -> None:
+        """Per-hop span recording for the requests THIS flush won.
+        Pure host arithmetic over timestamps the loop already took: the
+        "device" hop is t_dispatched->t_done, proven by the deferred
+        fetch completing (the stepclock argument) — tracing adds zero
+        device dispatches and zero syncs. Losing hedge copies record
+        nothing here; their queue residency closes at the admission
+        pop with ``won_elsewhere``."""
+        for r in batch:
+            ctx = r.trace
+            if ctx is None or not r.won:
+                continue
+            rid = self.replica_id
+            ctx.span_done("queue", r.t_submit, t0, replica=rid)
+            ctx.span_done("stack", t0, t_stacked, replica=rid)
+            ctx.span_done("submit", t_stacked, t_dispatched,
+                          replica=rid, n=n, trigger=trigger,
+                          tier=r.tier or "base")
+            ctx.span_done("device", t_dispatched, t_done, replica=rid,
+                          hedge=r.is_hedge)
+            ctx.span_done("resolve", t_done, t_resolved, replica=rid)
+            status = "deadline_miss" if t_done > r.deadline else "ok"
+            ctx.finish(status, t_end=t_resolved)
 
     @staticmethod
     def _resolve(batch: List[FleetRequest], host) -> None:

@@ -26,6 +26,19 @@ Endpoints:
                     readiness probe for a load balancer.
   GET  /stats       JSON snapshot: requests served, queue depths,
                     shed/class telemetry in fleet mode.
+  GET  /metrics     Prometheus text exposition (version 0.0.4),
+                    stdlib-rendered from the same stats() snapshot:
+                    queue depths, per-class/tenant latency quantiles,
+                    shed/hedge/brownout/scale counters, plus the
+                    span-derived per-hop latency histograms from
+                    --trace_sample tracing (obs/trace.py).
+
+Every POST reply carries an ``X-Trace-Id`` header (tracing always
+mints an id); with --trace_sample > 0 the matching span graph lands on
+--obs_jsonl as a ``trace`` event — feed a slice to
+tools/trace_timeline.py for a Perfetto timeline and a per-hop
+critical-path table. Shed/expired/errored requests are tail-kept even
+at --trace_sample 0, so the trace_id on a 429 always resolves.
 
 Run:
   python -m cyclegan_tpu.serve.server --output_dir runs --port 8080 \
@@ -61,12 +74,15 @@ class ServeApp:
     Works over either executor: PipelinedExecutor (single-replica
     pipeline) or FleetExecutor (admission-controlled replica fleet) —
     both expose the same public ``stats()`` snapshot, so the handler
-    never reaches into executor internals."""
+    never reaches into executor internals. ``tracer`` (obs/trace.py)
+    mints one TraceContext per POST; None disables tracing entirely."""
 
-    def __init__(self, executor, with_cycle: bool, fleet: bool = False):
+    def __init__(self, executor, with_cycle: bool, fleet: bool = False,
+                 tracer=None):
         self.executor = executor
         self.with_cycle = with_cycle
         self.fleet = fleet
+        self.tracer = tracer
         self.n_requests = 0
         self.n_errors = 0
         self.n_shed = 0
@@ -85,6 +101,174 @@ class ServeApp:
                "n_shed": self.n_shed, "fleet": self.fleet}
         out.update(self.executor.stats())
         return out
+
+
+def _prom_escape(value) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_labels(labels: Optional[dict]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_prom_escape(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def render_prometheus(stats: dict, tracer=None) -> str:
+    """Prometheus text exposition (version 0.0.4) rendered with the
+    stdlib from the executor's existing ``stats()`` snapshot plus the
+    tracer's span-derived hop histograms. Pure host-side dict reads —
+    no device interaction, safe to scrape at any frequency. Tolerant of
+    missing keys so one renderer covers both executors and any fleet
+    option subset."""
+    lines = []
+    seen_meta = set()
+
+    def emit(name, value, labels=None, help_=None, type_="gauge"):
+        if value is None:
+            return
+        if name not in seen_meta:
+            seen_meta.add(name)
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {type_}")
+        v = float(value)
+        out = int(v) if v == int(v) else round(v, 9)
+        lines.append(f"{name}{_prom_labels(labels)} {out}")
+
+    emit("cyclegan_serve_requests_total", stats.get("n_requests"),
+         help_="HTTP requests handled", type_="counter")
+    emit("cyclegan_serve_errors_total", stats.get("n_errors"),
+         type_="counter")
+    emit("cyclegan_serve_shed_total", stats.get("n_shed"),
+         help_="HTTP requests answered 429/503 (shed or expired)",
+         type_="counter")
+    emit("cyclegan_serve_images_done_total", stats.get("n_images_done"),
+         type_="counter")
+    emit("cyclegan_serve_flushes_total", stats.get("n_flushes"),
+         type_="counter")
+
+    # Pipeline (single-replica) executor: per-bucket queue depths.
+    for bucket, depth in sorted(
+            (stats.get("queue_depths") or {}).items()):
+        emit("cyclegan_serve_queue_depth", depth,
+             labels={"bucket": bucket},
+             help_="live micro-batcher queue depth per (size, tier)")
+    emit("cyclegan_serve_queue_depth_max",
+         stats.get("max_queue_depth"))
+
+    # Fleet admission queue.
+    adm = stats.get("admission") or {}
+    emit("cyclegan_fleet_queue_depth", adm.get("depth"),
+         help_="live admission queue depth")
+    emit("cyclegan_fleet_queue_capacity", adm.get("capacity"))
+    emit("cyclegan_fleet_queue_depth_max", adm.get("max_depth"))
+    emit("cyclegan_fleet_drain_rate", adm.get("drain_rate"),
+         help_="drain-rate EWMA, images/sec")
+    emit("cyclegan_fleet_arrival_rate", adm.get("arrival_rate"))
+    emit("cyclegan_fleet_retry_after_seconds", adm.get("retry_after_s"))
+    for klass, n in sorted((adm.get("admitted") or {}).items()):
+        emit("cyclegan_fleet_admitted_total", n,
+             labels={"class": klass}, type_="counter")
+    for klass, n in sorted((adm.get("shed") or {}).items()):
+        emit("cyclegan_fleet_shed_total", n,
+             labels={"class": klass},
+             help_="requests shed (rejected + evicted + expired)",
+             type_="counter")
+    for reason, n in sorted((adm.get("shed_reasons") or {}).items()):
+        emit("cyclegan_fleet_shed_reason_total", n,
+             labels={"reason": reason}, type_="counter")
+    for reason, n in sorted((adm.get("cancelled") or {}).items()):
+        emit("cyclegan_fleet_hedge_cancel_total", n,
+             labels={"reason": reason}, type_="counter")
+
+    # Per-class latency (summary-style quantile gauges) + misses.
+    for klass, row in sorted((stats.get("classes") or {}).items()):
+        for q, key in (("0.5", "p50_s"), ("0.95", "p95_s")):
+            emit("cyclegan_fleet_latency_seconds", row.get(key),
+                 labels={"class": klass, "quantile": q},
+                 help_="resolved-request e2e latency by deadline class",
+                 type_="summary")
+        emit("cyclegan_fleet_deadline_misses_total",
+             row.get("deadline_misses"), labels={"class": klass},
+             type_="counter")
+
+    # Fleet shape / self-driving overlay counters.
+    emit("cyclegan_fleet_replicas", stats.get("n_replicas"))
+    emit("cyclegan_fleet_replicas_active",
+         stats.get("n_replicas_active"))
+    emit("cyclegan_fleet_replicas_busy", stats.get("replicas_busy"))
+    emit("cyclegan_fleet_circuits_open", stats.get("circuits_open"))
+    emit("cyclegan_fleet_recoveries_total", stats.get("recoveries"),
+         type_="counter")
+    hedges = stats.get("hedges") or {}
+    for key in ("dispatched", "wins", "losses"):
+        emit("cyclegan_fleet_hedges_total", hedges.get(key),
+             labels={"outcome": key}, type_="counter")
+    emit("cyclegan_fleet_degraded_total",
+         stats.get("degraded_requests"),
+         help_="requests served on a browned-out tier",
+         type_="counter")
+    quar = stats.get("quarantine") or {}
+    for key in ("quarantined", "readmitted", "condemned"):
+        emit("cyclegan_fleet_quarantine_total", quar.get(key),
+             labels={"action": key}, type_="counter")
+    auto = stats.get("autoscale") or {}
+    emit("cyclegan_fleet_scale_ups_total", auto.get("scale_ups"),
+         type_="counter")
+    emit("cyclegan_fleet_scale_downs_total", auto.get("scale_downs"),
+         type_="counter")
+    brown = stats.get("brownout") or {}
+    emit("cyclegan_fleet_brownout_level", brown.get("level"),
+         help_="current brownout cascade level (0 = full quality)")
+
+    # Per-tenant rollup.
+    for tkey, row in sorted((stats.get("tenants") or {}).items()):
+        labels = {"tenant": tkey}
+        for q, key in (("0.5", "p50_s"), ("0.95", "p95_s")):
+            emit("cyclegan_tenant_latency_seconds", row.get(key),
+                 labels=dict(labels, quantile=q), type_="summary")
+        emit("cyclegan_tenant_images_total", row.get("n_images"),
+             labels=labels, type_="counter")
+        emit("cyclegan_tenant_slo_misses_total", row.get("slo_misses"),
+             labels=labels, type_="counter")
+
+    # Span-derived hop histograms (obs/trace.py).
+    if tracer is not None:
+        tstats = tracer.stats()
+        emit("cyclegan_trace_sample", tstats.get("sample"),
+             help_="head-sampling fraction (--trace_sample)")
+        for key in ("traces", "emitted", "tail", "late"):
+            emit(f"cyclegan_trace_{key}_total", tstats.get(key),
+                 type_="counter")
+        from cyclegan_tpu.obs.trace import HIST_BUCKETS_S
+
+        hists = sorted(tracer.hop_histograms().items())
+        if hists:
+            name = "cyclegan_trace_hop_seconds"
+            lines.append(f"# HELP {name} per-hop span durations "
+                         f"(seconds), from finished traces")
+            lines.append(f"# TYPE {name} histogram")
+            for hop, h in hists:
+                cum = 0
+                for edge, n in zip(HIST_BUCKETS_S, h["buckets"]):
+                    cum += n
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_prom_labels({'hop': hop, 'le': repr(edge)})}"
+                        f" {cum}")
+                cum += h["buckets"][-1]
+                lines.append(
+                    f"{name}_bucket"
+                    f"{_prom_labels({'hop': hop, 'le': '+Inf'})} {cum}")
+                lines.append(
+                    f"{name}_sum{_prom_labels({'hop': hop})} "
+                    f"{round(h['sum'], 9)}")
+                lines.append(
+                    f"{name}_count{_prom_labels({'hop': hop})} {cum}")
+    return "\n".join(lines) + "\n"
 
 
 def _decode_upload(body: bytes) -> np.ndarray:
@@ -115,10 +299,13 @@ def make_handler(app: ServeApp):
             pass
 
         def _reply(self, code: int, body: bytes,
-                   ctype: str = "application/json") -> None:
+                   ctype: str = "application/json",
+                   headers: Optional[dict] = None) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for key, value in (headers or {}).items():
+                self.send_header(key, value)
             self.end_headers()
             self.wfile.write(body)
 
@@ -127,6 +314,11 @@ def make_handler(app: ServeApp):
                 self._reply(200, b'{"status": "ok"}')
             elif self.path == "/stats":
                 self._reply(200, json.dumps(app.stats()).encode())
+            elif self.path == "/metrics":
+                body = render_prometheus(app.stats(),
+                                         app.tracer).encode()
+                self._reply(200, body,
+                            ctype="text/plain; version=0.0.4")
             else:
                 self._reply(404, b'{"error": "not found"}')
 
@@ -140,6 +332,15 @@ def make_handler(app: ServeApp):
             tier = q.get("tier", [None])[0]
             klass = q.get("class", [None])[0]
             tenant = q.get("tenant", [None])[0]
+            # Mint the trace at ingress, before decode — the "admit"
+            # hop recorded at submission then covers decode/preprocess.
+            # The id is echoed on EVERY reply (X-Trace-Id), so a client
+            # holding a 429 can hand support the exact trace whose shed
+            # decision explains it.
+            ctx = (app.tracer.trace("request")
+                   if app.tracer is not None else None)
+            hdrs = ({"X-Trace-Id": ctx.trace_id}
+                    if ctx is not None else None)
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 img = _decode_upload(self.rfile.read(length))
@@ -150,13 +351,15 @@ def make_handler(app: ServeApp):
                 if app.fleet:
                     fut = app.executor.submit_raw(img, klass=klass,
                                                   tier=tier,
-                                                  tenant=tenant)
+                                                  tenant=tenant,
+                                                  trace=ctx)
                 elif tenant is not None:
                     raise KeyError(
                         "?tenant= requires fleet mode with configured "
                         "tenants (--fleet N --tenant ...)")
                 else:
-                    fut = app.executor.submit_raw(img, tier=tier)
+                    fut = app.executor.submit_raw(img, tier=tier,
+                                                  trace=ctx)
                 result = fut.result(timeout=120)
                 if want_panel and "cycled" in result:
                     size = result["fake"].shape[0]
@@ -169,7 +372,12 @@ def make_handler(app: ServeApp):
                 else:
                     body = _encode_png(result["fake"])
                 app.count()
-                self._reply(200, body, ctype="image/png")
+                if ctx is not None:
+                    # Safety net only: the pipeline's completion path
+                    # already finished the trace (first finish wins).
+                    ctx.finish("ok")
+                self._reply(200, body, ctype="image/png",
+                            headers=hdrs)
             except Exception as e:  # noqa: BLE001 — a request must not kill the server
                 # admission.py has no engine/jax dependency, so this
                 # import is cheap even on the error path.
@@ -182,46 +390,61 @@ def make_handler(app: ServeApp):
                     # Load shed: tell the client when to come back
                     # instead of letting it pile onto the queue.
                     app.count(shed=True)
+                    if ctx is not None:
+                        ctx.finish("shed")
                     body = json.dumps({
                         "error": "overloaded",
                         "reason": e.reason,
                         "class": e.klass,
                         "retry_after_s": round(e.retry_after_s, 3),
+                        "trace_id": ctx.trace_id if ctx else None,
                     }).encode()
                     self.send_response(429)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Retry-After",
                                      str(max(1, int(e.retry_after_s))))
+                    if ctx is not None:
+                        self.send_header("X-Trace-Id", ctx.trace_id)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
                 elif isinstance(e, DeadlineExceeded):
                     app.count(shed=True)
+                    if ctx is not None:
+                        ctx.finish("expired")
                     self._reply(503, json.dumps(
                         {"error": "deadline exceeded in queue",
-                         "detail": str(e)}).encode())
+                         "detail": str(e)}).encode(), headers=hdrs)
                 elif isinstance(e, KeyError):
                     # Unknown ?class= / ?tenant=: the client named a
                     # routing identity the fleet doesn't have — their
                     # mistake, not an overload or a server fault.
                     app.count(error=True)
+                    if ctx is not None:
+                        ctx.finish("error")
                     self._reply(400, json.dumps(
-                        {"error": str(e).strip("'\"")}).encode())
+                        {"error": str(e).strip("'\"")}).encode(),
+                        headers=hdrs)
                 else:
                     app.count(error=True)
+                    if ctx is not None:
+                        ctx.finish("error")
                     self._reply(500, json.dumps(
-                        {"error": f"{type(e).__name__}: {e}"}).encode())
+                        {"error": f"{type(e).__name__}: {e}"}).encode(),
+                        headers=hdrs)
 
     return Handler
 
 
 def make_server(executor, host: str = "127.0.0.1", port: int = 0,
-                with_cycle: bool = False, fleet: bool = False):
+                with_cycle: bool = False, fleet: bool = False,
+                tracer=None):
     """Build (but do not start) the HTTP server; port 0 picks a free
     one (server.server_address reports it). Returns (server, app).
     ``fleet=True`` routes ?class=/?tier= through FleetExecutor.submit
-    and maps shed requests to 429 + Retry-After."""
-    app = ServeApp(executor, with_cycle, fleet=fleet)
+    and maps shed requests to 429 + Retry-After. ``tracer`` enables
+    per-request tracing (X-Trace-Id echo + /metrics hop histograms)."""
+    app = ServeApp(executor, with_cycle, fleet=fleet, tracer=tracer)
     server = ThreadingHTTPServer((host, port), make_handler(app))
     server.daemon_threads = True
     return server, app
@@ -305,6 +528,14 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--obs_jsonl", default=None,
                    help="telemetry stream path (PR-1 schema; fold with "
                         "tools/obs_report.py)")
+    p.add_argument("--trace_sample", default=0.0, type=float,
+                   help="head-sampling fraction of requests to trace "
+                        "end to end (0..1). Failures (shed/expired/"
+                        "deadline-miss/error) are ALWAYS tail-kept "
+                        "regardless. Kept traces land on --obs_jsonl "
+                        "as 'trace' events (timeline via "
+                        "tools/trace_timeline.py); /metrics exposes "
+                        "span-derived hop histograms either way")
     args = p.parse_args(argv)
 
     from cyclegan_tpu.utils.axon_compat import cli_startup
@@ -470,9 +701,17 @@ def main(argv: Optional[list] = None) -> None:
     else:
         executor = PipelinedExecutor(engine, max_wait_ms=args.max_wait_ms,
                                      logger=logger)
+    # The tracer is ALWAYS built: without --obs_jsonl kept traces go
+    # nowhere (NullMetricsLogger), but /metrics hop histograms and the
+    # X-Trace-Id echo still work. --trace_sample sizes the head sample;
+    # failures tail-keep regardless.
+    from cyclegan_tpu.obs import NullMetricsLogger, Tracer
+
+    tracer = Tracer(logger if logger is not None else NullMetricsLogger(),
+                    sample=args.trace_sample)
     server, _app = make_server(executor, args.host, args.port,
                                with_cycle=args.panels,
-                               fleet=args.fleet > 0)
+                               fleet=args.fleet > 0, tracer=tracer)
     host, port = server.server_address[:2]
     mode = (f"fleet x{args.fleet} (capacity {args.capacity})"
             if args.fleet > 0 else "pipelined")

@@ -1,0 +1,457 @@
+"""Request-scoped distributed tracing (cyclegan_tpu/obs/trace.py):
+head/tail sampling, span parenting across hedge twins, the fleet's
+hop-tiling invariant (hop sum == e2e by construction), the zero-cost
+pin (tracing adds no device dispatches), the X-Trace-Id HTTP echo,
+Perfetto export schema on a pinned fixture, /metrics exposition, and
+the obs_report unknown-kind census.
+
+All fleet-level tests run against the FakeEngine control-plane double
+(no compiles); the fixture stream in tests/data/trace_fixture.jsonl is
+pinned so the Perfetto/critical-path assertions are deterministic.
+"""
+
+import io
+import json
+import os
+import re
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from cyclegan_tpu.obs import (  # noqa: E402
+    NULL_TRACE,
+    NullTracer,
+    Tracer,
+)
+from cyclegan_tpu.serve.fleet import (  # noqa: E402
+    FleetConfig,
+    FleetExecutor,
+    ShedError,
+)
+from cyclegan_tpu.serve.fleet.admission import FleetRequest  # noqa: E402
+
+from test_fleet import CLASSES, FakeEngine  # noqa: E402
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "data", "trace_fixture.jsonl")
+
+HOP_NAMES = {"admit", "queue", "stack", "submit", "device", "resolve"}
+
+
+class CapLogger:
+    """MetricsLogger-shaped capture: the tracer only needs .event()."""
+
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+
+    def event(self, kind, /, **fields):
+        with self._lock:
+            self.events.append({"event": kind, **fields})
+
+    def flush(self):
+        pass
+
+    def traces(self):
+        with self._lock:
+            return [e for e in self.events if e["event"] == "trace"]
+
+
+def _img(size=32):
+    return np.zeros((size, size, 3), np.float32)
+
+
+def _fleet(engine, **kw):
+    cfg = dict(n_replicas=1, capacity=64, max_batch=4, max_wait_ms=2.0)
+    cfg.update(kw)
+    return FleetExecutor(engine, FleetConfig(**cfg))
+
+
+# -- sampling ---------------------------------------------------------------
+
+def test_tracer_rejects_out_of_range_sample():
+    for bad in (-0.1, 1.5):
+        with pytest.raises(ValueError):
+            Tracer(sample=bad)
+
+
+def test_head_sampling_keeps_ok_traces_only_when_sampled():
+    cap = CapLogger()
+    t1 = Tracer(cap, sample=1.0)
+    ctx = t1.trace("request")
+    ctx.span_done("admit", None, ctx.root.t_start + 0.001)
+    ctx.finish("ok")
+    assert ctx.kept
+    assert len(cap.traces()) == 1
+    assert cap.traces()[0]["trace_id"] == ctx.trace_id
+    assert re.fullmatch(r"[0-9a-f]{16}", ctx.trace_id)
+
+    t0 = Tracer(cap, sample=0.0)
+    ctx = t0.trace("request")
+    ctx.finish("ok")
+    assert not ctx.kept
+    assert len(cap.traces()) == 1  # unchanged
+    s = t0.stats()
+    assert s["traces"] == 1 and s["emitted"] == 0
+
+
+def test_failures_are_tail_sampled_at_sample_zero():
+    cap = CapLogger()
+    tr = Tracer(cap, sample=0.0)
+    for status in ("shed", "expired", "deadline_miss", "error"):
+        ctx = tr.trace("request")
+        ctx.finish(status)
+        assert ctx.kept, status
+    kept = cap.traces()
+    assert [e["status"] for e in kept] == ["shed", "expired",
+                                           "deadline_miss", "error"]
+    assert all(e["tail"] for e in kept)
+    assert tr.stats()["tail"] == 4
+
+
+def test_mark_tail_keeps_an_ok_trace_at_sample_zero():
+    cap = CapLogger()
+    tr = Tracer(cap, sample=0.0)
+    ctx = tr.trace("request")
+    ctx.mark_tail()  # hedge twin expired at pop while the primary won
+    ctx.finish("ok")
+    assert ctx.kept and cap.traces()[0]["status"] == "ok"
+
+
+def test_first_finish_wins_and_late_spans_supplement():
+    cap = CapLogger()
+    tr = Tracer(cap, sample=1.0)
+    ctx = tr.trace("request")
+    assert ctx.finish("ok") is True
+    assert ctx.finish("error") is False  # safety-net double finish
+    assert cap.traces()[0]["status"] == "ok"
+    # A span recorded after the flush (the cancelled hedge twin) lands
+    # as a late=True supplement sharing the trace_id.
+    t0 = ctx.root.t_start
+    ctx.span_done("queued", t0, t0 + 0.005, outcome="won_elsewhere")
+    late = [e for e in cap.traces() if e.get("late")]
+    assert len(late) == 1
+    assert late[0]["trace_id"] == ctx.trace_id
+    assert late[0]["spans"][0]["name"] == "queued"
+    assert tr.stats()["late"] == 1
+
+
+def test_null_tracer_is_inert():
+    nt = NullTracer()
+    ctx = nt.trace("request")
+    assert ctx is NULL_TRACE
+    ctx.span_done("queue", 0.0, 1.0).end()
+    ctx.event("shed")
+    ctx.mark_tail()
+    assert ctx.finish("error") is False
+    assert nt.hop_histograms() == {}
+    s = nt.stats()
+    assert s.get("traces", 0) == 0 and s.get("emitted", 0) == 0
+
+
+# -- hedge twins ------------------------------------------------------------
+
+def test_hedge_twin_shares_the_trace_context():
+    tr = Tracer(CapLogger(), sample=1.0)
+    req = FleetRequest(_img(), 32, "base", CLASSES["interactive"])
+    req.trace = tr.trace("request")
+    twin = req.twin()
+    assert twin.is_hedge and twin.trace is req.trace
+    # Both copies' spans land on one trace_id: record from "each side".
+    t0 = req.trace.root.t_start
+    req.trace.span_done("device", t0, t0 + 0.001, replica=0, hedge=False)
+    twin.trace.span_done("queued", t0, t0 + 0.002,
+                         outcome="won_elsewhere", hedge=True)
+    req.trace.finish("ok")
+    spans = tr._logger.traces()[0]["spans"]
+    assert {s["name"] for s in spans} == {"device", "queued"}
+    # Parenting: every hop is a child of the root (id 0).
+    assert all(s["parent"] == 0 for s in spans)
+
+
+# -- fleet end-to-end -------------------------------------------------------
+
+def test_fleet_spans_tile_the_request_interval():
+    cap = CapLogger()
+    tr = Tracer(cap, sample=1.0)
+    eng = FakeEngine(buckets=(1, 4))
+    fleet = _fleet(eng)
+    try:
+        futs = []
+        for _ in range(8):
+            ctx = tr.trace("request")
+            futs.append(fleet.submit_raw(_img(), klass="batch",
+                                         trace=ctx))
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        fleet.close()
+    kept = [e for e in cap.traces() if not e.get("late")]
+    assert len(kept) == 8
+    for ev in kept:
+        assert ev["status"] == "ok"
+        assert (ev.get("attrs") or {}).get("class") == "batch"
+        names = [s["name"] for s in ev["spans"]]
+        assert set(names) == HOP_NAMES
+        assert all(s["parent"] == 0 for s in ev["spans"])
+        # The hops tile [t_start, t_end]: their sum reconciles with the
+        # e2e duration by construction (<< the 5% acceptance bound;
+        # tolerance only covers the 6-dp rounding in to_dict).
+        hop_sum = sum(s["t1"] - s["t0"] for s in ev["spans"])
+        assert ev["dur_s"] > 0
+        assert abs(hop_sum - ev["dur_s"]) <= 1e-5 + 0.001 * ev["dur_s"]
+    # Hop histograms feed /metrics: every hop folded, counts match.
+    hists = tr.hop_histograms()
+    assert set(hists) >= HOP_NAMES | {"request"}
+    assert hists["device"]["count"] == 8
+
+
+def test_tracing_adds_zero_device_dispatches():
+    """The overhead pin: the same submission pattern traced at sample
+    1.0 and untraced must produce IDENTICAL flush counts — tracing is
+    pure host arithmetic on timestamps the pipeline already takes."""
+    flushes = {}
+    for label, tracer in (("untraced", None),
+                          ("traced", Tracer(CapLogger(), sample=1.0))):
+        eng = FakeEngine(buckets=(1, 4))
+        fleet = _fleet(eng)
+        try:
+            for _ in range(2):  # two full batches, gapped deterministically
+                futs = []
+                for _ in range(4):
+                    kw = {}
+                    if tracer is not None:
+                        kw["trace"] = tracer.trace("request")
+                    futs.append(fleet.submit_raw(_img(), klass="batch",
+                                                 **kw))
+                for f in futs:
+                    f.result(timeout=30)
+        finally:
+            fleet.close()
+        flushes[label] = len(eng.flushes)
+    assert flushes["traced"] == flushes["untraced"]
+
+
+def test_shed_trace_is_kept_at_sample_zero_through_the_fleet():
+    cap = CapLogger()
+    tr = Tracer(cap, sample=0.0)
+    eng = FakeEngine(buckets=(1,))
+    eng.gate = threading.Event()
+    fleet = _fleet(eng, capacity=1, max_batch=1, max_wait_ms=0.0)
+    try:
+        pinned = fleet.submit_raw(_img(), klass="best_effort")
+        assert eng.entered.wait(timeout=10)
+        queued = fleet.submit_raw(_img(), klass="best_effort")
+        ctx = tr.trace("request")
+        with pytest.raises(ShedError):
+            fleet.submit_raw(_img(), klass="best_effort", trace=ctx)
+        eng.gate.set()
+        pinned.result(timeout=30)
+        queued.result(timeout=30)
+    finally:
+        fleet.close()
+    kept = cap.traces()
+    assert len(kept) == 1
+    ev = kept[0]
+    assert ev["status"] == "shed" and ev["tail"] and not ev["sampled"]
+    sheds = [e for e in ev.get("events") or [] if e["name"] == "shed"]
+    assert sheds and sheds[0]["reason"] == "rejected"
+
+
+# -- HTTP: X-Trace-Id + /metrics -------------------------------------------
+
+def test_http_x_trace_id_and_metrics_exposition():
+    import urllib.request
+
+    from cyclegan_tpu.serve.server import make_server
+
+    cap = CapLogger()
+    tr = Tracer(cap, sample=1.0)
+    eng = FakeEngine(buckets=(1, 4))
+    fleet = _fleet(eng)
+    server, app = make_server(fleet, port=0, fleet=True, tracer=tr)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        buf = io.BytesIO()
+        np.save(buf, np.zeros((32, 32, 3), np.uint8))
+        req = urllib.request.Request(
+            f"http://{host}:{port}/translate?class=interactive",
+            data=buf.getvalue(), method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            trace_id = r.headers["X-Trace-Id"]
+        assert re.fullmatch(r"[0-9a-f]{16}", trace_id)
+        # The echoed id resolves to an emitted span graph.
+        by_id = {e["trace_id"]: e for e in cap.traces()}
+        assert trace_id in by_id
+        assert {s["name"] for s in by_id[trace_id]["spans"]} == HOP_NAMES
+
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=30) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+    finally:
+        server.shutdown()
+        fleet.close()
+
+    # Prometheus text exposition 0.0.4: every sample line parses, HELP/
+    # TYPE comments name real families, histogram buckets are cumulative.
+    sample_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+        r"(?:[-+]?(?:\d+\.?\d*(?:[eE][-+]?\d+)?|nan|inf))$")
+    families = set()
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# TYPE "):
+            name, kind = line.split()[2:4]
+            assert name not in families, f"duplicate TYPE for {name}"
+            families.add(name)
+            assert kind in ("counter", "gauge", "summary", "histogram")
+            continue
+        if line.startswith("#"):
+            continue
+        assert sample_re.match(line), f"unparseable sample line: {line!r}"
+    assert "cyclegan_serve_requests_total" in families
+    assert "cyclegan_trace_sample" in families
+    assert "cyclegan_trace_hop_seconds" in families
+    # Cumulative buckets: the device hop's +Inf count equals _count.
+    bucket_lines = [ln for ln in text.split("\n")
+                    if ln.startswith("cyclegan_trace_hop_seconds_bucket")
+                    and 'hop="device"' in ln]
+    assert bucket_lines, "no device-hop histogram buckets"
+    counts = [float(ln.rsplit(" ", 1)[1]) for ln in bucket_lines]
+    assert counts == sorted(counts), "histogram buckets not cumulative"
+    inf_line = [ln for ln in bucket_lines if 'le="+Inf"' in ln]
+    count_line = [ln for ln in text.split("\n")
+                  if ln.startswith("cyclegan_trace_hop_seconds_count")
+                  and 'hop="device"' in ln]
+    assert inf_line and count_line
+    assert (inf_line[0].rsplit(" ", 1)[1]
+            == count_line[0].rsplit(" ", 1)[1])
+
+
+# -- Perfetto export on the pinned fixture ---------------------------------
+
+def test_trace_timeline_folds_fixture_with_late_supplement():
+    import trace_timeline
+
+    traces = trace_timeline.load_traces(FIXTURE)
+    assert len(traces) == 3  # the late event merged, not a 4th trace
+    by_id = {t["trace_id"]: t for t in traces}
+    hedged = by_id["bbbb0000111122223333444455556666"]
+    assert any(s["name"] == "queued" for s in hedged["spans"])
+    assert len(hedged["spans"]) == 7
+
+
+def test_trace_timeline_perfetto_schema_on_fixture(tmp_path):
+    import trace_timeline
+
+    out = tmp_path / "trace.perfetto.json"
+    rc = trace_timeline.main([FIXTURE, "--out", str(out), "--json"])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    assert doc["displayTimeUnit"] == "ms"
+    names_by_tid = {}
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] in ("X", "M", "i")
+        assert ev["pid"] == 1 and isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0 and ev["ts"] >= 0
+            assert "name" in ev
+        elif ev["ph"] == "M" and ev["name"] == "thread_name":
+            names_by_tid[ev["tid"]] = ev["args"]["name"]
+        elif ev["ph"] == "i":
+            assert ev["s"] == "t"
+    tracks = set(names_by_tid.values())
+    assert {"requests", "queue", "hedge lane",
+            "replica 0", "replica 1"} <= tracks
+    # Hop slices land on their replica's track; hedged work (the
+    # winning twin's device hop, the cancelled twin's queue residency)
+    # lands on the hedge lane.
+    tid_of = {v: k for k, v in names_by_tid.items()}
+    hops = [ev for ev in doc["traceEvents"] if ev.get("cat") == "hop"]
+    assert any(ev["tid"] == tid_of["replica 1"] and ev["name"] == "queue"
+               for ev in hops)
+    assert any(ev["tid"] == tid_of["replica 0"] and ev["name"] == "device"
+               for ev in hops)
+    for name in ("device", "queued"):  # the hedged pair from trace bbbb
+        assert any(ev["tid"] == tid_of["hedge lane"]
+                   and ev["name"] == name for ev in hops)
+
+
+def test_trace_timeline_critical_path_reconciles_on_fixture():
+    import trace_timeline
+
+    table = trace_timeline.critical_path(trace_timeline.load_traces(FIXTURE))
+    assert set(table) == {"class=interactive tenant=-",
+                          "class=batch tenant=-",
+                          "class=best_effort tenant=-"}
+    for label in ("class=interactive tenant=-", "class=batch tenant=-"):
+        g = table[label]
+        # The acceptance bound: per-request hop sum within 5% of e2e.
+        assert g["recon_frac"] is not None and g["recon_frac"] <= 0.05
+        assert set(g["hops"]) >= {"admit", "queue", "device"}
+    rendered = trace_timeline.render_table(table)
+    assert "reconciliation" in rendered
+
+
+def test_trace_timeline_empty_stream_exits_nonzero(tmp_path, capsys):
+    import trace_timeline
+
+    p = tmp_path / "empty.jsonl"
+    p.write_text('{"event": "manifest", "t": 0.0}\n')
+    assert trace_timeline.main([str(p)]) == 1
+
+
+# -- obs_report: trace section + unknown-kind census ------------------------
+
+def test_obs_report_names_unknown_kinds_and_folds_traces(tmp_path):
+    import obs_report
+
+    events, skipped = obs_report.load_events(FIXTURE)
+    lines = events + [{"event": "from_the_future", "t": 9.9},
+                      {"event": "from_the_future", "t": 9.95}]
+    report = obs_report.fold(lines, skipped)
+    # The satellite contract: an unrecognized kind is counted and NAMED
+    # in the render, never silently dropped.
+    assert report["unknown_kinds"] == {"from_the_future": 2}
+    text = obs_report.render(report)
+    assert "unknown event kinds" in text
+    assert "from_the_future x2" in text
+    roll = report["trace_rollup"]
+    assert roll["n_traces"] == 3 and roll["n_late_supplements"] == 1
+    assert roll["statuses"] == {"ok": 2, "shed": 1}
+    assert roll["n_tail_kept"] == 1
+    assert roll["slowest"][0]["dur_ms"] == 18.0
+    assert "-- request traces (3 kept" in text
+    assert "bbbb0000111122223333444455556666" in text
+
+
+def test_obs_report_serving_stream_without_traces_renders_absent():
+    import obs_report
+
+    stream = [{"event": "fleet_flush", "t": 0.1, "n": 2, "trigger": "full",
+               "replica": 0}]
+    text = obs_report.render(obs_report.fold(stream))
+    assert "request traces: absent" in text
+
+
+# -- static discipline ------------------------------------------------------
+
+def test_no_sync_scan_covers_trace_module():
+    from check_no_sync import hot_path_entries, run_check
+
+    entries = dict(hot_path_entries())
+    # obs/ expands into the hot path with zero sanctioned fetches: the
+    # tracer must stay pure host arithmetic.
+    assert entries.get("cyclegan_tpu/obs/trace.py") is False
+    assert run_check() == []

@@ -148,6 +148,11 @@ def build_queue(mode: str, round_tag: str = ROUND_TAG) -> list:
     if mode == "local_compile":
         env = {"PALLAS_AXON_POOL_IPS": "", "CYCLEGAN_AXON_LOCAL_COMPILE": "1"}
     sweeps = os.path.join("docs", "bench_sweeps.json")
+    # serve_sweep's telemetry stream + Perfetto export stage OUTSIDE
+    # the repo (like the profiler trace run); serve_trace collects the
+    # keeper slice into the round's chip_logs dir.
+    serve_obs = f"/tmp/chip_serve_obs_{round_tag}.jsonl"
+    serve_perfetto = f"/tmp/chip_serve_trace_{round_tag}.perfetto.json"
     q = [
         # Static-discipline preflight: graftlint over the whole tree
         # (donation-aliasing, no-sync, tracer-leak, compile-site census
@@ -226,14 +231,35 @@ def build_queue(mode: str, round_tag: str = ROUND_TAG) -> list:
               "scan:b4k2zeroi512"], 3600.0, env=env, artifacts=[sweeps]),
         # Serving open-loop sweep on chip (ROADMAP serving item): the
         # bench_serve contract — serial baseline, saturated pipeline,
-        # offered-load curve, fleet/int8 tiers — lands as one JSON line,
-        # validated before commit like the bench steps. Budget covers
-        # the serve-program compiles (cache_warm pre-warms them) plus
-        # the sweep itself.
+        # offered-load curve, fleet/int8 tiers, trace_overhead — lands
+        # as one JSON line, validated before commit like the bench
+        # steps. Budget covers the serve-program compiles (cache_warm
+        # pre-warms them) plus the sweep itself. The telemetry stream
+        # (incl. the trace_overhead phase's span graphs at sample=1.0)
+        # goes to /tmp; the serve_trace step below folds it.
         Step("serve_sweep", [py, "bench_serve.py"], 3600.0,
-             env={**env, "BENCH_SERVE_TIME_BUDGET_S": "1800"},
+             env={**env, "BENCH_SERVE_TIME_BUDGET_S": "1800",
+                  "BENCH_OBS_JSONL": serve_obs},
              stdout_to=os.path.join(
                  "docs", f"bench_serve_{round_tag}_onchip.json")),
+        # Archive the round's request traces next to the bench JSON:
+        # the critical-path table (per class/tenant per-hop p50/p95 +
+        # hop-sum-vs-e2e reconciliation) commits via stdout_to, and the
+        # Perfetto timeline + the raw trace slice collect into the
+        # round's chip_logs dir — a latency regression three rounds
+        # later diffs against THESE spans, not a rerun.
+        Step("serve_trace",
+             [py, "tools/trace_timeline.py", serve_obs,
+              "--out", serve_perfetto, "--json"], 300.0, env=env,
+             collect=[(serve_perfetto,
+                       os.path.join("docs", "chip_logs", round_tag,
+                                    "serve_trace.perfetto.json")),
+                      (serve_obs,
+                       os.path.join("docs", "chip_logs", round_tag,
+                                    "serve_obs.jsonl"))],
+             stdout_to=os.path.join(
+                 "docs", "chip_logs", round_tag,
+                 "serve_trace_table.json")),
         # Profiler trace of the headline config (runbook item 3):
         # attributes the unexplained 18% between the 337 ms measured
         # step and the 277 ms bandwidth floor.

@@ -7,10 +7,12 @@ path python bench.py) — one tool for both, because both emit the same
 event schema. Pure stdlib on purpose: the report must render on any box
 the JSONL file lands on, including ones without jax installed.
 
-Robustness contract: unknown event types are ignored (forward
-compatibility), malformed lines are skipped and counted (a preempted or
-SIGKILLed run legally truncates its last line mid-write), and every
-section renders with whatever subset of events exists.
+Robustness contract: unknown event types are never fatal (forward
+compatibility) but they are COUNTED and named in the render — a section
+the report cannot fold must be visibly absent, not silently omitted.
+Malformed lines are skipped and counted (a preempted or SIGKILLed run
+legally truncates its last line mid-write), and every section renders
+with whatever subset of events exists.
 """
 
 from __future__ import annotations
@@ -134,6 +136,14 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
         "elastic_preflights": [],
         "elastic_reshards": [],
         "emergency_saves": [],
+        # Request-scoped tracing (obs/trace.py): kept span graphs, one
+        # event per trace (+ late=True supplements for spans that
+        # arrived after their trace flushed, e.g. cancelled hedge twins).
+        "traces": [],
+        # Forward-compat census: event kinds this folder does not know.
+        # They are still ignored (never fatal), but COUNTED — the render
+        # names them explicitly instead of silently dropping them.
+        "unknown_kinds": {},
         "end": None,
     }
     for ev in events:
@@ -222,9 +232,17 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             report["elastic_reshards"].append(ev)
         elif kind == "emergency_save":
             report["emergency_saves"].append(ev)
+        elif kind == "trace":
+            report["traces"].append(ev)
         elif kind == "end":
             report["end"] = ev
-        # unknown events: ignored by design
+        else:
+            # Unknown events: never fatal (forward compatibility), but
+            # counted and named in the render — an absent section must
+            # be visibly absent, not silently omitted.
+            key = str(kind)
+            report["unknown_kinds"][key] = \
+                report["unknown_kinds"].get(key, 0) + 1
 
     # Derived rollups ----------------------------------------------------
     train_aggs = [a for a in report["epoch_steps"] if a.get("split") == "train"]
@@ -453,6 +471,51 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             "probe_verdicts": verdicts,
             "quarantine_actions": q_actions,
         }
+
+    # Request-trace rollup: status census, sampling provenance (head
+    # sample vs tail-kept failure), per-hop duration stats, and the
+    # slowest exemplars with their trace_id — the "which trace_id do I
+    # feed tools/trace_timeline.py" block.
+    if report["traces"]:
+        bases = [ev for ev in report["traces"] if not ev.get("late")]
+        late = [ev for ev in report["traces"] if ev.get("late")]
+        statuses: Dict[str, int] = {}
+        hop_durs: Dict[str, List[float]] = {}
+        for ev in bases:
+            s = str(ev.get("status", "?"))
+            statuses[s] = statuses.get(s, 0) + 1
+        for ev in report["traces"]:
+            for span in ev.get("spans") or []:
+                t0, t1 = span.get("t0"), span.get("t1")
+                if t0 is None or t1 is None:
+                    continue
+                hop_durs.setdefault(
+                    str(span.get("name", "?")), []).append(t1 - t0)
+        hops = {}
+        for name in sorted(hop_durs):
+            vals = sorted(hop_durs[name])
+            hops[name] = {
+                "n": len(vals),
+                "p50_ms": round(_percentile(vals, 0.5) * 1e3, 3),
+                "p95_ms": round(_percentile(vals, 0.95) * 1e3, 3),
+            }
+        timed = [ev for ev in bases if ev.get("dur_s") is not None]
+        slowest = sorted(timed, key=lambda e: e["dur_s"],
+                         reverse=True)[:5]
+        report["trace_rollup"] = {
+            "n_traces": len(bases),
+            "n_late_supplements": len(late),
+            "statuses": statuses,
+            "n_tail_kept": sum(1 for ev in bases if ev.get("tail")),
+            "hops": hops,
+            "slowest": [
+                {"trace_id": ev.get("trace_id"),
+                 "status": ev.get("status"),
+                 "dur_ms": round(ev["dur_s"] * 1e3, 3),
+                 "class": (ev.get("attrs") or {}).get("class"),
+                 "tenant": (ev.get("attrs") or {}).get("tenant")}
+                for ev in slowest],
+        }
     return report
 
 
@@ -486,6 +549,14 @@ def render(report: dict) -> str:
     w(f"events: {report['n_events']}"
       + (f"  (skipped {report['skipped_lines']} malformed/truncated lines)"
          if report["skipped_lines"] else ""))
+    unknown = report.get("unknown_kinds") or {}
+    if unknown:
+        # Never let an unrecognized kind vanish silently: name it, so a
+        # newer emitter paired with an older report is a visible
+        # version-skew signal rather than a quietly thinner report.
+        w("unknown event kinds (not folded — newer emitter than this "
+          "report?): " + ", ".join(
+              f"{k} x{v}" for k, v in sorted(unknown.items())))
 
     mani = report["manifest"]
     if mani:
@@ -920,6 +991,33 @@ def render(report: dict) -> str:
                 sorted((fs.get("degraded_census") or {}).items()))
             w(f"degraded requests: {fs['degraded_requests']}"
               + (f" ({census})" if census else ""))
+
+    trroll = report.get("trace_rollup")
+    if trroll:
+        w(f"-- request traces ({trroll['n_traces']} kept"
+          + (f", {trroll['n_late_supplements']} late span supplements"
+             if trroll["n_late_supplements"] else "") + ") --")
+        w("status: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(trroll["statuses"].items()))
+          + f"; {trroll['n_tail_kept']} tail-kept (failure outcomes "
+            "recorded regardless of --trace_sample)")
+        for hop, s in trroll["hops"].items():
+            w(f"  hop {hop:<8} n={s['n']:<6} p50 {s['p50_ms']:>9.3f}ms  "
+              f"p95 {s['p95_ms']:>9.3f}ms")
+        if trroll["slowest"]:
+            w("slowest (feed the trace_id to tools/trace_timeline.py "
+              "--trace-id):")
+            for ex in trroll["slowest"]:
+                tag = "".join(
+                    f" {k}={ex[k]}" for k in ("class", "tenant")
+                    if ex.get(k))
+                w(f"  {ex['trace_id']}  {_fmt(ex['dur_ms'], '.3f')}ms  "
+                  f"{ex['status']}{tag}")
+    elif report.get("fleet_flushes") or report.get("serve_flushes"):
+        # A serving stream with zero kept traces is worth a line: the
+        # operator probably expected --trace_sample > 0.
+        w("-- request traces: absent (no `trace` events in stream; "
+          "is --trace_sample > 0?) --")
 
     lint = report.get("lint")
     if lint:

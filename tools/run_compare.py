@@ -213,6 +213,9 @@ def serve_profile(record: dict, name: str = "?") -> dict:
             autoscale.get("fixed_fleet_interactive_p95_ms")),
         "autoscale_scale_ups": autoscale.get("scale_ups"),
         "autoscale_scale_downs": autoscale.get("scale_downs"),
+        "trace_overhead_frac": _float(
+            (fleet.get("trace_overhead") or {}).get("overhead_frac")
+            if isinstance(fleet.get("trace_overhead"), dict) else None),
     }
 
 
@@ -480,6 +483,21 @@ def _compare_serve(base: dict, cand: dict, th) -> List[Check]:
         checks.append((INFO, "serve autoscale churn",
                        f"scale_ups {cand.get('autoscale_scale_ups')}, "
                        f"scale_downs {cand.get('autoscale_scale_downs')}"))
+    # Tracing-overhead gate — a CANDIDATE invariant (the base may
+    # predate request tracing): full head sampling must price in under
+    # the budget, or the span hot path grew a hidden cost (a sync, a
+    # lock on the record path, per-span allocation blowup).
+    toh = cand.get("trace_overhead_frac")
+    if toh is not None:
+        over = toh > th.max_trace_overhead
+        checks.append((
+            FAIL if over else PASS, "serve trace overhead",
+            f"saturated img/s at --trace_sample 1.0 costs "
+            f"{100 * toh:.2f}% vs sample 0.0 (limit "
+            f"{100 * th.max_trace_overhead:.1f}%)"))
+    elif cand.get("fleet_ips") is not None:
+        checks.append((SKIP, "serve trace overhead",
+                       "no trace_overhead phase in candidate record"))
     return checks
 
 
@@ -772,6 +790,7 @@ def make_thresholds(
     max_serve_p95_increase: float = 0.50,
     max_elastic_loss_diff: float = 1e-5,
     max_transfer_epoch_frac: float = 0.25,
+    max_trace_overhead: float = 0.03,
     json: bool = False,
 ) -> argparse.Namespace:
     """Programmatic threshold bundle (bench.py's end-of-run hook)."""
@@ -784,6 +803,7 @@ def make_thresholds(
         max_serve_p95_increase=max_serve_p95_increase,
         max_elastic_loss_diff=max_elastic_loss_diff,
         max_transfer_epoch_frac=max_transfer_epoch_frac,
+        max_trace_overhead=max_trace_overhead,
         json=json,
     )
 
@@ -814,6 +834,10 @@ def main(argv=None) -> int:
                         help="max elementwise |diff| of per-step loss "
                              "trajectories when the candidate resharded "
                              "or resumed mid-epoch (f32 equivalence)")
+    parser.add_argument("--max_trace_overhead", default=0.03, type=float,
+                        help="max fractional throughput cost of serving "
+                             "at --trace_sample 1.0 vs 0.0 (candidate-"
+                             "side; bench_serve trace_overhead phase)")
     parser.add_argument("--max_transfer_epoch_frac", default=0.25, type=float,
                         help="max epochs a transfer-onboarded fine-tune may "
                              "run, as a fraction of its parent's from-scratch "

@@ -1,0 +1,311 @@
+"""Export trace events from a JSONL stream to a Chrome/Perfetto
+timeline + per-hop critical-path table.
+
+The serving pipeline (obs/trace.py) flushes one ``trace`` event per
+kept request: the root span, the per-hop child spans (admit -> queue ->
+stack -> submit -> device -> resolve), point events (shed/hedge/requeue
+decisions), and the hedge lane's cancelled-twin ``queued`` spans
+(possibly as ``late=True`` supplements sharing the trace_id — merged
+back here). This tool turns any stream slice into:
+
+- **Perfetto JSON** (``--out``): Chrome trace-event format, loadable at
+  ui.perfetto.dev or chrome://tracing. One track ("thread") per replica
+  plus a queue track (admit/queue/queued spans and root-span rows) and
+  a hedge lane (cancelled twins + hedged device hops); point events
+  render as instants on their track.
+- **Critical-path table** (stdout): per (class, tenant) per-hop
+  duration stats — count / mean / p50 / p95 ms — plus the e2e rollup
+  and the hop-sum vs e2e reconciliation error, which for a cleanly
+  traced request is ~0 by construction (the hops tile the root span).
+
+Usage:
+  python tools/trace_timeline.py runs/obs.jsonl --out trace.perfetto.json
+  python tools/trace_timeline.py runs/obs.jsonl --trace-id 1f00baced00dfeed
+  python tools/trace_timeline.py runs/obs.jsonl --slowest 20 --json
+
+Stdlib only; pure host-side file reads.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+# Span names that live on the queue track regardless of replica attr.
+_QUEUE_HOPS = ("admit", "queue")
+# Hop display order for the critical-path table.
+_HOP_ORDER = ("admit", "queue", "stack", "submit", "device", "resolve",
+              "queued")
+
+
+def load_traces(path: str, limit: Optional[int] = None) -> List[dict]:
+    """Read ``trace`` events from a JSONL stream, folding ``late``
+    supplements into their base trace by trace_id. Returns one dict per
+    trace: {trace_id, name, status, attrs, events, spans, t_start,
+    t_end, dur_s, sampled, tail}. Unparseable lines are skipped (a torn
+    tail from a crashed run must not kill the report)."""
+    by_id: Dict[str, dict] = {}
+    order: List[str] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if ev.get("event") != "trace":
+                continue
+            tid = ev.get("trace_id")
+            if tid is None:
+                continue
+            if ev.get("late"):
+                base = by_id.get(tid)
+                if base is not None:
+                    base["spans"].extend(ev.get("spans") or [])
+                else:
+                    # Supplement arrived without (or before) its base —
+                    # keep it as a skeleton so the spans still render.
+                    by_id[tid] = {"trace_id": tid, "status": "?",
+                                  "spans": list(ev.get("spans") or []),
+                                  "attrs": {}, "events": []}
+                    order.append(tid)
+                continue
+            base = by_id.get(tid)
+            if base is not None:
+                # Base caught up with an earlier late-span skeleton
+                # (or a duplicated id): keep the accumulated spans.
+                extra = base["spans"]
+                base = dict(ev)
+                base["spans"] = list(ev.get("spans") or []) + extra
+                by_id[tid] = base
+            else:
+                base = dict(ev)
+                base["spans"] = list(ev.get("spans") or [])
+                by_id[tid] = base
+                order.append(tid)
+            base.setdefault("attrs", {})
+            base["attrs"] = base.get("attrs") or {}
+            base["events"] = base.get("events") or []
+            if limit is not None and len(order) > limit:
+                drop = order.pop(0)
+                by_id.pop(drop, None)
+    return [by_id[t] for t in order if t in by_id]
+
+
+def _span_track(span: dict) -> str:
+    attrs = span.get("attrs") or {}
+    name = span.get("name", "?")
+    if name == "queued" or attrs.get("hedge"):
+        return "hedge lane"
+    if "replica" in attrs:
+        return f"replica {attrs['replica']}"
+    if name in _QUEUE_HOPS:
+        return "queue"
+    return "queue"
+
+
+def export_perfetto(traces: List[dict]) -> dict:
+    """Chrome trace-event JSON: ph "X" complete events on one pid,
+    one tid per track, ph "M" thread_name metadata naming the tracks,
+    ph "i" instants for point events. Timestamps are microseconds
+    relative to the earliest span in the slice (perf_counter epochs are
+    arbitrary — only deltas mean anything)."""
+    t0s = [s.get("t0") for tr in traces for s in tr["spans"]
+           if s.get("t0") is not None]
+    t0s += [tr.get("t_start") for tr in traces
+            if tr.get("t_start") is not None]
+    epoch = min(t0s) if t0s else 0.0
+
+    def us(t: float) -> float:
+        return round((t - epoch) * 1e6, 3)
+
+    tracks: Dict[str, int] = {"requests": 1, "queue": 2,
+                              "hedge lane": 3}
+    events: List[dict] = []
+    for tr in traces:
+        tid_label = tr.get("trace_id", "?")
+        attrs = tr.get("attrs") or {}
+        if tr.get("t_start") is not None and tr.get("t_end") is not None:
+            events.append({
+                "name": f"request {tid_label[:8]}",
+                "cat": tr.get("status", "?"),
+                "ph": "X", "pid": 1, "tid": tracks["requests"],
+                "ts": us(tr["t_start"]),
+                "dur": round((tr["t_end"] - tr["t_start"]) * 1e6, 3),
+                "args": dict(attrs, trace_id=tid_label,
+                             status=tr.get("status")),
+            })
+        for span in tr["spans"]:
+            t_start, t_end = span.get("t0"), span.get("t1")
+            if t_start is None or t_end is None:
+                continue
+            track = _span_track(span)
+            tid = tracks.setdefault(track, len(tracks) + 1)
+            events.append({
+                "name": span.get("name", "?"),
+                "cat": "hop",
+                "ph": "X", "pid": 1, "tid": tid,
+                "ts": us(t_start),
+                "dur": round((t_end - t_start) * 1e6, 3),
+                "args": dict(span.get("attrs") or {},
+                             trace_id=tid_label),
+            })
+        for ev in tr.get("events") or []:
+            if ev.get("t") is None:
+                continue
+            events.append({
+                "name": ev.get("name", "?"),
+                "cat": "decision",
+                "ph": "i", "s": "t",
+                "pid": 1, "tid": tracks["queue"],
+                "ts": us(ev["t"]),
+                "args": dict({k: v for k, v in ev.items()
+                              if k not in ("name", "t")},
+                             trace_id=tid_label),
+            })
+    meta = [{"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+             "args": {"name": label}}
+            for label, tid in sorted(tracks.items(), key=lambda kv: kv[1])]
+    # sort_index keeps the track order stable (requests on top).
+    meta += [{"name": "thread_sort_index", "ph": "M", "pid": 1,
+              "tid": tid, "args": {"sort_index": tid}}
+             for _, tid in sorted(tracks.items(), key=lambda kv: kv[1])]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def critical_path(traces: List[dict]) -> dict:
+    """Per (class, tenant) per-hop stats + hop-sum vs e2e
+    reconciliation. Returns {group_label: {"n", "e2e": {...}, "hops":
+    {hop: {...}}, "recon_frac"}} where recon_frac is the mean
+    |hop_sum - e2e| / e2e over the group's fully-traced requests."""
+    groups: Dict[str, dict] = {}
+    for tr in traces:
+        if tr.get("status") == "?":
+            continue
+        attrs = tr.get("attrs") or {}
+        label = "class=%s tenant=%s" % (attrs.get("class", "-"),
+                                        attrs.get("tenant", "-") or "-")
+        g = groups.setdefault(
+            label, {"n": 0, "e2e": [], "hops": {}, "recon": []})
+        g["n"] += 1
+        dur = tr.get("dur_s")
+        if dur is not None:
+            g["e2e"].append(dur)
+        hop_sum = 0.0
+        complete = dur is not None
+        for span in tr["spans"]:
+            t0, t1 = span.get("t0"), span.get("t1")
+            if t0 is None or t1 is None:
+                continue
+            name = span.get("name", "?")
+            g["hops"].setdefault(name, []).append(t1 - t0)
+            if name != "queued":  # the hedge loser's lane, not a hop
+                hop_sum += t1 - t0
+        if complete and dur > 0 and tr["spans"]:
+            g["recon"].append(abs(hop_sum - dur) / dur)
+
+    def stats(vals: List[float]) -> dict:
+        s = sorted(vals)
+        return {
+            "n": len(s),
+            "mean_ms": round(sum(s) / len(s) * 1e3, 3) if s else None,
+            "p50_ms": round(_percentile(s, 0.5) * 1e3, 3) if s else None,
+            "p95_ms": round(_percentile(s, 0.95) * 1e3, 3) if s else None,
+        }
+
+    out = {}
+    for label, g in sorted(groups.items()):
+        hops = {h: stats(v) for h, v in g["hops"].items()}
+        ordered = {h: hops[h] for h in _HOP_ORDER if h in hops}
+        ordered.update({h: v for h, v in sorted(hops.items())
+                        if h not in ordered})
+        out[label] = {
+            "n": g["n"],
+            "e2e": stats(g["e2e"]),
+            "hops": ordered,
+            "recon_frac": (round(sum(g["recon"]) / len(g["recon"]), 6)
+                           if g["recon"] else None),
+        }
+    return out
+
+
+def render_table(table: dict) -> str:
+    lines = []
+    for label, g in table.items():
+        lines.append(f"== {label}  (n={g['n']}) ==")
+        lines.append(f"{'hop':<10} {'n':>6} {'mean ms':>10} "
+                     f"{'p50 ms':>10} {'p95 ms':>10}")
+        for hop, s in g["hops"].items():
+            lines.append(
+                f"{hop:<10} {s['n']:>6} {s['mean_ms']:>10} "
+                f"{s['p50_ms']:>10} {s['p95_ms']:>10}")
+        e = g["e2e"]
+        lines.append(
+            f"{'e2e':<10} {e['n']:>6} {e['mean_ms']:>10} "
+            f"{e['p50_ms']:>10} {e['p95_ms']:>10}")
+        recon = g["recon_frac"]
+        lines.append(
+            "hop-sum vs e2e reconciliation: "
+            + (f"{recon * 100:.2f}% mean error"
+               if recon is not None else "n/a"))
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("stream", help="JSONL telemetry stream "
+                                  "(--obs_jsonl / BENCH_OBS_JSONL)")
+    p.add_argument("--out", default=None,
+                   help="write Perfetto/Chrome trace-event JSON here")
+    p.add_argument("--trace-id", default=None,
+                   help="restrict to one trace_id (prefix match)")
+    p.add_argument("--slowest", default=None, type=int, metavar="N",
+                   help="keep only the N slowest complete traces")
+    p.add_argument("--limit", default=None, type=int,
+                   help="cap traces read from the stream (keeps the "
+                        "most recent)")
+    p.add_argument("--json", action="store_true",
+                   help="print the critical-path table as JSON instead "
+                        "of text")
+    args = p.parse_args(argv)
+
+    traces = load_traces(args.stream, limit=args.limit)
+    if args.trace_id:
+        traces = [t for t in traces
+                  if t.get("trace_id", "").startswith(args.trace_id)]
+    if args.slowest:
+        traces = sorted(traces, key=lambda t: t.get("dur_s") or -1.0,
+                        reverse=True)[:args.slowest]
+    if not traces:
+        print("no trace events matched "
+              "(is --trace_sample > 0, or did anything fail?)",
+              file=sys.stderr)
+        return 1
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(export_perfetto(traces), f)
+        print(f"wrote {args.out}: {len(traces)} traces "
+              f"(load at ui.perfetto.dev)", file=sys.stderr)
+    table = critical_path(traces)
+    if args.json:
+        print(json.dumps(table, indent=2))
+    else:
+        print(render_table(table))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
