@@ -21,6 +21,19 @@ on the existing backpressure path — never `block_until_ready`);
 `tools/check_no_sync.py` enforces this statically and runs in tier-1.
 """
 
+from cyclegan_tpu.obs.comms import (
+    RECON_TOLERANCE,
+    analytic_census,
+    build_census,
+    parse_hlo_collectives,
+)
+from cyclegan_tpu.obs.goodput import (
+    BADPUT_PHASES,
+    PHASES,
+    GoodputLedger,
+    classify_pass,
+    rollup_phases,
+)
 from cyclegan_tpu.obs.health import (
     HealthFault,
     HealthMonitor,
@@ -49,6 +62,15 @@ from cyclegan_tpu.obs.watchdog import StallWatchdog
 
 __all__ = [
     "EVENT_SCHEMA_VERSION",
+    "RECON_TOLERANCE",
+    "analytic_census",
+    "build_census",
+    "parse_hlo_collectives",
+    "PHASES",
+    "BADPUT_PHASES",
+    "GoodputLedger",
+    "classify_pass",
+    "rollup_phases",
     "HealthFault",
     "HealthMonitor",
     "finalize_health_metrics",

@@ -86,8 +86,10 @@ class StepClock:
         heartbeat: Optional[Callable[[], None]] = None,
         clock=time.perf_counter,
         stall_multiple: float = 0.0,
+        on_finish: Optional[Callable[[dict], None]] = None,
     ):
         self._logger = logger
+        self._on_finish = on_finish
         self._epoch = epoch
         self._split = split
         self._log_every = max(0, int(log_every))
@@ -105,6 +107,7 @@ class StepClock:
         self._fetch_s = 0.0
         self._drain_s = 0.0
         self._host_s = 0.0
+        self._dispatch0_s = 0.0  # first dispatch carries trace+compile
         self._t_open = clock()
         self._t_iter: Optional[float] = None  # current iteration start
         self._t0 = None  # stage_begin timestamp
@@ -200,6 +203,8 @@ class StepClock:
         now = self._clock()
         d = now - self._t0 if self._t0 is not None else 0.0
         self._dispatch_s += d
+        if self.n_dispatches == 0:
+            self._dispatch0_s = d
         self.depth += steps if pinned is None else pinned
         self.n_dispatches += 1
         self.n_steps += steps
@@ -271,6 +276,7 @@ class StepClock:
             "wall_s": round(wall, 6),
             "stage_s": round(self._stage_s, 6),
             "dispatch_s": round(self._dispatch_s, 6),
+            "dispatch0_s": round(self._dispatch0_s, 6),
             "fetch_block_s": round(self._fetch_s, 6),
             "drain_s": round(self._drain_s, 6),
             # Fraction of loop wall the host spent waiting on INPUT
@@ -286,6 +292,8 @@ class StepClock:
             "n_loop_stalls": self.n_loop_stalls,
         }
         self._logger.event("epoch_steps", **agg)
+        if self._on_finish is not None:
+            self._on_finish(agg)
         self._heartbeat()
         return agg
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from cyclegan_tpu.obs.goodput import GoodputLedger
 from cyclegan_tpu.obs.jsonl import MetricsLogger, NullMetricsLogger
 from cyclegan_tpu.obs.manifest import build_manifest
 from cyclegan_tpu.obs.memory import memory_watermarks
@@ -25,11 +26,13 @@ class Telemetry:
         step_log_every: int = 1,
         watchdog: Optional[StallWatchdog] = None,
         stall_multiple: float = 0.0,
+        goodput: Optional[GoodputLedger] = None,
     ):
         self.logger = logger
         self.step_log_every = step_log_every
         self.stall_multiple = stall_multiple
         self.watchdog = watchdog
+        self.goodput = goodput
         self._clock: Optional[StepClock] = None
         if watchdog is not None:
             watchdog.start()
@@ -47,10 +50,12 @@ class Telemetry:
         """A fresh clock for one (epoch, split) pass, heartbeating the
         watchdog and exposing its pending depth to it."""
         beat = self.watchdog.beat if self.watchdog is not None else None
+        on_finish = self.goodput.note_pass if self.goodput is not None else None
         clock = StepClock(
             self.logger, epoch, split=split,
             log_every=self.step_log_every, heartbeat=beat,
             stall_multiple=self.stall_multiple,
+            on_finish=on_finish,
         )
         self._clock = clock
         if self.watchdog is not None:
@@ -58,11 +63,27 @@ class Telemetry:
         return clock
 
     def event(self, kind: str, /, **fields) -> None:
+        # The goodput ledger rides existing events: epoch-services job
+        # seconds and the comms census's link-model estimate feed it
+        # without any new instrumentation in the emitters.
+        if self.goodput is not None:
+            if kind == "service_job":
+                self.goodput.note_service(fields.get("seconds", 0.0))
+            elif kind == "comms_census":
+                self.goodput.note_census(fields)
         self.logger.event(kind, **fields)
 
     def epoch(self, epoch: int, **fields) -> None:
-        """Per-epoch rollup: throughput, utilization, eval metrics."""
+        """Per-epoch rollup: throughput, utilization, eval metrics —
+        followed by the goodput ledger's phase rollup for the same
+        window when an epoch duration is available."""
         self.logger.event("epoch", epoch=epoch, **fields)
+        if self.goodput is not None:
+            elapse = fields.get("elapse_s") or fields.get("seconds")
+            if elapse is not None:
+                rollup = self.goodput.rollup(epoch, float(elapse))
+                if rollup is not None:
+                    self.logger.event("goodput", **rollup)
 
     def memory(self, epoch: int) -> None:
         self.logger.event("memory", epoch=epoch, **memory_watermarks())
@@ -84,6 +105,7 @@ class NullTelemetry(Telemetry):
         self.step_log_every = 0
         self.stall_multiple = 0.0
         self.watchdog = None
+        self.goodput = None
         self._clock = None
 
     @property
@@ -140,4 +162,5 @@ def make_telemetry(obs_config, output_dir: str, primary: bool = True) -> Telemet
         step_log_every=int(getattr(obs_config, "step_log_every", 1)),
         watchdog=watchdog,
         stall_multiple=float(getattr(obs_config, "stall_multiple", 0.0) or 0.0),
+        goodput=GoodputLedger(),
     )

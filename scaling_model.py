@@ -45,6 +45,14 @@ Usage:
   python scaling_model.py                   # the BASELINE v4-32 target
   python scaling_model.py --chip v5e --devices 16
   python scaling_model.py --link_gbps 20    # sensitivity: slower ICI
+  python scaling_model.py --from_census docs/comms_census.json
+
+`--from_census` re-predicts efficiency from a committed comms-census
+artifact (obs/comms.py: the ledger reconciled against the *compiled*
+program) instead of the closed-form byte estimate — the measured
+data-axis all-reduce payload replaces `grad_bytes()`, and the
+prediction is printed beside the closed-form one so drift between the
+two is visible in every run.
 
 Prints a per-assumption table to stderr and ONE JSON line to stdout.
 """
@@ -122,6 +130,54 @@ def predict(
     }
 
 
+def load_census_bytes(path: str) -> dict:
+    """Per-step data-axis collective bytes from a comms-census
+    artifact: a JSON file holding one census payload, or a JSONL
+    telemetry stream (the LAST `comms_census` event wins). Prefers the
+    measured (parsed-from-HLO) bytes; falls back to the analytic
+    ledger for census runs without HLO text."""
+    payload = None
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read().strip()
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict):
+            payload = doc if "analytic" in doc else None
+    except ValueError:
+        doc = None
+    if payload is None:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(ev, dict) and ev.get("event") == "comms_census":
+                payload = ev
+    if payload is None:
+        raise SystemExit(f"no comms_census payload in {path}")
+    full = (payload.get("full_size_measured") or {}).get("axes", {})
+    measured = (payload.get("measured") or {}).get("axes", {})
+    if full.get("data", {}).get("bytes"):
+        # The advisory full-size section is the flagship program as XLA
+        # actually compiled it — the right payload for the v4-32
+        # question even though the gated census ran the smoke config.
+        d_bytes, source = int(full["data"]["bytes"]), "measured-full-size"
+    elif measured.get("data", {}).get("bytes"):
+        d_bytes, source = int(measured["data"]["bytes"]), "measured"
+    else:
+        d_bytes = int(payload["analytic"]["data_bytes"])
+        source = "analytic"
+    return {
+        "bytes_per_step": d_bytes,
+        "source": source,
+        "mesh": payload.get("mesh", {}),
+        "max_recon_error": payload.get("max_recon_error"),
+    }
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--chip", default="v4", choices=sorted(CHIPS))
@@ -140,6 +196,10 @@ def main() -> None:
                         "parameter-exact count from the real trees; pass "
                         "158684236 for the compiler-measured payload, "
                         "tools/aot_multichip.py)")
+    p.add_argument("--from_census", default=None, metavar="PATH",
+                   help="comms-census artifact (JSON payload or JSONL "
+                        "stream): re-predict with the compiled ledger's "
+                        "data-axis bytes beside the closed-form estimate")
     args = p.parse_args()
 
     out = predict(args.devices, args.batch, args.chip,
@@ -162,6 +222,29 @@ def main() -> None:
         "vs_baseline": round(out["predicted_efficiency"] / 0.90, 3),
     }
     line.update(out)
+    if args.from_census:
+        census = load_census_bytes(args.from_census)
+        cen_out = predict(args.devices, args.batch, args.chip,
+                          link_gbps=args.link_gbps, ips_1chip=args.ips,
+                          bytes_per_step=census["bytes_per_step"])
+        print(
+            f"[scaling_model] from census ({census['source']} data-axis "
+            f"bytes, mesh {census['mesh'].get('n_data', '?')}x"
+            f"{census['mesh'].get('n_spatial', '?')}): all-reduce "
+            f"{cen_out['grad_bytes_per_step'] / 1e6:.1f} MB => efficiency "
+            f"{cen_out['predicted_efficiency'] * 100:.1f}% "
+            f"(closed-form {out['predicted_efficiency'] * 100:.1f}%)",
+            file=sys.stderr,
+            flush=True,
+        )
+        line["from_census"] = {
+            "predicted_efficiency": cen_out["predicted_efficiency"],
+            "grad_bytes_per_step": cen_out["grad_bytes_per_step"],
+            "t_comm_ms_no_overlap": cen_out["t_comm_ms_no_overlap"],
+            "source": census["source"],
+            "census_mesh": census["mesh"],
+            "census_max_recon_error": census["max_recon_error"],
+        }
     print(json.dumps(line), flush=True)
 
 

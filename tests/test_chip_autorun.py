@@ -52,10 +52,12 @@ def test_queue_order_and_budgets():
     q = build_queue("remote")
     names = [s.name for s in q]
     # Highest value first (VERDICT r4 item 1): the no-TPU static
-    # preflight, health probe, official number cold then warm, the pad
-    # lever, 512^2 rows, the serving sweep (+ its trace archive),
-    # trace, e2e run.
-    assert names == ["graftlint", "diag", "bench_cold", "bench_warm",
+    # preflights (lint, then the comms census — abort before burning
+    # the window on a mis-sharded program), health probe, official
+    # number cold then warm, the pad lever, 512^2 rows, the serving
+    # sweep (+ its trace archive), trace, e2e run.
+    assert names == ["graftlint", "comms_census", "diag",
+                     "bench_cold", "bench_warm",
                      "pad_sweep", "epilogue_sweep", "grad_sweep",
                      "upsample_sweep", "accum512", "scan512",
                      "serve_sweep", "serve_trace", "trace",
@@ -67,6 +69,12 @@ def test_queue_order_and_budgets():
     assert by["graftlint"].abort_queue_on_fail
     assert by["graftlint"].always_run
     assert by["graftlint"].stdout_to.endswith("graftlint.json")
+    # census failing = mis-sharded program; abort before chip time,
+    # on host devices only (never a TPU client before diag probes it)
+    assert by["comms_census"].abort_queue_on_fail
+    assert by["comms_census"].always_run
+    assert by["comms_census"].env.get("JAX_PLATFORMS") == "cpu"
+    assert by["comms_census"].stdout_to.endswith("comms_census.json")
     # cold run gets the cache-warming budget; warm run is the record
     assert float(by["bench_cold"].env["BENCH_TIME_BUDGET_S"]) > float(
         by["bench_warm"].env["BENCH_TIME_BUDGET_S"])
@@ -380,8 +388,8 @@ def test_diag_never_given_up_while_work_pends(fake_repo, monkeypatch):
     monkeypatch.setattr(chip_autorun, "run_queue", fake_run_queue)
     assert chip_autorun.attempt_window(fake_repo) is False
     # the probe still runs every attempt (right after the static
-    # preflight, which needs no TPU and so precedes it)
-    assert ran and ran[0][:2] == ["graftlint", "diag"]
+    # preflights, which need no TPU and so precede it)
+    assert ran and ran[0][:3] == ["graftlint", "comms_census", "diag"]
 
 
 def test_run_queue_stops_on_mode_shift(fake_repo, monkeypatch):

@@ -1,0 +1,386 @@
+"""Scale-out observatory (obs/comms.py + obs/goodput.py): the analytic
+collective ledger pinned against REAL compiled HLO on 2x1 and 2x2 host
+meshes, the HLO collective parser on synthetic programs, the goodput
+phase math (exact wall-clock accounting), the telemetry wiring, and
+the zero-extra-dispatch pin (a goodput-traced run performs exactly the
+dispatches an untraced run does).
+
+All CPU-runnable tier-1: the census compiles on virtual host devices
+and reads program text; the ledger is pure host arithmetic.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+sys.path.insert(0, REPO)
+
+from cyclegan_tpu.config import ObsConfig, ParallelConfig, tiny_test_config  # noqa: E402
+from cyclegan_tpu.obs import GoodputLedger, MetricsLogger, make_telemetry  # noqa: E402
+from cyclegan_tpu.obs.comms import (  # noqa: E402
+    DISC_GRAD_SITES_PER_STEP,
+    GEN_APPS_PER_STEP,
+    RECON_TOLERANCE,
+    analytic_census,
+    build_census,
+    data_axis_bytes,
+    grad_tree_bytes,
+    parse_hlo_collectives,
+)
+from cyclegan_tpu.obs.goodput import classify_pass, rollup_phases  # noqa: E402
+from cyclegan_tpu.obs.telemetry import Telemetry  # noqa: E402
+from cyclegan_tpu.parallel import make_mesh_plan, shard_train_step  # noqa: E402
+from cyclegan_tpu.train import create_state, make_train_step  # noqa: E402
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _census_for_mesh(devices, n_devices, spatial):
+    """Compile the REAL sharded tiny train step (abstract avals, the
+    dryrun stage-2 pattern) and census it against its own HLO."""
+    par = ParallelConfig(spatial_parallelism=spatial)
+    plan = make_mesh_plan(par, devices[:n_devices])
+    cfg = tiny_test_config().replace(parallel=par)
+    gb = plan.n_data * cfg.train.batch_size
+    s = cfg.model.image_size
+    state = jax.eval_shape(lambda: create_state(cfg, jax.random.PRNGKey(0)))
+    step = shard_train_step(plan, make_train_step(cfg, gb))
+    img = jax.ShapeDtypeStruct((gb, s, s, 3), np.float32)
+    w = jax.ShapeDtypeStruct((gb,), np.float32)
+    hlo = step.lower(state, img, img, w).compile().as_text()
+    return build_census(plan, cfg, gb, state, hlo_text=hlo, link_gbps=45.0)
+
+
+# ------------------------------------------------- census vs real HLO
+
+
+def test_census_reconciles_on_2x1_mesh(devices):
+    """Pure data parallelism: the 3x(G+F) + 2x(DX+DY) per-site payload
+    must match the compiled program's data-axis all-reduces tightly
+    (residual: loss-scalar reduces), and no spatial axis exists."""
+    census = _census_for_mesh(devices, 2, 1)
+    assert census["ok"], census["reconciliation"]
+    recon = census["reconciliation"]
+    assert "data" in recon and "spatial" not in recon
+    assert recon["data"]["error"] <= 0.01
+    assert recon["data"]["measured_ops"] > 0
+    assert census["analytic"]["spatial_bytes"] == 0.0
+
+
+def test_census_reconciles_on_2x2_mesh(devices):
+    """Both mesh axes live: data within 1%, spatial (halo + edge-site
+    full reduces + ConvTranspose reshards + IN stats) within the 10%
+    census tolerance."""
+    census = _census_for_mesh(devices, 4, 2)
+    assert census["ok"], census["reconciliation"]
+    recon = census["reconciliation"]
+    assert recon["data"]["error"] <= 0.01
+    assert recon["spatial"]["error"] <= RECON_TOLERANCE
+    # Spatial traffic is real on this mesh, not a vacuous 0==0 pass.
+    assert recon["spatial"]["measured_bytes"] > 0
+    assert census["measured"]["unknown_dtypes"] == []
+
+
+def test_analytic_multiplicities(tiny_config):
+    """Data-axis payload counts gradient trees per application site:
+    generators 3x (translate/cycle/identity), discriminators 2x
+    (real + fake; the adversarial site stop-gradients D)."""
+    state = jax.eval_shape(
+        lambda: create_state(tiny_config, jax.random.PRNGKey(0)))
+    trees = grad_tree_bytes(state)
+    expected = (GEN_APPS_PER_STEP * (trees["g"] + trees["f"])
+                + DISC_GRAD_SITES_PER_STEP * (trees["dx"] + trees["dy"]))
+    assert data_axis_bytes(trees) == expected
+    assert trees["g"] == trees["f"] and trees["dx"] == trees["dy"]
+
+
+def test_analytic_census_axis_gating(devices):
+    """n_data == 1 zeroes the data axis; n_spatial == 1 zeroes the
+    spatial axis — an axis of extent 1 has no collectives."""
+    par = ParallelConfig(spatial_parallelism=2)
+    plan = make_mesh_plan(par, devices[:2])  # 1 data x 2 spatial
+    cfg = tiny_test_config().replace(parallel=par)
+    state = jax.eval_shape(lambda: create_state(cfg, jax.random.PRNGKey(0)))
+    out = analytic_census(plan, cfg, cfg.train.batch_size, state)
+    assert plan.n_data == 1
+    assert out["data_bytes"] == 0
+    assert out["spatial_bytes"] > 0
+
+
+# ------------------------------------------------- HLO parser (pinned)
+
+# dp=2 x sp=2 mesh, flat device id = d * sp + s.
+_SYNTH_HLO = """\
+HloModule synth
+  %ar0 = f32[100]{0} all-reduce(f32[100]{0} %a), replica_groups={{0,2},{1,3}}, to_apply=%sum
+  %ar1 = f32[50]{0} all-reduce(f32[50]{0} %b), replica_groups={{0,1},{2,3}}, to_apply=%sum
+  %ag = f32[8,16]{1,0} all-gather(f32[4,16]{1,0} %c), replica_groups=[2,2]<=[4], dimensions={0}
+  %ar2 = f32[10]{0} all-reduce-start(f32[10]{0} %d), replica_groups=[2,2]<=[2,2]T(1,0), to_apply=%sum
+  %cp = f32[10]{0} collective-permute(f32[10]{0} %e), source_target_pairs={{0,1},{1,0},{2,3},{3,2}}
+  %cpx = f32[10]{0} collective-permute(f32[10]{0} %f), source_target_pairs={{0,3},{3,0}}
+  %weird = c64[5]{0} all-gather(c64[5]{0} %g), replica_groups={{0,1},{2,3}}, dimensions={0}
+"""
+
+
+def test_parse_hlo_synthetic_attribution():
+    out = parse_hlo_collectives(_SYNTH_HLO, 2, 2)
+    axes = out["axes"]
+    # data: ar0 (groups fix i%sp) 400B + ar2 (iota transposed ->
+    # [[0,2],[1,3]]) 40B.
+    assert axes["data"] == {"bytes": 440, "ops": 2}
+    # spatial: ar1 200B + ag (iota [[0,1],[2,3]], RESULT shape 8x16)
+    # 512B + cp (all pairs within a dp row) 40B. The c64 all-gather's
+    # bytes are excluded (unknown dtype) but the op still lands on its
+    # axis with 0 bytes.
+    assert axes["spatial"] == {"bytes": 752, "ops": 4}
+    # cpx crosses both axes -> other.
+    assert axes["other"] == {"bytes": 40, "ops": 1}
+    assert out["unknown_dtypes"] == ["c64"]
+    assert out["by_kind"]["all-reduce:data"]["ops"] == 2
+    assert out["by_kind"]["collective-permute:spatial"]["ops"] == 1
+
+
+def test_build_census_failure_and_analytic_only(devices):
+    par = ParallelConfig(spatial_parallelism=1)
+    plan = make_mesh_plan(par, devices[:2])
+    cfg = tiny_test_config().replace(parallel=par)
+    gb = plan.n_data * cfg.train.batch_size
+    state = jax.eval_shape(lambda: create_state(cfg, jax.random.PRNGKey(0)))
+    # Analytic-only census (no HLO): no verdict, but a per-link model
+    # and a collective-seconds estimate for the goodput ledger.
+    ana = build_census(plan, cfg, gb, state, link_gbps=45.0)
+    assert "reconciliation" not in ana and "ok" not in ana
+    assert ana["per_link"]["data_allreduce_bytes"] > 0
+    assert ana["est_step_comms_s"] > 0
+    # A program whose collectives do NOT match (one tiny all-reduce)
+    # must fail reconciliation — this is the chip_autorun abort path.
+    bad_hlo = ("  %ar = f32[10]{0} all-reduce(f32[10]{0} %a), "
+               "replica_groups={{0,1}}, to_apply=%sum\n")
+    bad = build_census(plan, cfg, gb, state, hlo_text=bad_hlo)
+    assert not bad["ok"]
+    assert bad["max_recon_error"] > RECON_TOLERANCE
+
+
+# ------------------------------------------------- goodput phase math
+
+
+def test_classify_pass_pinned():
+    agg = {"wall_s": 10.0, "stage_s": 1.0, "dispatch_s": 2.0,
+           "fetch_block_s": 3.0, "drain_s": 0.5, "host_work_s": 0.5,
+           "dispatch0_s": 1.1, "n_dispatches": 10, "n_steps": 20}
+    ph = classify_pass(agg)
+    # steady dispatch = (2.0 - 1.1) / 9 = 0.1; compile = 1.1 - 0.1.
+    assert ph["compile"] == pytest.approx(1.0)
+    assert ph["compute"] == pytest.approx(3.5)  # fetch + drain
+    assert ph["data_wait"] == pytest.approx(1.0)
+    # host = steady dispatch (1.0) + host_work (0.5) + wall residue
+    # (10 - 1 - 2 - 3 - 0.5 - 0.5 = 3.0).
+    assert ph["host"] == pytest.approx(4.5)
+    total = ph["compute"] + ph["data_wait"] + ph["host"] + ph["compile"]
+    assert total == pytest.approx(agg["wall_s"])
+    # Single-dispatch pass: all of dispatch 0 is the compile estimate.
+    one = classify_pass({"wall_s": 2.0, "dispatch_s": 1.5,
+                         "dispatch0_s": 1.5, "n_dispatches": 1,
+                         "n_steps": 1})
+    assert one["compile"] == pytest.approx(1.5)
+
+
+def test_rollup_sums_to_elapse_exactly():
+    passes = [classify_pass({"wall_s": 10.0, "stage_s": 1.0,
+                             "dispatch_s": 2.0, "fetch_block_s": 3.0,
+                             "drain_s": 0.5, "host_work_s": 0.5,
+                             "dispatch0_s": 1.1, "n_dispatches": 10,
+                             "n_steps": 20}),
+              classify_pass({"wall_s": 4.0, "fetch_block_s": 2.0,
+                             "dispatch_s": 1.0, "dispatch0_s": 0.1,
+                             "n_dispatches": 10, "n_steps": 10})]
+    out = rollup_phases(passes, service_s=2.0, elapse_s=20.0)
+    assert sum(out["phases_s"].values()) == pytest.approx(20.0)
+    assert sum(out["phase_fractions"].values()) == pytest.approx(1.0, abs=1e-4)
+    assert out["goodput_fraction"] == out["phase_fractions"]["compute"]
+    assert out["n_steps"] == 30 and out["n_passes"] == 2
+    # Badput census is sorted most-expensive-first and excludes compute.
+    badput = list(out["badput"].values())
+    assert badput == sorted(badput, reverse=True)
+    assert "compute" not in out["badput"]
+    # Services fit the epoch remainder here: nothing overlapped.
+    assert out["phases_s"]["services"] == pytest.approx(2.0)
+    assert out["service_overlap_s"] == 0.0
+
+
+def test_rollup_service_overlap_and_collective_carve():
+    passes = [classify_pass({"wall_s": 8.0, "fetch_block_s": 6.0,
+                             "dispatch_s": 1.0, "stage_s": 1.0,
+                             "dispatch0_s": 0.1, "n_dispatches": 10,
+                             "n_steps": 10})]
+    # Epoch barely longer than the pass: a 5s service job mostly
+    # overlapped device time and must NOT inflate the ledger past
+    # elapse — the excess is reported separately.
+    out = rollup_phases(passes, service_s=5.0, elapse_s=9.0)
+    assert sum(out["phases_s"].values()) == pytest.approx(9.0)
+    assert out["phases_s"]["services"] == pytest.approx(1.0)
+    assert out["service_overlap_s"] == pytest.approx(4.0)
+    # Census-informed collective share is carved OUT of compute and
+    # bounded by it.
+    carved = rollup_phases(passes, 0.0, 9.0, comms_s_per_step=0.2)
+    assert carved["phases_s"]["collective"] == pytest.approx(2.0)
+    assert carved["phases_s"]["compute"] == pytest.approx(4.0)
+    assert sum(carved["phases_s"].values()) == pytest.approx(9.0)
+    bounded = rollup_phases(passes, 0.0, 9.0, comms_s_per_step=10.0)
+    assert bounded["phases_s"]["collective"] == pytest.approx(6.0)
+    assert bounded["phases_s"]["compute"] == 0.0
+
+
+def test_ledger_empty_window_emits_nothing():
+    led = GoodputLedger()
+    assert led.rollup(0, 5.0) is None
+    led.note_service(0.25)
+    assert led.rollup(1, 5.0) is not None
+    # The window reset: the next epoch is empty again.
+    assert led.rollup(2, 5.0) is None
+
+
+# ------------------------------------------------- telemetry wiring
+
+
+def test_goodput_rides_telemetry_events(tmp_path):
+    """The ledger is fed entirely by Telemetry: StepClock on_finish,
+    service_job interception, census est pickup — and the `goodput`
+    event trails the `epoch` event with fractions summing to 1."""
+    path = str(tmp_path / "t.jsonl")
+    tele = Telemetry(MetricsLogger(path), goodput=GoodputLedger())
+    clock = tele.step_clock(0)
+    clock.stage_begin(); clock.staged()
+    clock.dispatched(steps=2, kind="multi")
+    clock.fetched(0.01, steps=2)
+    clock.finish()
+    tele.event("service_job", job="checkpoint:e0", seconds=0.5)
+    tele.event("comms_census", est_step_comms_s=1e-4)
+    tele.epoch(0, elapse_s=5.0, images_per_sec=1.0)
+    # An epoch with no passes and no services stays ledger-free.
+    tele.epoch(1, elapse_s=5.0, images_per_sec=1.0)
+    tele.close()
+
+    evs = _events(path)
+    kinds = [e["event"] for e in evs]
+    assert kinds.count("goodput") == 1
+    assert "comms_census" in kinds  # interception still logs the event
+    gp = evs[kinds.index("goodput")]
+    assert gp["epoch"] == 0
+    assert kinds.index("goodput") == kinds.index("epoch") + 1
+    assert sum(gp["phase_fractions"].values()) == pytest.approx(1.0,
+                                                                abs=1e-4)
+    assert gp["comms_s_per_step"] == pytest.approx(1e-4)
+    assert gp["phases_s"]["services"] + gp["service_overlap_s"] == \
+        pytest.approx(0.5)
+
+
+def test_traced_run_dispatches_exactly_like_untraced(tiny_config, devices,
+                                                     tmp_path):
+    """Zero-extra-dispatch pin: the goodput ledger classifies existing
+    timestamps — a run with full telemetry performs EXACTLY the step
+    dispatches of an obs=None run."""
+    from cyclegan_tpu.data import build_data
+    from cyclegan_tpu.parallel.mesh import replicated
+    from cyclegan_tpu.train import loop
+    from cyclegan_tpu.utils.summary import NullSummary
+
+    config = tiny_config
+    plan = make_mesh_plan(config.parallel, devices[:4])
+    data = build_data(config, 4)
+    step = shard_train_step(plan, make_train_step(config, 4))
+
+    def fresh_state():
+        # The step donates its state buffers: each run needs its own.
+        return jax.device_put(create_state(config, jax.random.PRNGKey(0)),
+                              replicated(plan))
+
+    def counting(counter):
+        def wrapped(*args, **kw):
+            counter.append(1)
+            return step(*args, **kw)
+        return wrapped
+
+    untraced = []
+    loop.train_epoch(config, data, plan, counting(untraced), fresh_state(),
+                     NullSummary(), epoch=0)
+
+    traced = []
+    tele = make_telemetry(
+        ObsConfig(jsonl_path=str(tmp_path / "t.jsonl")), str(tmp_path))
+    assert tele.goodput is not None  # the ledger is on by default
+    loop.train_epoch(config, data, plan, counting(traced), fresh_state(),
+                     NullSummary(), epoch=0, obs=tele)
+    tele.epoch(0, elapse_s=1.0)
+    tele.close()
+
+    assert len(traced) == len(untraced)
+    evs = _events(str(tmp_path / "t.jsonl"))
+    assert any(e["event"] == "goodput" for e in evs)
+
+
+# ------------------------------------------------- downstream folding
+
+
+def test_obs_report_folds_goodput_and_census(tmp_path):
+    """The report renders both new sections — and names their absence
+    explicitly on streams that predate them."""
+    from obs_report import fold, load_events, render
+
+    path = str(tmp_path / "t.jsonl")
+    tele = Telemetry(MetricsLogger(path), goodput=GoodputLedger())
+    clock = tele.step_clock(0)
+    clock.stage_begin(); clock.staged()
+    clock.dispatched(steps=1, kind="single")
+    clock.fetched(0.01, steps=1)
+    clock.finish()
+    tele.event("comms_census", mesh={"n_data": 2, "n_spatial": 1},
+               analytic={"data_bytes": 1000, "spatial_bytes": 0},
+               reconciliation={"data": {"analytic_bytes": 1000,
+                                        "measured_bytes": 990,
+                                        "measured_ops": 3,
+                                        "error": 0.0101}},
+               max_recon_error=0.0101, tolerance=0.10, ok=True)
+    tele.epoch(0, elapse_s=2.0)
+    tele.close()
+
+    events, skipped = load_events(path)
+    report = fold(events, skipped)
+    assert not report["unknown_kinds"]  # both kinds are folded
+    assert report["goodput_rollup"]["n_epochs"] == 1
+    assert report["comms_census_rollup"]["ok"] is True
+    text = render(report)
+    assert "goodput ledger" in text and "comms census" in text
+    assert "RECONCILIATION FAILED" not in text
+
+    # A stream with loop aggregates but neither event renders the
+    # explicit absence lines, not silence.
+    path2 = str(tmp_path / "old.jsonl")
+    tele2 = Telemetry(MetricsLogger(path2), goodput=None)
+    clock = tele2.step_clock(0)
+    clock.stage_begin(); clock.staged()
+    clock.dispatched(steps=1, kind="single")
+    clock.finish()
+    tele2.close()
+    events2, _ = load_events(path2)
+    text2 = render(fold(events2, 0))
+    assert "goodput ledger: absent" in text2
+    assert "comms census: absent" in text2
+
+
+def test_no_sync_covers_observatory_modules():
+    """obs/comms.py and obs/goodput.py live in the hot-path no-sync
+    scan set: the census and ledger must never add a device sync."""
+    from check_no_sync import HOT_PATH_DIRS, run_check
+
+    assert "cyclegan_tpu/obs" in dict(HOT_PATH_DIRS)
+    assert run_check() == []

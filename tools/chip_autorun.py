@@ -167,6 +167,21 @@ def build_queue(mode: str, round_tag: str = ROUND_TAG) -> list:
              env=env, abort_queue_on_fail=True, always_run=True,
              stdout_to=os.path.join(
                  "docs", "chip_logs", round_tag, "graftlint.json")),
+        # Comms-census preflight: compile the round's target mesh (the
+        # validated unrolled smoke program) on HOST devices (tools/
+        # comms_census.py forces
+        # JAX_PLATFORMS=cpu — never an axon client, needs no TPU) and
+        # reconcile its compiled collectives against the analytic
+        # ledger (obs/comms.py). A mis-sharded program — the partitioner
+        # silently resharding where the model says halo, or a gradient
+        # tree dropping out of the all-reduce — fails reconciliation
+        # here and aborts the queue BEFORE any chip time burns on it.
+        Step("comms_census",
+             [py, "tools/comms_census.py", "--devices", "8"], 1800.0,
+             env={**env, "JAX_PLATFORMS": "cpu"},
+             abort_queue_on_fail=True, always_run=True,
+             stdout_to=os.path.join(
+                 "docs", "chip_logs", round_tag, "comms_census.json")),
         # Staged health probe: attributes any hang to init vs compile
         # vs execute. A failure here aborts the queue — the relay is
         # not actually healthy, and further clients would pile onto it.

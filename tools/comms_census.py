@@ -1,0 +1,156 @@
+"""Run the collective comms census for a mesh and print the verdict.
+
+Compiles the REAL sharded train step for the requested dp x spatial
+mesh on host devices (abstract avals — no arrays materialized, the
+dryrun stage-2 pattern), walks the lowered HLO for its collectives,
+and reconciles them against the analytic ledger (obs/comms.py). Exit
+status is the verdict: 0 when every axis reconciles within tolerance,
+1 otherwise — `chip_autorun` runs this as a preflight step so a
+mis-sharded program aborts the queue BEFORE it burns a relay window.
+
+The gated program is the UNROLLED smoke config: the analytic site
+model is validated for unrolled trunks (under scan_blocks XLA sums the
+generator's three gradient contributions before a single all-reduce,
+so per-site multipliers overestimate by design), and the gate's
+question — did the partitioner lay out collectives on THIS mesh the
+way the model expects? — is mesh-shaped, not model-shaped. Pass
+`--full` to additionally compile the full-size scan program and attach
+its measured (parsed-from-HLO) per-axis bytes as an advisory section.
+
+  python tools/comms_census.py --devices 8             # gate, 4x2 mesh
+  python tools/comms_census.py --devices 8 --full      # + advisory 256^2
+  python tools/comms_census.py --devices 8 --out docs/comms_census.json
+
+Prints ONE JSON line (the census payload) to stdout; progress to
+stderr. Forces CPU host devices — the census reads the compiled
+program's text, it never needs the chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> int:
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--devices", default=8, type=int,
+                   help="total mesh size (dp x spatial)")
+    p.add_argument("--spatial", default=None, type=int,
+                   help="spatial axis size (default: 2 when --devices "
+                        "is even, matching dryrun_multichip)")
+    p.add_argument("--full", action="store_true",
+                   help="also compile the full-size (256^2, scanned "
+                        "trunk) program and attach its measured "
+                        "collectives as an advisory section (slow)")
+    p.add_argument("--link_gbps", default=45.0, type=float,
+                   help="per-link one-way GB/s for the per-link time "
+                        "estimate (scaling_model.py default)")
+    p.add_argument("--out", default=None,
+                   help="also write the census payload (pretty JSON) here")
+    args = p.parse_args()
+
+    # Host devices only: assert BEFORE jax import wins the backend race.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from cyclegan_tpu.config import (
+        Config,
+        ModelConfig,
+        ParallelConfig,
+        TrainConfig,
+        tiny_test_config,
+    )
+    from cyclegan_tpu.obs.comms import build_census, parse_hlo_collectives
+    from cyclegan_tpu.parallel import make_mesh_plan, shard_train_step
+    from cyclegan_tpu.train import create_state, make_train_step
+    from cyclegan_tpu.utils.platform import enable_compilation_cache
+
+    def compile_step(cfg, plan, gb):
+        s = cfg.model.image_size
+        state = jax.eval_shape(
+            lambda: create_state(cfg, jax.random.PRNGKey(0)))
+        step = shard_train_step(plan, make_train_step(cfg, gb))
+        img = jax.ShapeDtypeStruct((gb, s, s, 3), np.float32)
+        w = jax.ShapeDtypeStruct((gb,), np.float32)
+        return state, step.lower(state, img, img, w).compile()
+
+    enable_compilation_cache()
+    devices = jax.devices()[:args.devices]
+    if len(devices) < args.devices:
+        print(f"need {args.devices} devices, have {len(devices)}",
+              file=sys.stderr)
+        return 1
+    spatial = args.spatial
+    if spatial is None:
+        spatial = 2 if args.devices % 2 == 0 and args.devices > 1 else 1
+    par = ParallelConfig(spatial_parallelism=spatial)
+    plan = make_mesh_plan(par, devices)
+    cfg = tiny_test_config().replace(parallel=par)
+    gb = plan.n_data * cfg.train.batch_size
+    s = cfg.model.image_size
+    print(f"[comms_census] compiling mesh {plan.n_data}x{plan.n_spatial}, "
+          f"{s}^2, global batch {gb} ...", file=sys.stderr, flush=True)
+    state, compiled = compile_step(cfg, plan, gb)
+    census = build_census(plan, cfg, gb, state,
+                          hlo_text=compiled.as_text(),
+                          link_gbps=args.link_gbps)
+    if args.full:
+        batch = -(-8 // plan.n_data)  # ceil: global batch >= 8
+        cfg_full = Config(
+            model=ModelConfig(image_size=256, scan_blocks=True),
+            parallel=par,
+            train=TrainConfig(batch_size=batch),
+        )
+        gb_full = plan.n_data * batch
+        print(f"[comms_census] compiling full-size 256^2 program "
+              f"(advisory, global batch {gb_full}) ...",
+              file=sys.stderr, flush=True)
+        _, compiled_full = compile_step(cfg_full, plan, gb_full)
+        census["full_size_measured"] = {
+            "note": "compiled full-size scan program (advisory: the "
+                    "analytic site model gates unrolled trunks only)",
+            "image_size": 256,
+            "global_batch": gb_full,
+            "axes": parse_hlo_collectives(
+                compiled_full.as_text(), plan.n_data,
+                plan.n_spatial)["axes"],
+        }
+    for ax, v in census.get("reconciliation", {}).items():
+        print(f"[comms_census] {ax}: analytic "
+              f"{v['analytic_bytes'] / 1e6:.2f} MB vs measured "
+              f"{v['measured_bytes'] / 1e6:.2f} MB over "
+              f"{v['measured_ops']} ops (err {v['error'] * 100:.1f}%)",
+              file=sys.stderr, flush=True)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(census, f, indent=2, sort_keys=True)
+        print(f"[comms_census] wrote {args.out}", file=sys.stderr)
+    print(json.dumps(census), flush=True)
+    if not census.get("ok", False):
+        print("[comms_census] RECONCILIATION FAILED: analytic model and "
+              "compiled program disagree beyond "
+              f"{census['tolerance'] * 100:.0f}% — do not burn chip time "
+              "on this program", file=sys.stderr)
+        return 1
+    print(f"[comms_census] OK (max axis error "
+          f"{census.get('max_recon_error', 0) * 100:.1f}%)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,224 @@
+"""Export a training run's goodput ledger to a Chrome/Perfetto
+timeline + per-epoch phase table.
+
+The training-side twin of trace_timeline.py: where that tool renders
+the serving pipeline's per-request spans, this one renders the
+dispatch loop's wall-clock attribution from the events the telemetry
+stream already carries — per-dispatch ``step`` records, per-pass
+``epoch_steps`` aggregates, per-epoch ``goodput`` rollups
+(obs/goodput.py), ``service_job`` completions, and ``loop_stall``
+instants. No new instrumentation: a stream written by any traced run
+renders as-is.
+
+- **Perfetto JSON** (``--out``): one "epochs" track with a span per
+  epoch (named with its goodput fraction, phase seconds in args), a
+  per-split "steps" track tiling each dispatch's stage/dispatch/fetch/
+  host windows, a "services" track for epoch-services jobs, and
+  loop-stall instants. Timestamps are reconstructed from each event's
+  stream offset ``t`` and its duration fields (spans end at emit time).
+- **Phase table** (stdout): per-epoch phase fractions with the badput
+  census, plus the run rollup.
+
+Usage:
+  python tools/goodput_timeline.py runs/telemetry.jsonl --out goodput.json
+  python tools/goodput_timeline.py runs/telemetry.jsonl --json
+
+Stdlib only; pure host-side file reads.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+PHASE_ORDER = ("compute", "collective", "data_wait", "host", "compile",
+               "services", "idle")
+
+
+def load_events(path: str) -> List[dict]:
+    """All parseable events from a JSONL stream; torn lines skipped."""
+    out: List[dict] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(ev, dict) and "event" in ev:
+                out.append(ev)
+    return out
+
+
+def export_perfetto(events: List[dict]) -> dict:
+    """Chrome trace-event JSON (see trace_timeline.export_perfetto for
+    the format conventions mirrored here: ph "X" spans, ph "i"
+    instants, ph "M" track names, microsecond deltas)."""
+
+    def us(t: float) -> float:
+        return round(t * 1e6, 3)
+
+    tracks: Dict[str, int] = {"epochs": 1, "services": 2}
+    out: List[dict] = []
+    for ev in events:
+        kind = ev.get("event")
+        t = ev.get("t")
+        if t is None:
+            continue
+        if kind == "goodput":
+            dur = float(ev.get("elapse_s") or 0.0)
+            frac = ev.get("goodput_fraction")
+            label = f"epoch {ev.get('epoch', '?')}"
+            if frac is not None:
+                label += f" (goodput {float(frac) * 100:.0f}%)"
+            out.append({
+                "name": label, "cat": "goodput", "ph": "X", "pid": 1,
+                "tid": tracks["epochs"], "ts": us(t - dur),
+                "dur": round(dur * 1e6, 3),
+                "args": {"phases_s": ev.get("phases_s"),
+                         "badput": ev.get("badput"),
+                         "n_steps": ev.get("n_steps")},
+            })
+        elif kind == "step":
+            split = ev.get("split", "train")
+            track = f"steps:{split}"
+            tid = tracks.setdefault(track, len(tracks) + 1)
+            wall = float(ev.get("wall_s") or 0.0)
+            start = t - wall
+            # Tile the dispatch's windows in loop order; the remainder
+            # is host work (bookkeeping between windows).
+            cursor = start
+            for name, key in (("stage", "stage_s"),
+                              ("dispatch", "dispatch_s"),
+                              ("fetch", "fetch_block_s")):
+                d = float(ev.get(key) or 0.0)
+                if d > 0:
+                    out.append({
+                        "name": name, "cat": "window", "ph": "X",
+                        "pid": 1, "tid": tid, "ts": us(cursor),
+                        "dur": round(d * 1e6, 3),
+                        "args": {"dispatch": ev.get("dispatch"),
+                                 "epoch": ev.get("epoch")},
+                    })
+                    cursor += d
+            host = max(0.0, start + wall - cursor)
+            if host > 0:
+                out.append({
+                    "name": "host", "cat": "window", "ph": "X",
+                    "pid": 1, "tid": tid, "ts": us(cursor),
+                    "dur": round(host * 1e6, 3),
+                    "args": {"dispatch": ev.get("dispatch"),
+                             "epoch": ev.get("epoch")},
+                })
+        elif kind == "service_job":
+            dur = float(ev.get("seconds") or 0.0)
+            out.append({
+                "name": ev.get("job", "service"), "cat": "service",
+                "ph": "X", "pid": 1, "tid": tracks["services"],
+                "ts": us(t - dur), "dur": round(dur * 1e6, 3),
+                "args": {k: v for k, v in ev.items()
+                         if k not in ("event", "t")},
+            })
+        elif kind == "loop_stall":
+            split = ev.get("split", "train")
+            tid = tracks.setdefault(f"steps:{split}", len(tracks) + 1)
+            out.append({
+                "name": "loop_stall", "cat": "stall", "ph": "i",
+                "s": "t", "pid": 1, "tid": tid, "ts": us(t),
+                "args": {k: v for k, v in ev.items()
+                         if k not in ("event", "t")},
+            })
+    meta = [{"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+             "args": {"name": label}}
+            for label, tid in sorted(tracks.items(), key=lambda kv: kv[1])]
+    meta += [{"name": "thread_sort_index", "ph": "M", "pid": 1,
+              "tid": tid, "args": {"sort_index": tid}}
+             for _, tid in sorted(tracks.items(), key=lambda kv: kv[1])]
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+def phase_table(events: List[dict]) -> dict:
+    """Per-epoch goodput rows + the whole-run rollup (seconds-weighted
+    across epochs)."""
+    epochs = []
+    totals = {p: 0.0 for p in PHASE_ORDER}
+    elapse = 0.0
+    for ev in events:
+        if ev.get("event") != "goodput":
+            continue
+        phases = ev.get("phases_s") or {}
+        epochs.append({
+            "epoch": ev.get("epoch"),
+            "elapse_s": ev.get("elapse_s"),
+            "goodput_fraction": ev.get("goodput_fraction"),
+            "phase_fractions": ev.get("phase_fractions") or {},
+            "badput": ev.get("badput") or {},
+        })
+        for p in PHASE_ORDER:
+            totals[p] += float(phases.get(p) or 0.0)
+        elapse += float(ev.get("elapse_s") or 0.0)
+    run = None
+    if elapse > 0:
+        run = {
+            "elapse_s": round(elapse, 3),
+            "phase_fractions": {p: round(totals[p] / elapse, 4)
+                                for p in PHASE_ORDER},
+            "goodput_fraction": round(totals["compute"] / elapse, 4),
+        }
+    return {"epochs": epochs, "run": run}
+
+
+def render_table(table: dict) -> str:
+    lines = []
+    header = f"{'epoch':>6} {'elapse s':>9} " + " ".join(
+        f"{p[:8]:>9}" for p in PHASE_ORDER)
+    lines.append(header)
+    for row in table["epochs"]:
+        fr = row["phase_fractions"]
+        lines.append(
+            f"{str(row['epoch']):>6} {row['elapse_s']:>9} " + " ".join(
+                f"{100 * float(fr.get(p) or 0):>8.1f}%" for p in PHASE_ORDER))
+    run = table["run"]
+    if run is not None:
+        fr = run["phase_fractions"]
+        lines.append(
+            f"{'run':>6} {run['elapse_s']:>9} " + " ".join(
+                f"{100 * float(fr.get(p) or 0):>8.1f}%" for p in PHASE_ORDER))
+        lines.append(f"run goodput fraction: "
+                     f"{run['goodput_fraction'] * 100:.1f}%")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("stream", help="JSONL telemetry stream")
+    p.add_argument("--out", default=None,
+                   help="write Perfetto/Chrome trace-event JSON here")
+    p.add_argument("--json", action="store_true",
+                   help="print the phase table as JSON instead of text")
+    args = p.parse_args(argv)
+
+    events = load_events(args.stream)
+    table = phase_table(events)
+    if not table["epochs"]:
+        print("no goodput events in the stream (run predates the "
+              "ledger, or telemetry was disabled)", file=sys.stderr)
+        return 1
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(export_perfetto(events), f)
+        print(f"wrote {args.out} (load at ui.perfetto.dev)",
+              file=sys.stderr)
+    if args.json:
+        print(json.dumps(table, indent=2))
+    else:
+        print(render_table(table))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -140,6 +140,10 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
         # event per trace (+ late=True supplements for spans that
         # arrived after their trace flushed, e.g. cancelled hedge twins).
         "traces": [],
+        # Scale-out observatory (obs/goodput.py, obs/comms.py): per-
+        # epoch wall-clock phase rollups and collective-traffic census.
+        "goodputs": [],
+        "comms_censuses": [],
         # Forward-compat census: event kinds this folder does not know.
         # They are still ignored (never fatal), but COUNTED — the render
         # names them explicitly instead of silently dropping them.
@@ -234,6 +238,10 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             report["emergency_saves"].append(ev)
         elif kind == "trace":
             report["traces"].append(ev)
+        elif kind == "goodput":
+            report["goodputs"].append(ev)
+        elif kind == "comms_census":
+            report["comms_censuses"].append(ev)
         elif kind == "end":
             report["end"] = ev
         else:
@@ -472,6 +480,43 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             "quarantine_actions": q_actions,
         }
 
+    # Goodput rollup: seconds-weighted phase census over the per-epoch
+    # `goodput` events — where every wall-clock second of the run went,
+    # the run-level goodput fraction, and the epoch that wasted the
+    # most (the one to open in tools/goodput_timeline.py).
+    if report["goodputs"]:
+        total_s = sum(float(ev.get("elapse_s", 0.0))
+                      for ev in report["goodputs"])
+        phases_s: Dict[str, float] = {}
+        for ev in report["goodputs"]:
+            for p, s in (ev.get("phases_s") or {}).items():
+                phases_s[str(p)] = phases_s.get(str(p), 0.0) + float(s)
+        fracs = {p: (s / total_s if total_s > 0 else 0.0)
+                 for p, s in phases_s.items()}
+        worst = min(
+            report["goodputs"],
+            key=lambda ev: (float(ev.get("goodput_fraction", 1.0)),
+                            -float(ev.get("elapse_s", 0.0))))
+        report["goodput_rollup"] = {
+            "n_epochs": len(report["goodputs"]),
+            "elapse_s": total_s,
+            "phases_s": phases_s,
+            "phase_fractions": fracs,
+            "goodput_fraction": fracs.get("compute", 0.0),
+            "badput": dict(sorted(
+                ((p, f) for p, f in fracs.items()
+                 if p != "compute" and f > 0),
+                key=lambda kv: -kv[1])),
+            "worst_epoch": worst.get("epoch"),
+            "worst_epoch_fraction": worst.get("goodput_fraction"),
+        }
+
+    # Comms-census rollup: the LAST census wins (a stream legally
+    # carries one per round); per-axis analytic-vs-measured bytes and
+    # the reconciliation verdict.
+    if report["comms_censuses"]:
+        report["comms_census_rollup"] = report["comms_censuses"][-1]
+
     # Request-trace rollup: status census, sampling provenance (head
     # sample vs tail-kept failure), per-hop duration stats, and the
     # slowest exemplars with their trace_id — the "which trace_id do I
@@ -640,6 +685,60 @@ def render(report: dict) -> str:
     if "train_starvation_fraction" in report:
         w(f"run starvation fraction (train): "
           f"{_fmt(report['train_starvation_fraction'])}")
+
+    # Goodput ledger: the wall-clock phase census. Every second of the
+    # run is in exactly one phase, so the fractions answer "where did
+    # the time go" without any cross-referencing.
+    gp = report.get("goodput_rollup")
+    if gp:
+        w(f"-- goodput ledger ({gp['n_epochs']} epoch rollups, "
+          f"{_fmt(gp['elapse_s'], '.1f')}s accounted) --")
+        w(f"goodput fraction: {_fmt(gp['goodput_fraction'], '.3f')} "
+          f"(device compute share of wall-clock)")
+        if gp["badput"]:
+            w("badput: " + ", ".join(
+                f"{p}={_fmt(f, '.3f')}" for p, f in gp["badput"].items()))
+        else:
+            w("badput: none recorded")
+        w(f"worst epoch: {gp.get('worst_epoch', '?')} at "
+          f"{_fmt(gp.get('worst_epoch_fraction'), '.3f')} goodput "
+          f"(open it in tools/goodput_timeline.py)")
+    elif report["epoch_steps"]:
+        # A training stream with loop aggregates but no rollups is a
+        # version-skew signal, same convention as the traces line.
+        w("-- goodput ledger: absent (no `goodput` events; stream "
+          "predates obs/goodput.py?) --")
+
+    cen = report.get("comms_census_rollup")
+    if cen:
+        mesh = cen.get("mesh") or {}
+        w(f"-- comms census (mesh {mesh.get('n_data', '?')} data x "
+          f"{mesh.get('n_spatial', '?')} spatial) --")
+        recon = cen.get("reconciliation") or {}
+        for ax, v in sorted(recon.items()):
+            w(f"{ax} axis: analytic {_fmt_bytes(v.get('analytic_bytes'))} "
+              f"vs measured {_fmt_bytes(v.get('measured_bytes'))} per "
+              f"step ({v.get('measured_ops', '?')} ops, error "
+              f"{_fmt(v.get('error'), '.3f')})")
+        if not recon:
+            ana = cen.get("analytic") or {}
+            w(f"analytic only (no compiled HLO): data "
+              f"{_fmt_bytes(ana.get('data_bytes'))}, spatial "
+              f"{_fmt_bytes((ana.get('spatial_bytes') or 0) or None)} "
+              f"per step")
+        if cen.get("max_recon_error") is not None:
+            tol = cen.get("tolerance")
+            verdict = "OK" if cen.get("ok") else "RECONCILIATION FAILED"
+            w(f"verdict: {verdict} (max axis error "
+              f"{_fmt(cen['max_recon_error'], '.3f')} vs tolerance "
+              f"{_fmt(tol, '.2f')})")
+        if cen.get("est_step_comms_s") is not None:
+            w(f"per-step collective estimate: "
+              f"{_fmt(cen['est_step_comms_s'], '.6f')}s at "
+              f"{_fmt(cen.get('link_gbps'), '.0f')} GB/s links")
+    elif report["epoch_steps"]:
+        w("-- comms census: absent (no `comms_census` event; single-"
+          "device run, or stream predates obs/comms.py?) --")
 
     if report["memory"]:
         w("-- memory watermarks --")

@@ -197,6 +197,95 @@ def plot_health(health: list, faults: list, out: str, title: str = "",
     return n_series
 
 
+def read_goodput_events(jsonl_path: str) -> list:
+    """Per-epoch `goodput` rollups (obs/goodput.py) from a telemetry
+    stream, in order. Malformed lines are skipped."""
+    out = []
+    with open(jsonl_path, "r", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(ev, dict) and ev.get("event") == "goodput":
+                out.append(ev)
+    return out
+
+
+# Canonical phase order for the stacked bars (obs/goodput.py PHASES);
+# unknown phases from newer streams stack after these, alphabetically.
+_GOODPUT_PHASES = ("compute", "collective", "data_wait", "host",
+                   "compile", "services", "idle")
+_PHASE_COLORS = {
+    "compute": "#2a9d2a",
+    "collective": "#6a5acd",
+    "data_wait": "#e07b39",
+    "host": "#d4b106",
+    "compile": "#8b5a2b",
+    "services": "#4682b4",
+    "idle": "#b0b0b0",
+}
+
+
+def plot_goodput(events: list, out: str, title: str = "") -> int:
+    """Stacked per-epoch phase-fraction bars from `goodput` rollups:
+    green is device compute (the goodput), everything above it is
+    badput with its cause labeled. Returns the number of bars drawn."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    if not events:
+        raise SystemExit(
+            "no `goodput` events in the stream (the ledger needs "
+            "StepClock data — streams predating obs/goodput.py or "
+            "metrics-disabled runs have none)"
+        )
+    epochs = [int(ev.get("epoch", i)) for i, ev in enumerate(events)]
+    seen = {p for ev in events for p in (ev.get("phase_fractions") or {})}
+    phases = [p for p in _GOODPUT_PHASES if p in seen]
+    phases += sorted(seen - set(phases))
+
+    fig, ax = plt.subplots(figsize=(max(7, 0.6 * len(epochs) + 3), 4.5))
+    bottoms = [0.0] * len(events)
+    for phase in phases:
+        vals = [float((ev.get("phase_fractions") or {}).get(phase, 0.0))
+                for ev in events]
+        if not any(vals):
+            continue
+        ax.bar(epochs, vals, bottom=bottoms, width=0.8, label=phase,
+               color=_PHASE_COLORS.get(phase))
+        bottoms = [b + v for b, v in zip(bottoms, vals)]
+    # Label each epoch with its goodput % and its dominant badput cause
+    # — the one-glance answer to "where did the wall-clock go".
+    for x, ev in zip(epochs, events):
+        gp = ev.get("goodput_fraction")
+        badput = ev.get("badput") or {}
+        worst = max(badput, key=badput.get) if badput else None
+        text = f"{100 * float(gp):.0f}%" if gp is not None else "?"
+        if worst:
+            text += f"\n{worst} {100 * float(badput[worst]):.0f}%"
+        ax.text(x, 1.02, text, ha="center", va="bottom", fontsize=7)
+    ax.set_xlabel("epoch")
+    ax.set_ylabel("wall-clock fraction")
+    ax.set_ylim(0, 1.18)
+    ax.set_xticks(epochs)
+    ax.legend(fontsize=7, ncol=min(4, len(phases)), loc="lower right")
+    ax.grid(alpha=0.3, axis="y")
+    if title:
+        ax.set_title(title)
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    fig.savefig(out, dpi=120)
+    print(f"plotted {len(events)} goodput bars "
+          f"({len(phases)} phases) -> {out}")
+    return len(events)
+
+
 if __name__ == "__main__":
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--run", help="training output dir (TensorBoard mode)")
@@ -204,12 +293,21 @@ if __name__ == "__main__":
                    help="regex(es) matched against full scalar tags "
                         "(TensorBoard mode)")
     p.add_argument("--jsonl", help="telemetry stream: plot `health` "
-                                   "events instead of TB scalars")
+                                   "events (or `goodput` rollups with "
+                                   "--jsonl_mode goodput) instead of "
+                                   "TB scalars")
+    p.add_argument("--jsonl_mode", default="health",
+                   choices=("health", "goodput"),
+                   help="which stream view to render: the two-panel "
+                        "health figure, or the stacked per-epoch "
+                        "goodput/badput phase bars")
     p.add_argument("--out", required=True, help="destination PNG")
     p.add_argument("--title", default="")
     p.add_argument("--logy", action="store_true")
     a = p.parse_args()
-    if a.jsonl:
+    if a.jsonl and a.jsonl_mode == "goodput":
+        plot_goodput(read_goodput_events(a.jsonl), a.out, a.title)
+    elif a.jsonl:
         health, faults = read_health_events(a.jsonl)
         plot_health(health, faults, a.out, a.title, a.logy)
     elif a.run and a.tags:

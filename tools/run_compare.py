@@ -36,6 +36,17 @@ Axes for a stream pair (each gated by its own threshold flag):
                transfer-onboarded run (domains/transfer.py) whose
                recorded parent_domain matches the base: then the
                transfer axis alone engages
+  goodput      seconds-weighted goodput fraction from the per-epoch
+               `goodput` rollups (obs/goodput.py): a candidate whose
+               fraction drops more than --max_goodput_drop below the
+               base wasted wall-clock somewhere (data-wait, host work,
+               checkpoint barriers) even at unchanged steady-state
+               img/s; SKIPs when either stream predates the ledger
+  comms-census candidate-side invariant (like the serve trace-overhead
+               gate): the last `comms_census` event's analytic-vs-
+               compiled reconciliation error must sit inside the
+               census's own tolerance (10%) — census drift means the
+               model or the sharding changed silently
   transfer     a fine-tune (`transfer_init` in the stream) is gated
                against its parent run: final losses within
                --max_loss_increase of the parent's, epoch count at most
@@ -311,6 +322,26 @@ def stream_profile(events: List[dict], skipped: int = 0, name: str = "?") -> dic
                     series.setdefault(str(k), []).extend(
                         float(x) for x in v)
         step_losses[ep] = series
+    # Goodput ledger (PR-16): seconds-weighted goodput fraction over
+    # the run's per-epoch rollups, plus the last comms census's
+    # reconciliation verdict. Streams predating the ledger profile as
+    # None and the axes SKIP / stay candidate-side.
+    gp_num = gp_den = 0.0
+    for e in events:
+        if e.get("event") != "goodput":
+            continue
+        frac = _float(e.get("goodput_fraction"))
+        dur = _float(e.get("elapse_s"))
+        if frac is not None and dur:
+            gp_num += frac * dur
+            gp_den += dur
+    goodput = (gp_num / gp_den) if gp_den > 0 else None
+    census = next((e for e in reversed(events)
+                   if e.get("event") == "comms_census"), None)
+    census_err = _float(census.get("max_recon_error")) \
+        if census is not None else None
+    census_tol = (_float(census.get("tolerance")) or 0.10) \
+        if census is not None else None
     end = next((e for e in events if e.get("event") == "end"), None)
     halting = sum(1 for e in faults if e.get("policy") == "halt")
     if end is not None and end.get("status") == "health_fault":
@@ -338,6 +369,9 @@ def stream_profile(events: List[dict], skipped: int = 0, name: str = "?") -> dic
         "n_emergency_saves": len(saves),
         "n_uncommitted_saves": n_uncommitted,
         "step_losses": step_losses,
+        "goodput_fraction": goodput,
+        "census_recon_error": census_err,
+        "census_tolerance": census_tol,
         "end_status": end.get("status") if end else None,
     }
 
@@ -634,6 +668,43 @@ def _compare_streams(base: dict, cand: dict, th) -> List[Check]:
                        f"{cand.get('n_fleet_recoveries', 0)} "
                        f"(reported, not gated)"))
 
+    # Goodput axis (PR-16): the ledger classifies every wall-clock
+    # second of the run; the gated quantity is the seconds-weighted
+    # fraction spent in device compute. A candidate whose goodput
+    # fraction drops more than --max_goodput_drop below the base wasted
+    # chip time SOMEWHERE (data-wait, host work, checkpoint barriers)
+    # even if its steady-state img/s looks unchanged — throughput
+    # measures the steps that ran, goodput measures the seconds that
+    # didn't.
+    b_gp, c_gp = base.get("goodput_fraction"), cand.get("goodput_fraction")
+    if b_gp is not None and c_gp is not None:
+        drop = b_gp - c_gp
+        status = FAIL if drop > th.max_goodput_drop else PASS
+        checks.append((status, "goodput",
+                       f"goodput fraction {b_gp:.3f} -> {c_gp:.3f} "
+                       f"(drop {drop:+.3f} vs limit "
+                       f"{th.max_goodput_drop:.3f})"))
+    else:
+        checks.append((SKIP, "goodput",
+                       "no goodput ledger in one stream "
+                       "(predates the ledger?)"))
+
+    # Comms-census axis: candidate-side invariant (like the serve trace
+    # overhead gate) — judged on the candidate alone, because the claim
+    # is absolute: the analytic collective ledger must reconcile with
+    # the compiled program within the census's own tolerance. Census
+    # drift means the model or the sharding changed silently.
+    c_err = cand.get("census_recon_error")
+    if c_err is not None:
+        tol = cand.get("census_tolerance") or 0.10
+        status = FAIL if c_err > tol else PASS
+        checks.append((status, "comms-census",
+                       f"analytic vs compiled reconciliation error "
+                       f"{100 * c_err:.1f}% (limit {100 * tol:.0f}%)"))
+    else:
+        checks.append((SKIP, "comms-census",
+                       "no comms_census event in the candidate stream"))
+
     # Elastic axis: engages when the candidate resharded across
     # topologies or emergency-saved mid-epoch. The claim under gate is
     # cross-mesh EQUIVALENCE: same per-step losses as the base, same
@@ -791,6 +862,7 @@ def make_thresholds(
     max_elastic_loss_diff: float = 1e-5,
     max_transfer_epoch_frac: float = 0.25,
     max_trace_overhead: float = 0.03,
+    max_goodput_drop: float = 0.05,
     json: bool = False,
 ) -> argparse.Namespace:
     """Programmatic threshold bundle (bench.py's end-of-run hook)."""
@@ -804,6 +876,7 @@ def make_thresholds(
         max_elastic_loss_diff=max_elastic_loss_diff,
         max_transfer_epoch_frac=max_transfer_epoch_frac,
         max_trace_overhead=max_trace_overhead,
+        max_goodput_drop=max_goodput_drop,
         json=json,
     )
 
@@ -838,6 +911,10 @@ def main(argv=None) -> int:
                         help="max fractional throughput cost of serving "
                              "at --trace_sample 1.0 vs 0.0 (candidate-"
                              "side; bench_serve trace_overhead phase)")
+    parser.add_argument("--max_goodput_drop", default=0.05, type=float,
+                        help="max absolute drop of the seconds-weighted "
+                             "goodput fraction (obs/goodput.py ledger) "
+                             "vs base")
     parser.add_argument("--max_transfer_epoch_frac", default=0.25, type=float,
                         help="max epochs a transfer-onboarded fine-tune may "
                              "run, as a fraction of its parent's from-scratch "
