@@ -25,8 +25,11 @@ Methodology notes:
 - The fleet tier (serve/fleet/) is measured on top: saturated
   throughput through 2 replicas + the admission-controlled EDF queue
   (must hold the single-replica record), the int8 quantized-tier row
-  (throughput + max output delta vs the base tier), and one mixed-class
-  overload point at ~1.8x capacity against a tight admission queue —
+  (throughput + max output delta vs the base tier), the int8_fused
+  inference-only row (in-kernel dequant + zero-skip upsample +
+  forward-only kernels — must beat the dequant-outside int8 row), and
+  one mixed-class overload point at ~1.8x capacity against a tight
+  admission queue —
   the shed counts must land on `best_effort`/`batch` while
   `interactive` p95 stays near its bound (class-ordered shedding).
 - The autoscale phase replays overload-class traffic as a surge ->
@@ -517,7 +520,8 @@ def main(argv=None) -> int:
         model_cfg, fwd_params, bwd_params=None,
         serve_cfg=ServeConfig(batch_buckets=tuple(sorted({1, args.batch})),
                               sizes=(args.image,), dtype=args.dtype,
-                              with_cycle=False, int8_tier=True))
+                              with_cycle=False, int8_tier=True,
+                              infer_tier=True))
     executor = PipelinedExecutor(engine, max_batch=args.batch,
                                  max_wait_ms=args.max_wait_ms,
                                  logger=_OBS_LOGGER)
@@ -569,6 +573,7 @@ def main(argv=None) -> int:
     #    shedding.
     fleet_line = None
     int8_line = None
+    int8_fused_line = None
     if time.perf_counter() - t_start <= TIME_BUDGET_S:
         from cyclegan_tpu.serve.engine import preprocess_request
         from cyclegan_tpu.serve.fleet import (
@@ -659,7 +664,20 @@ def main(argv=None) -> int:
         # output delta vs the base tier on one bucket (weight-only
         # per-channel symmetric, f32 accumulate — the delta should be
         # small but nonzero).
-        i8 = bench_fleet_saturated(fleet, images, tier="int8")
+        # The int8 vs int8_fused rows are an acceptance-gated A/B
+        # (run_compare + the ISSUE headline), so they get the same
+        # jitter-damping as the trace phase: interleaved best-of-2,
+        # both tiers sampling the same contention environment instead
+        # of single rounds minutes apart.
+        tier_rows = {"int8": None, "int8_fused": None}
+        for _rep in range(2):
+            for tname in tier_rows:
+                row = bench_fleet_saturated(fleet, images, tier=tname)
+                best = tier_rows[tname]
+                if best is None or (row["images_per_sec"]
+                                    > best["images_per_sec"]):
+                    tier_rows[tname] = row
+        i8 = tier_rows["int8"]
         x_tol = np.stack([preprocess_request(im, args.image)
                           for im in images[:args.batch]])
         (base_out,), _ = engine.run(x_tol, size=args.image)
@@ -680,6 +698,29 @@ def main(argv=None) -> int:
             # error, so the honest delta is ~1e-9 — tiny but NONZERO,
             # which is itself the proof the quantized programs ran.
             "max_abs_diff_vs_base": int8_diff,
+        }
+
+        # int8_fused tier: the inference-only composition (in-kernel
+        # dequant + zero-skip upsample + forward-only kernels). The
+        # acceptance bar is this row beating the dequant-outside int8
+        # row on saturated img/s; the unrounded delta vs base proves
+        # the fused programs (not the int8 set) produced the outputs.
+        fz = tier_rows["int8_fused"]
+        (fz_out,), _ = engine.run(x_tol, size=args.image,
+                                  tier="int8_fused")
+        int8_fused_diff = float(np.max(np.abs(
+            np.asarray(base_out, np.float32)
+            - np.asarray(fz_out, np.float32))))
+        say(f"{key}: int8_fused tier {fz['images_per_sec']:.2f} "
+            f"images/sec, max |int8_fused - {args.dtype}| = "
+            f"{int8_fused_diff:.4g}")
+        _obs_event("bench", key=key + "/fleet_int8_fused",
+                   images_per_sec=round(fz["images_per_sec"], 4),
+                   platform=platform)
+        int8_fused_line = {
+            "images_per_sec": round(fz["images_per_sec"], 2),
+            "p95_ms": round(fz["p95_ms"], 1),
+            "max_abs_diff_vs_base": int8_fused_diff,
         }
 
         fleet_summary = fleet.close()
@@ -743,7 +784,8 @@ def main(argv=None) -> int:
                         eval_s=0.05, hysteresis=2, cooldown_s=1.0,
                         up_backlog_s=0.1),
                     cascade=CascadeConfig(
-                        tiers=("base", "int8"), enter_backlog_s=0.05,
+                        tiers=("base", "int8", "int8_fused"),
+                        enter_backlog_s=0.05,
                         exit_backlog_s=0.02, hysteresis=2,
                         cooldown_s=0.1, shadow_fraction=0.05)),
                 logger=trace)
@@ -832,6 +874,8 @@ def main(argv=None) -> int:
         line["fleet"] = fleet_line
     if int8_line is not None:
         line["int8"] = int8_line
+    if int8_fused_line is not None:
+        line["int8_fused"] = int8_fused_line
     if sweep:
         line["load_sweep"] = [
             {k: (round(v, 3) if isinstance(v, float) else v)

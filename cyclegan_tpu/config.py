@@ -51,7 +51,10 @@ class ModelConfig:
     compute_dtype: str = "float32"  # "bfloat16" for MXU-friendly mixed precision
     remat: bool = False  # jax.checkpoint residual blocks (512^2 HBM relief)
     scan_blocks: bool = False  # lax.scan the residual trunk (smaller HLO, faster compiles)
-    instance_norm_impl: str = "auto"  # "xla" | "pallas" | "auto"
+    # "auto_fwd"/"pallas_fwd" are the inference-only forms: identical
+    # dispatch to "auto"/"pallas", but Pallas sites build no_vjp=True
+    # (no custom-VJP registration — serve tier "int8_fused").
+    instance_norm_impl: str = "auto"  # "xla"|"pallas"|"auto"|"auto_fwd"|"pallas_fwd"
     # "reflect" = reference parity (ReflectionPadding2D, model.py:14-33);
     # "zero" = conv built-in SAME padding: same parameter tree (checkpoint
     # compatible), different border semantics — a TPU perf option whose
@@ -104,7 +107,11 @@ class ModelConfig:
     #                    (ops/pallas/upsample_kernel.py), eligibility-
     #                    gated per shape/dtype with the XLA zeroskip
     #                    path as fallback.
-    upsample_impl: str = "dense"  # "dense" | "zeroskip" | "zeroskip_fused"
+    # "zeroskip_fused_int8" = the inference-only serve-tier form: the
+    #                    upsample weights stay int8 (in-kernel dequant
+    #                    on TPU, per-kernel dequant + XLA zeroskip off
+    #                    TPU); no VJP exists on this path.
+    upsample_impl: str = "dense"  # "dense"|"zeroskip"|"zeroskip_fused"|"zeroskip_fused_int8"
 
     def __post_init__(self):
         # A typo like "Reflect" would otherwise silently select zero/SAME
@@ -115,9 +122,11 @@ class ModelConfig:
             raise ValueError(
                 f"pad_mode must be 'reflect' or 'zero', got {self.pad_mode!r}"
             )
-        if self.instance_norm_impl not in ("auto", "xla", "pallas"):
+        if self.instance_norm_impl not in (
+                "auto", "xla", "pallas", "auto_fwd", "pallas_fwd"):
             raise ValueError(
-                "instance_norm_impl must be 'auto', 'xla' or 'pallas', "
+                "instance_norm_impl must be 'auto', 'xla', 'pallas', "
+                "'auto_fwd' or 'pallas_fwd', "
                 f"got {self.instance_norm_impl!r}"
             )
         if self.pad_impl not in ("pad", "fused", "epilogue"):
@@ -130,16 +139,18 @@ class ModelConfig:
                 f"trunk_impl must be 'resnet' or 'perturb', got "
                 f"{self.trunk_impl!r}"
             )
-        if self.upsample_impl not in ("dense", "zeroskip", "zeroskip_fused"):
+        if self.upsample_impl not in (
+                "dense", "zeroskip", "zeroskip_fused", "zeroskip_fused_int8"):
             raise ValueError(
-                "upsample_impl must be 'dense', 'zeroskip' or "
-                f"'zeroskip_fused', got {self.upsample_impl!r}"
+                "upsample_impl must be 'dense', 'zeroskip', "
+                "'zeroskip_fused' or 'zeroskip_fused_int8', "
+                f"got {self.upsample_impl!r}"
             )
-        if (self.upsample_impl == "zeroskip_fused"
+        if (self.upsample_impl in ("zeroskip_fused", "zeroskip_fused_int8")
                 and self.instance_norm_impl == "xla"):
             raise ValueError(
-                "upsample_impl='zeroskip_fused' embeds a Pallas instance "
-                "norm in the fused upsample kernel; "
+                f"upsample_impl={self.upsample_impl!r} embeds a Pallas "
+                "instance norm in the fused upsample kernel; "
                 "instance_norm_impl='xla' contradicts it — use 'auto' (or "
                 "'pallas'), or upsample_impl='zeroskip' for the pure-XLA "
                 "decomposition"

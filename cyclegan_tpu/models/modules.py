@@ -21,7 +21,11 @@ import jax.numpy as jnp
 
 from cyclegan_tpu.ops.norm import instance_norm, instance_norm_act_pad
 from cyclegan_tpu.ops.padding import reflect_conv, reflect_pad
-from cyclegan_tpu.ops.upsample import conv_transpose_up2, upsample_norm_relu_pad
+from cyclegan_tpu.ops.upsample import (
+    conv_transpose_up2,
+    upsample_norm_relu_pad,
+    upsample_norm_relu_pad_int8,
+)
 
 Dtype = Any
 
@@ -355,6 +359,32 @@ class ZeroSkipKernel(nn.Module):
         )
 
 
+class QuantZeroSkipKernel(nn.Module):
+    """Param holder for the inference-only int8 upsample tier: declares
+    "kernel" as the QUANTIZED dict — {"int8_q": int8 (3, 3, Cin,
+    features), "int8_scale": f32 (1, 1, 1, features)} — exactly the
+    structure serve.engine.quantize_params_int8 produces for the dense
+    tier's ConvTranspose kernel (flax validates bound params by
+    flattened leaf shapes, so a dict-valued param binds cleanly).
+    Callers pin the name "ConvTranspose_0" so the quantized serving
+    tree drops in with NO remapping: quantize the dense checkpoint,
+    keep the upsample leaves as dicts, apply."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> dict:
+        def init_q(_rng):
+            return {
+                "int8_q": jnp.zeros(
+                    (3, 3, x.shape[-1], self.features), jnp.int8),
+                "int8_scale": jnp.ones(
+                    (1, 1, 1, self.features), jnp.float32),
+            }
+
+        return self.param("kernel", init_q)
+
+
 class NormParams(nn.Module):
     """Param holder declaring InstanceNorm's "scale"/"bias" (same names,
     shapes, init) without applying the op — for fused kernels that
@@ -391,9 +421,15 @@ class Upsample(nn.Module):
                         (> reflect-pad) in one VMEM residency
                         (ops/pallas/upsample_kernel.py), XLA zeroskip
                         fallback where the slab is ineligible.
+      "zeroskip_fused_int8": the inference-only serve-tier form — the
+                        kernel param is the QUANTIZED dict
+                        (QuantZeroSkipKernel) and the weights stay int8
+                        into the Pallas kernel (in-kernel dequant); no
+                        VJP exists on this path.
     The zero-skip tiers require the default 3x3/stride-2 geometry and
-    declare the identical param tree via ZeroSkipKernel/NormParams, so
-    checkpoints interchange across all three.
+    declare the identical param tree via ZeroSkipKernel/NormParams
+    (int8: the quantized image of that tree), so checkpoints
+    interchange across all tiers.
     """
 
     filters: int
@@ -421,7 +457,8 @@ class Upsample(nn.Module):
                 y, pad_after=self.pad_after, norm_impl=self.norm_impl,
                 activation=self.activation,
             )
-        if self.upsample_impl not in ("zeroskip", "zeroskip_fused"):
+        if self.upsample_impl not in (
+                "zeroskip", "zeroskip_fused", "zeroskip_fused_int8"):
             raise ValueError(
                 f"unknown upsample_impl {self.upsample_impl!r}"
             )
@@ -430,6 +467,24 @@ class Upsample(nn.Module):
                 "zero-skip upsampling is specialized to the reference "
                 "3x3/stride-2 geometry; got kernel_size="
                 f"{self.kernel_size}, strides={self.strides}"
+            )
+        if self.upsample_impl == "zeroskip_fused_int8":
+            # Inference-only tier: the kernel param IS the quantized
+            # dict; weights stay int8 end-to-end (in-kernel dequant on
+            # TPU — ops/upsample.py upsample_norm_relu_pad_int8).
+            if self.activation is not nn.relu:
+                raise ValueError(
+                    "upsample_impl='zeroskip_fused_int8' requires the "
+                    f"ReLU epilogue (got {self.activation!r})"
+                )
+            qkernel = QuantZeroSkipKernel(
+                self.filters, name="ConvTranspose_0")(x)
+            if self.dtype is not None:
+                x = x.astype(self.dtype)
+            scale, bias = NormParams(self.filters, name="InstanceNorm_0")()
+            return upsample_norm_relu_pad_int8(
+                x, qkernel["int8_q"], qkernel["int8_scale"], scale, bias,
+                pad=self.pad_after, eps=1e-3, norm_impl=self.norm_impl,
             )
         kernel = ZeroSkipKernel(self.filters, name="ConvTranspose_0")(x)
         if self.dtype is not None:

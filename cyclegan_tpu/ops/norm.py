@@ -138,15 +138,20 @@ def instance_norm(
       scale: [C] learned gamma (reference init N(0, 0.02) — model.py:11).
       bias: [C] learned beta (zeros init).
       eps: numerical epsilon; 1e-3 matches tfa's default.
-      impl: "xla" | "pallas" | "auto". "auto" resolves to "xla": measured
-        on TPU v5e inside the full fused train step (95.0 vs 86.1 img/s),
-        XLA wins because it fuses the norm into the producer/consumer
-        convs' HBM passes while pallas_call is an opaque fusion boundary
-        that forces an isolated read+write — the quantified ceiling
-        analysis is in docs/BENCHMARKS.md. The kernel stays opt-in for
-        shapes/backends where producer fusion is unavailable.
+      impl: "xla" | "pallas" | "auto" | "auto_fwd" | "pallas_fwd".
+        "auto" resolves to "xla": measured on TPU v5e inside the full
+        fused train step (95.0 vs 86.1 img/s), XLA wins because it
+        fuses the norm into the producer/consumer convs' HBM passes
+        while pallas_call is an opaque fusion boundary that forces an
+        isolated read+write — the quantified ceiling analysis is in
+        docs/BENCHMARKS.md. The kernel stays opt-in for shapes/backends
+        where producer fusion is unavailable. The "_fwd" variants are
+        the inference-only forms (serve tier "int8_fused"): same
+        dispatch decision as their base impl, but any Pallas site
+        builds with no_vjp=True — no custom-VJP registration, forward
+        bit-identical.
     """
-    if impl == "pallas":
+    if impl in ("pallas", "pallas_fwd"):
         from cyclegan_tpu.ops.pallas.norm_kernel import instance_norm_pallas
 
         try:
@@ -154,7 +159,9 @@ def instance_norm(
             # in interpret mode (correct everywhere, slow — useful for
             # tests).
             interpret = jax.default_backend() != "tpu"
-            return instance_norm_pallas(x, scale, bias, eps=eps, interpret=interpret)
+            return instance_norm_pallas(
+                x, scale, bias, eps=eps, interpret=interpret,
+                no_vjp=impl.endswith("_fwd"))
         except NotImplementedError:
             pass
     return _instance_norm_xla(x, scale, bias, eps)
@@ -200,6 +207,7 @@ def instance_norm_act_pad(
             return instance_norm_relu_pad_pallas(
                 x, scale, bias, pad=pad, eps=eps,
                 negative_slope=negative_slope, interpret=interpret,
+                no_vjp=impl.endswith("_fwd"),
             )
     from cyclegan_tpu.ops.padding import reflect_pad
 

@@ -201,7 +201,18 @@ def _backward(x, scale, bias, mean, inv, g, pad, slope, interpret):
 
 
 @functools.lru_cache(maxsize=None)
-def _build(eps: float, pad: int, slope: float, interpret: bool):
+def _build(eps: float, pad: int, slope: float, interpret: bool,
+           no_vjp: bool = False):
+    if no_vjp:
+        # Inference-only build: shared `_forward`, no custom-VJP
+        # registration and no saved residuals. Forward bit-identical to
+        # the VJP-carrying build by construction.
+        def op_fwd_only(x, scale, bias):
+            y, _, _ = _forward(x, scale, bias, eps, pad, slope, interpret)
+            return y
+
+        return op_fwd_only
+
     @jax.custom_vjp
     def op(x, scale, bias):
         y, _, _ = _forward(x, scale, bias, eps, pad, slope, interpret)
@@ -235,17 +246,21 @@ def instance_norm_relu_pad_pallas(
     eps: float = 1e-3,
     negative_slope: float = 0.0,
     interpret: bool = False,
+    no_vjp: bool = False,
 ) -> jnp.ndarray:
     """Fused IN -> LeakyReLU(negative_slope) -> reflect-pad(pad):
     [N, H, W, C] -> [N, H+2p, W+2p, C]. negative_slope=0.0 is the exact
     ReLU epilogue; pad=0 skips the pad stage (the discriminator form).
-    Raises NotImplementedError when the slab cannot stay VMEM-resident
-    (caller composes the XLA fallback)."""
+    no_vjp=True builds the inference-only op (no custom-VJP
+    registration; forward bit-identical). Raises NotImplementedError
+    when the slab cannot stay VMEM-resident (caller composes the XLA
+    fallback)."""
     if not epilogue_eligible(x.shape, x.dtype, pad):
         raise NotImplementedError(
             f"shape {x.shape} dtype {x.dtype} pad {pad} exceeds the "
             f"epilogue slab budget ({vmem.EPILOGUE_BUDGET_BYTES} bytes)"
         )
     return _build(
-        float(eps), int(pad), float(negative_slope), bool(interpret)
+        float(eps), int(pad), float(negative_slope), bool(interpret),
+        bool(no_vjp)
     )(x, scale, bias)

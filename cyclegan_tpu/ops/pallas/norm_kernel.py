@@ -178,7 +178,19 @@ def _backward(x4, scale, mean, inv, g4, interpret):
 
 
 @functools.lru_cache(maxsize=None)
-def _build(eps: float, interpret: bool):
+def _build(eps: float, interpret: bool, no_vjp: bool = False):
+    if no_vjp:
+        # Inference-only build: same `_forward`, no custom-VJP
+        # registration and no residual outputs threaded through the
+        # jaxpr. Bit-identical forward by construction (the pallas_call
+        # is shared); differentiating through it raises at trace time,
+        # which is the point — serving never should.
+        def op_fwd_only(x, scale, bias):
+            y, _, _ = _forward(x, scale, bias, eps, interpret)
+            return y
+
+        return op_fwd_only
+
     @jax.custom_vjp
     def op(x, scale, bias):
         y, _, _ = _forward(x, scale, bias, eps, interpret)
@@ -215,12 +227,15 @@ def instance_norm_pallas(
     bias: jnp.ndarray,
     eps: float = 1e-3,
     interpret: bool = False,
+    no_vjp: bool = False,
 ) -> jnp.ndarray:
     """Fused instance norm. Raises NotImplementedError when the shape
-    cannot stay VMEM-resident (caller falls back to XLA)."""
+    cannot stay VMEM-resident (caller falls back to XLA). no_vjp=True
+    builds the inference-only op (no custom-VJP registration; forward
+    bit-identical to the VJP-carrying build)."""
     if not eligible(x.shape, x.dtype):
         raise NotImplementedError(
             f"shape {x.shape} dtype {x.dtype} exceeds the resident-slab "
             f"limit (H*W <= {vmem.norm_fwd_max_hw(np.dtype(x.dtype).itemsize)})"
         )
-    return _build(float(eps), bool(interpret))(x, scale, bias)
+    return _build(float(eps), bool(interpret), bool(no_vjp))(x, scale, bias)

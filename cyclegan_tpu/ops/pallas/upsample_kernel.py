@@ -66,6 +66,18 @@ def upsample_eligible(shape: Tuple[int, ...], dtype, pad: int) -> bool:
     return vmem.upsample_fits(h, w, c_in, int(pad), np.dtype(dtype).itemsize)
 
 
+def upsample_eligible_int8(shape: Tuple[int, ...], dtype, pad: int) -> bool:
+    """Eligibility for the int8-weight fused upsample (serve tier
+    "int8_fused"): the kernel block streams in as int8, so the budget
+    (vmem.upsample_fits_int8) is strictly more permissive than the f32
+    bound — deep-trunk buckets that straddled the f32 budget fit here."""
+    if len(shape) != 4:
+        return False
+    _, h, w, c_in = shape
+    return vmem.upsample_fits_int8(
+        h, w, c_in, int(pad), np.dtype(dtype).itemsize)
+
+
 def _fwd_kernel(x_ref, k_ref, scale_ref, bias_ref, y_ref, mean_ref, inv_ref,
                 *, eps, pad):
     x = x_ref[0]  # [H, W, Cin], activation dtype
@@ -152,8 +164,117 @@ def _forward(x, kernel, scale, bias, eps, pad, interpret):
     return y, mean, inv
 
 
+def _fwd_kernel_int8(x_ref, k_ref, kscale_ref, scale_ref, bias_ref,
+                     y_ref, mean_ref, inv_ref, *, eps, pad):
+    """int8-weight variant of `_fwd_kernel`: the 3x3 kernel block
+    arrives as int8 straight from HBM and widens to f32 in registers
+    inside the taps — no dequantized f32 param tree ever exists in the
+    XLA graph. The per-output-channel quant scale distributes over the
+    C_in sum, so it is applied ONCE per phase after tap accumulation:
+    sum_cin(x * q * s) == (sum_cin(x * q)) * s per output channel —
+    exact vs dequant-outside up to float summation order."""
+    x = x_ref[0]  # [H, W, Cin], activation dtype
+    h, w, cin = x.shape
+    cb = k_ref.shape[-1]
+    kscale = kscale_ref[0]  # [cb] f32 per-output-channel quant scales
+    zrow = jnp.zeros((1, w, cin), x.dtype)
+    zcol = jnp.zeros((h + 1, 1, cin), x.dtype)
+    xp = jnp.concatenate([zcol, jnp.concatenate([zrow, x], axis=0)], axis=1)
+
+    def tap(slab, a, b):
+        """[h, w, Cin] slab (.) widen(Q[a, b]) -> [h*w, cb] f32 dot."""
+        return jax.lax.dot_general(
+            slab.reshape(h * w, cin).astype(jnp.float32),
+            k_ref[a, b].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    ee = tap(xp[0:h, 0:w], 0, 0) + tap(xp[0:h, 1:1 + w], 0, 2) \
+        + tap(xp[1:1 + h, 0:w], 2, 0) + tap(xp[1:1 + h, 1:1 + w], 2, 2)
+    eo = tap(xp[0:h, 1:1 + w], 0, 1) + tap(xp[1:1 + h, 1:1 + w], 2, 1)
+    oe = tap(xp[1:1 + h, 0:w], 1, 0) + tap(xp[1:1 + h, 1:1 + w], 1, 2)
+    oo = tap(xp[1:1 + h, 1:1 + w], 1, 1)
+    phases = [(p * kscale[None, :]).reshape(h, w, cb).astype(x.dtype)
+              for p in (ee, eo, oe, oo)]
+    ee, eo, oe, oo = phases
+    even_rows = jnp.stack([ee, eo], axis=2).reshape(h, 2 * w, cb)
+    odd_rows = jnp.stack([oe, oo], axis=2).reshape(h, 2 * w, cb)
+    y = jnp.stack([even_rows, odd_rows], axis=1).reshape(2 * h, 2 * w, cb)
+
+    yf = y.astype(jnp.float32)
+    hw = 4 * h * w
+    mean = jnp.sum(yf, axis=(0, 1), keepdims=True) / hw
+    centered = yf - mean
+    var = jnp.sum(centered * centered, axis=(0, 1), keepdims=True) / hw
+    inv = jax.lax.rsqrt(var + eps)
+    scale = scale_ref[0].astype(jnp.float32)
+    bias = bias_ref[0].astype(jnp.float32)
+    out = centered * inv * scale[None, None, :] + bias[None, None, :]
+    out = jnp.maximum(out, 0.0)
+    y_ref[0] = _reflect_2d(out, pad).astype(y_ref.dtype)
+    mean_ref[0] = mean[0]
+    inv_ref[0] = inv[0]
+
+
+def _forward_int8(x, kernel_q, kernel_scale, scale, bias, eps, pad,
+                  interpret):
+    n, h, w, cin = x.shape
+    cout = kernel_q.shape[-1]
+    hp, wp = 2 * h + 2 * pad, 2 * w + 2 * pad
+    c_blk = min(cout, C_BLK)
+    grid = (n, pl.cdiv(cout, c_blk))
+    y, mean, inv = pl.pallas_call(
+        functools.partial(_fwd_kernel_int8, eps=eps, pad=pad),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, h, w, cin), lambda i, j: (i, 0, 0, 0)),
+            pl.BlockSpec((3, 3, cin, c_blk), lambda i, j: (0, 0, 0, j)),
+            pl.BlockSpec((1, c_blk), lambda i, j: (0, j)),
+            pl.BlockSpec((1, c_blk), lambda i, j: (0, j)),
+            pl.BlockSpec((1, c_blk), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, hp, wp, c_blk), lambda i, j: (i, 0, 0, j)),
+            pl.BlockSpec((1, 1, c_blk), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, c_blk), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, hp, wp, cout), x.dtype),
+            jax.ShapeDtypeStruct((n, 1, cout), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1, cout), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, kernel_q,
+      kernel_scale.reshape(1, cout).astype(jnp.float32),
+      scale.reshape(1, cout), bias.reshape(1, cout))
+    return y, mean, inv
+
+
 @functools.lru_cache(maxsize=None)
-def _build(eps: float, pad: int, interpret: bool):
+def _build_int8(eps: float, pad: int, interpret: bool):
+    """Inference-only by construction: the int8_fused tier never
+    differentiates, so no custom-VJP registration exists for this op."""
+    def op_fwd_only(x, kernel_q, kernel_scale, scale, bias):
+        y, _, _ = _forward_int8(
+            x, kernel_q, kernel_scale, scale, bias, eps, pad, interpret)
+        return y
+
+    return op_fwd_only
+
+
+@functools.lru_cache(maxsize=None)
+def _build(eps: float, pad: int, interpret: bool, no_vjp: bool = False):
+    if no_vjp:
+        # Inference-only build: shared `_forward`, no custom-VJP
+        # registration and no saved residuals. Forward bit-identical to
+        # the VJP-carrying build by construction.
+        def op_fwd_only(x, kernel, scale, bias):
+            y, _, _ = _forward(x, kernel, scale, bias, eps, pad, interpret)
+            return y
+
+        return op_fwd_only
+
     @jax.custom_vjp
     def op(x, kernel, scale, bias):
         y, _, _ = _forward(x, kernel, scale, bias, eps, pad, interpret)
@@ -205,14 +326,49 @@ def upsample_norm_relu_pad_pallas(
     pad: int = 0,
     eps: float = 1e-3,
     interpret: bool = False,
+    no_vjp: bool = False,
 ) -> jnp.ndarray:
     """Fused zero-skip upsample -> IN -> ReLU -> reflect-pad(pad):
     [N, H, W, Cin] x [3, 3, Cin, Cout] -> [N, 2H+2p, 2W+2p, Cout].
-    Raises NotImplementedError when the forward's residents cannot stay
-    in VMEM (caller composes the XLA zeroskip fallback)."""
+    no_vjp=True builds the inference-only op (no custom-VJP
+    registration; forward bit-identical). Raises NotImplementedError
+    when the forward's residents cannot stay in VMEM (caller composes
+    the XLA zeroskip fallback)."""
     if not upsample_eligible(x.shape, x.dtype, pad):
         raise NotImplementedError(
             f"shape {x.shape} dtype {x.dtype} pad {pad} exceeds the "
             f"upsample slab budget ({vmem.UPSAMPLE_BUDGET_BYTES} bytes)"
         )
-    return _build(float(eps), int(pad), bool(interpret))(x, kernel, scale, bias)
+    return _build(
+        float(eps), int(pad), bool(interpret), bool(no_vjp)
+    )(x, kernel, scale, bias)
+
+
+def upsample_norm_relu_pad_pallas_int8(
+    x: jnp.ndarray,
+    kernel_q: jnp.ndarray,
+    kernel_scale: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    pad: int = 0,
+    eps: float = 1e-3,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """int8-weight fused zero-skip upsample -> IN -> ReLU ->
+    reflect-pad(pad): [N, H, W, Cin] x int8 [3, 3, Cin, Cout] with f32
+    per-output-channel `kernel_scale` -> [N, 2H+2p, 2W+2p, Cout]. The
+    weights widen to f32 INSIDE the kernel (in-kernel dequant); no f32
+    kernel tensor is ever materialized. Inference-only — there is no
+    VJP registered. Raises NotImplementedError when the forward's
+    residents (int8 kernel accounting) cannot stay in VMEM."""
+    if not upsample_eligible_int8(x.shape, x.dtype, pad):
+        raise NotImplementedError(
+            f"shape {x.shape} dtype {x.dtype} pad {pad} exceeds the "
+            f"int8 upsample slab budget ({vmem.UPSAMPLE_BUDGET_BYTES} bytes)"
+        )
+    if kernel_q.dtype != jnp.int8:
+        raise TypeError(
+            f"kernel_q must be int8, got {kernel_q.dtype} — pass the "
+            "quantized tree leaf, not a dequantized kernel")
+    return _build_int8(float(eps), int(pad), bool(interpret))(
+        x, kernel_q, kernel_scale, scale, bias)

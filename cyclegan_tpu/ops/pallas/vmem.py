@@ -111,3 +111,33 @@ def upsample_fits(h: int, w: int, c_in: int, pad: int, itemsize: int) -> bool:
     if min(h, w) < 1 or pad < 0 or (pad and min(2 * h, 2 * w) <= pad):
         return False
     return upsample_bytes(h, w, c_in, pad, itemsize) <= UPSAMPLE_BUDGET_BYTES
+
+
+def upsample_bytes_int8(h: int, w: int, c_in: int, pad: int,
+                        itemsize: int) -> int:
+    """Resident bytes per grid step for the int8-weight variant of the
+    fused zero-skip upsample (serve tier "int8_fused"): identical to
+    `upsample_bytes` except the 3x3 kernel block streams in as int8
+    (1 B/element — it widens to f32 in registers inside the tap dots)
+    plus one f32 per-output-channel scale sliver. Activations keep the
+    activation itemsize."""
+    x_slab = (h + 1) * (w + 1) * c_in
+    phases = 4 * h * w * C_BLK
+    out_padded = (2 * h + 2 * pad) * (2 * w + 2 * pad) * C_BLK
+    kernel_int8 = 9 * c_in * C_BLK  # 1 byte/element
+    scale_sliver = C_BLK * 4  # f32 per-output-channel scales
+    return ((x_slab + phases + out_padded) * itemsize
+            + kernel_int8 + scale_sliver)
+
+
+def upsample_fits_int8(h: int, w: int, c_in: int, pad: int,
+                      itemsize: int) -> bool:
+    """Whether [*, h, w, c_in] can run the int8-weight fused zero-skip
+    upsample. Strictly more permissive than `upsample_fits` for
+    itemsize > 1: the kernel term shrinks by 9*c_in*C_BLK*(itemsize-1)
+    bytes, so deep-trunk buckets that straddled the f32 budget (e.g.
+    32x32 at 1024 input channels) become eligible in the int8 tier."""
+    if min(h, w) < 1 or pad < 0 or (pad and min(2 * h, 2 * w) <= pad):
+        return False
+    return (upsample_bytes_int8(h, w, c_in, pad, itemsize)
+            <= UPSAMPLE_BUDGET_BYTES)

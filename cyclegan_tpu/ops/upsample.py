@@ -148,3 +148,50 @@ def upsample_norm_relu_pad(
     if pad:
         return instance_norm_relu_pad(y, scale, bias, pad=pad, eps=eps)
     return jax.nn.relu(instance_norm(y, scale, bias, eps=eps))
+
+
+@functools.partial(jax.jit, static_argnames=("pad", "eps", "norm_impl"))
+def upsample_norm_relu_pad_int8(
+    x: jnp.ndarray,
+    kernel_q: jnp.ndarray,
+    kernel_scale: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    pad: int = 0,
+    eps: float = 1e-3,
+    norm_impl: str = "auto_fwd",
+) -> jnp.ndarray:
+    """`upsample_norm_relu_pad` consuming int8-quantized upsample
+    weights directly (serve tier "int8_fused"): `kernel_q` is the int8
+    [3, 3, Cin, Cout] leaf and `kernel_scale` the f32 per-output-channel
+    quant scale, exactly as serve.engine.quantize_params_int8 stores
+    them.
+
+    On TPU, VMEM-eligible shapes (int8 kernel accounting —
+    vmem.upsample_fits_int8, strictly wider than the f32 bound)
+    dispatch to the in-kernel-dequant Pallas kernel: the weights widen
+    to f32 inside the taps, no dequantized kernel tensor exists in the
+    graph. Off-TPU and for ineligible shapes, the fallback dequantizes
+    JUST this kernel and composes the XLA zeroskip path — never the
+    interpret-mode kernel, because this entry sits on the serving hot
+    path (interpret parity is tested by calling the Pallas entry
+    directly). Inference-only: no VJP is registered on the fused path.
+    """
+    if jax.default_backend() == "tpu":
+        from cyclegan_tpu.ops.pallas.upsample_kernel import (
+            upsample_eligible_int8,
+            upsample_norm_relu_pad_pallas_int8,
+        )
+
+        if upsample_eligible_int8(x.shape, x.dtype, pad):
+            return upsample_norm_relu_pad_pallas_int8(
+                x, kernel_q, kernel_scale, scale, bias, pad=pad, eps=eps
+            )
+    from cyclegan_tpu.ops.norm import instance_norm, instance_norm_relu_pad
+
+    kernel = kernel_q.astype(jnp.float32) * kernel_scale.astype(jnp.float32)
+    y = conv_transpose_zeroskip(x, kernel.astype(x.dtype))
+    if pad:
+        return instance_norm_relu_pad(
+            y, scale, bias, pad=pad, eps=eps, impl=norm_impl)
+    return jax.nn.relu(instance_norm(y, scale, bias, eps=eps, impl=norm_impl))

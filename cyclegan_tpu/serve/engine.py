@@ -32,6 +32,17 @@ what lives in HBM, so the tier trades a bounded output error
 per flush. ``run(..., tier="int8")`` selects it per flush; the fleet
 layer maps deadline classes onto tiers.
 
+The optional **int8_fused tier** (``ServeConfig(infer_tier=True)``) is
+the inference-only composition of the pieces above: the SAME
+startup-quantized tree, but the upsample weights stay int8 all the way
+INTO the Pallas zero-skip kernel (in-kernel dequant —
+ops/pallas/upsample_kernel.py int8 variant, eligibility under the
+int8-aware VMEM accounting), the rest of the tree dequantizes outside
+as the int8 tier does, and every Pallas site builds forward-only
+(no_vjp=True — no custom-VJP registration, forward bit-identical).
+``run(..., tier="int8_fused")`` selects it; the brownout cascade slots
+it between "int8" and "perturb" as the faster quantized rung.
+
 The optional **perturb tier** (``ServeConfig(perturb_tier=True)``) is
 the floor of the brownout ladder: the Perturbative-GAN cheap trunk
 (trunk_impl="perturb" — fixed random masks + learned 1x1 combiners,
@@ -134,6 +145,27 @@ def dequantize_params(qparams):
     return jax.tree_util.tree_map(dq, qparams, is_leaf=_is_quantized_leaf)
 
 
+def dequantize_params_except_upsample(qparams):
+    """The int8_fused tier's widen: dequantize every quantized leaf
+    EXCEPT the upsample kernels ("ConvTranspose_0" — the params the
+    zero-skip Pallas kernel consumes as raw int8 + scale via in-kernel
+    dequant). The fused generator (upsample_impl="zeroskip_fused_int8")
+    declares exactly the quantized dict for those leaves, so the result
+    tree applies directly."""
+    import jax
+    import jax.numpy as jnp
+
+    def dq(path, x):
+        if not _is_quantized_leaf(x):
+            return x
+        if any(getattr(k, "key", None) == "ConvTranspose_0" for k in path):
+            return x
+        return x["int8_q"].astype(jnp.float32) * x["int8_scale"]
+
+    return jax.tree_util.tree_map_with_path(
+        dq, qparams, is_leaf=_is_quantized_leaf)
+
+
 def quantized_param_specs(model_cfg, sizes: Sequence[int]):
     """ShapeDtypeStruct tree of the int8-quantized generator params —
     the cache-warm stand-in for the int8 tier (no weights needed)."""
@@ -143,7 +175,7 @@ def quantized_param_specs(model_cfg, sizes: Sequence[int]):
                           param_specs(model_cfg, sizes))
 
 
-def forward_fn(model_cfg, with_cycle: bool, quantized: bool = False):
+def forward_fn(model_cfg, with_cycle: bool, quantized=False):
     """The python callable every serve program traces. Shared with
     tools/cache_warm.py so offline warming lowers the byte-for-byte
     identical HLO the engine requests at startup (the bench._config_for
@@ -156,11 +188,19 @@ def forward_fn(model_cfg, with_cycle: bool, quantized: bool = False):
 
     quantized=True is the int8 tier's trace: params arrive as the
     quantize_params_int8 tree and widen to f32 inside the program.
+    quantized="fused" is the int8_fused tier's trace: the same tree,
+    but the upsample kernels stay int8 into the Pallas kernel
+    (model_cfg must carry upsample_impl="zeroskip_fused_int8").
     """
     import jax.numpy as jnp
 
     gen = build_generator(model_cfg)
-    widen = dequantize_params if quantized else (lambda p: p)
+    if quantized == "fused":
+        widen = dequantize_params_except_upsample
+    elif quantized:
+        widen = dequantize_params
+    else:
+        widen = (lambda p: p)
 
     if with_cycle:
         def fwd(fwd_params, bwd_params, x):
@@ -175,7 +215,7 @@ def forward_fn(model_cfg, with_cycle: bool, quantized: bool = False):
 
 
 def lower_forward(model_cfg, fwd_params, bwd_params, batch: int, size: int,
-                  with_cycle: bool, quantized: bool = False):
+                  with_cycle: bool, quantized=False):
     """Lower the exact serve program for one (size, batch) bucket.
     Params may be concrete arrays (engine startup) or ShapeDtypeStruct
     trees (tools/cache_warm.py) — lowering only consumes avals, so both
@@ -202,7 +242,11 @@ class ServeConfig:
     ``int8_tier`` compiles a SECOND program per bucket over int8
     weight-only-quantized params (f32 accumulate) — selected per flush
     via ``run(..., tier="int8")``.
-    ``perturb_tier`` compiles a THIRD set over the perturbative cheap
+    ``infer_tier`` compiles the inference-only **int8_fused** set: the
+    same quantized tree, upsample weights consumed as raw int8 by the
+    zero-skip Pallas kernel (in-kernel dequant), all Pallas sites built
+    forward-only (no_vjp) — selected via ``run(..., tier="int8_fused")``.
+    ``perturb_tier`` compiles a further set over the perturbative cheap
     trunk; the engine then requires a ``perturb_params`` checkpoint.
     """
 
@@ -211,6 +255,7 @@ class ServeConfig:
     dtype: str = "float32"  # "float32" | "bfloat16"
     with_cycle: bool = False
     int8_tier: bool = False
+    infer_tier: bool = False
     perturb_tier: bool = False
 
     def __post_init__(self):
@@ -227,6 +272,9 @@ class ServeConfig:
             # the int8 tier exists for the server's cheap path — the
             # combination has no caller and would double compile time.
             raise ValueError("int8_tier with with_cycle is unsupported "
+                             "(panel traffic serves from the base tier)")
+        if self.infer_tier and self.with_cycle:
+            raise ValueError("infer_tier with with_cycle is unsupported "
                              "(panel traffic serves from the base tier)")
         if self.perturb_tier and self.with_cycle:
             raise ValueError("perturb_tier with with_cycle is "
@@ -333,6 +381,36 @@ class InferenceEngine:
                                     else None),
                             seconds=round(time.perf_counter() - t0, 3),
                         )
+        # The int8_fused tier: the inference-only composition. Same
+        # quantized tree as the int8 tier (shared — quantize once), but
+        # the generator is traced with upsample_impl="zeroskip_fused_int8"
+        # (upsample weights stay int8 into the Pallas kernel) and
+        # instance_norm_impl="auto_fwd" (every Pallas site builds
+        # no_vjp=True — no custom-VJP machinery in an inference program).
+        self.programs_int8_fused: Dict[Tuple[int, int], Any] = {}
+        if serve_cfg.infer_tier:
+            fused_cfg = dataclasses.replace(
+                self.model_cfg, compute_dtype="float32",
+                upsample_impl="zeroskip_fused_int8",
+                instance_norm_impl="auto_fwd")
+            with place():
+                if self._fwd_params_int8 is None:
+                    self._fwd_params_int8 = quantize_params_int8(fwd_params)
+                for size in self._sizes:
+                    for batch in self._batch_buckets:
+                        t0 = time.perf_counter()
+                        self.programs_int8_fused[(size, batch)] = lower_forward(
+                            fused_cfg, self._fwd_params_int8, None, batch,
+                            size, False, quantized="fused",
+                        ).compile()
+                        self._event(
+                            "serve_compile", size=size, batch=batch,
+                            dtype="int8", tier="int8_fused",
+                            with_cycle=False,
+                            device=(str(device) if device is not None
+                                    else None),
+                            seconds=round(time.perf_counter() - t0, 3),
+                        )
         # The perturb tier: the brownout floor. Its programs trace the
         # perturbative cheap trunk over its OWN param tree; the bucket
         # grammar is shared so the fleet's batcher needs no tier-aware
@@ -378,11 +456,15 @@ class InferenceEngine:
     @property
     def tiers(self) -> Tuple[str, ...]:
         """Program tiers this engine serves, cheapest last: "base"
-        always, plus "int8"/"perturb" when those sets were compiled.
-        The brownout cascade reads this as its degradation ladder."""
+        always, plus "int8"/"int8_fused"/"perturb" when those sets were
+        compiled ("int8_fused" is the faster quantized rung — in-kernel
+        dequant + forward-only kernels). The brownout cascade reads
+        this as its degradation ladder."""
         tiers = ["base"]
         if self.programs_int8:
             tiers.append("int8")
+        if self.programs_int8_fused:
+            tiers.append("int8_fused")
         if self.programs_perturb:
             tiers.append("perturb")
         return tuple(tiers)
@@ -399,6 +481,12 @@ class InferenceEngine:
                     "int8 tier requested but the engine was built "
                     "without it (ServeConfig(int8_tier=True))")
             return "int8"
+        if tier == "int8_fused":
+            if not self.programs_int8_fused:
+                raise ValueError(
+                    "int8_fused tier requested but the engine was built "
+                    "without it (ServeConfig(infer_tier=True))")
+            return "int8_fused"
         if tier == "perturb":
             if not self.programs_perturb:
                 raise ValueError(
@@ -466,6 +554,9 @@ class InferenceEngine:
                  np.zeros((pad,) + batch_np.shape[1:], np.float32)])
         if tier == "int8":
             program = self.programs_int8[(size, bucket)]
+            return (program(self._fwd_params_int8, batch_np),), n
+        if tier == "int8_fused":
+            program = self.programs_int8_fused[(size, bucket)]
             return (program(self._fwd_params_int8, batch_np),), n
         if tier == "perturb":
             program = self.programs_perturb[(size, bucket)]

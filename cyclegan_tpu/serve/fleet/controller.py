@@ -225,6 +225,19 @@ class FleetConfig:
             raise ValueError(
                 f"default_class {self.default_class!r} not among "
                 f"classes {sorted(names)}")
+        if self.cascade is not None:
+            # Fail at construction, not when the cascade first fires
+            # under load: a typo'd class name in degrade_order used to
+            # be silently dropped from the brownout plan (the
+            # controller filtered unknown names), so the misconfigured
+            # class simply never degraded — the worst failure mode,
+            # invisible until an overload.
+            unknown = [cls for cls in self.cascade.degrade_order
+                       if cls not in names]
+            if unknown:
+                raise ValueError(
+                    f"cascade.degrade_order names unknown deadline "
+                    f"class(es) {unknown}; have {sorted(names)}")
         if self.hedge_ms is not None and self.hedge_ms <= 0:
             raise ValueError(
                 f"hedge_ms must be > 0 or None, got {self.hedge_ms}")
@@ -400,13 +413,24 @@ class FleetExecutor:
         self._t_next_autoscale = 0.0
         self._n_scale_up = 0
         self._n_scale_down = 0
-        # Brownout wiring: ladder = configured cascade tiers the engine
-        # actually compiled, in cascade order.
+        # Brownout wiring. Cascade tiers must name programs the engine
+        # ACTUALLY compiled — the old behavior silently intersected the
+        # two sets, so a typo'd tier name ("int8-fused") shortened the
+        # ladder without a word and only surfaced as a missing rung
+        # when the cascade first fired under load. Refuse at
+        # construction, naming the valid set (domain-registry style).
         self._brownout: Optional[BrownoutController] = None
         self._probe: Optional[QualityProbe] = None
         if self.cfg.cascade is not None:
-            ladder = [t for t in self.cfg.cascade.tiers
-                      if t in engine.tiers]
+            unknown = [t for t in self.cfg.cascade.tiers
+                       if t not in engine.tiers]
+            if unknown:
+                raise ValueError(
+                    f"cascade tier(s) {unknown} were never compiled by "
+                    f"the engine; have {list(engine.tiers)} — enable "
+                    "the tier in ServeConfig (int8_tier / infer_tier / "
+                    "perturb_tier) or drop it from CascadeConfig.tiers")
+            ladder = list(self.cfg.cascade.tiers)
             self._brownout = BrownoutController(
                 self.cfg.cascade, ladder, list(self._classes))
             if self.cfg.cascade.shadow_fraction > 0:

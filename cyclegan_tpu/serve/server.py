@@ -16,7 +16,8 @@ Endpoints:
                     ?class=interactive|batch|best_effort picks the
                     deadline class (fleet mode; default `batch`).
                     ?tier=int8 routes to the quantized program tier
-                    when the engine compiled one.
+                    when the engine compiled one; ?tier=int8_fused to
+                    the inference-only fused int8 tier (--int8_fused).
                     ?tenant=domain/tier picks a resident model version
                     in a multi-tenant fleet (--tenant flags); unknown
                     tenants/classes answer 400.
@@ -43,13 +44,14 @@ at --trace_sample 0, so the trace_id on a 429 always resolves.
 Run:
   python -m cyclegan_tpu.serve.server --output_dir runs --port 8080 \
       [--dtype bfloat16] [--batch_bucket 8] [--max_wait_ms 5] [--panels] \
-      [--fleet 2 [--capacity 256]] [--int8] \
+      [--fleet 2 [--capacity 256]] [--int8] [--int8_fused] \
       [--autoscale --min_replicas 1 --max_replicas 4] \
       [--brownout [--shadow_fraction 0.05]] [--hedge_ms 250]
 
 The last row is the self-driving overlay (fleet mode only): the
 autoscaler grows/shrinks the replica fleet from queue-rate signals, the
-brownout cascade degrades request tiers (f32 -> int8) before shedding
+brownout cascade degrades request tiers (f32 -> int8 -> int8_fused)
+before shedding
 — governed by a sampled shadow-probe quality budget — and --hedge_ms
 re-dispatches stragglers to a second replica (first result wins).
 /stats reports all three (autoscale/brownout/hedges/quarantine keys).
@@ -486,6 +488,11 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--int8", action="store_true",
                    help="also compile the int8 weight-quantized program "
                         "tier (?tier=int8 routes to it)")
+    p.add_argument("--int8_fused", action="store_true",
+                   help="also compile the inference-only fused int8 "
+                        "tier — in-kernel dequant + forward-only Pallas "
+                        "kernels (?tier=int8_fused routes to it; the "
+                        "brownout cascade slots it after int8)")
     p.add_argument("--autoscale", action="store_true",
                    help="fleet mode: grow/shrink the replica fleet from "
                         "queue-rate signals (--fleet N is the starting "
@@ -572,18 +579,20 @@ def main(argv: Optional[list] = None) -> None:
                      **build_manifest(config, query_devices=False,
                                       role="serve"))
 
-    if args.int8 and args.panels:
-        raise SystemExit("--int8 and --panels are mutually exclusive "
-                         "(the int8 tier has no fused cycle program)")
+    if (args.int8 or args.int8_fused) and args.panels:
+        raise SystemExit("--int8/--int8_fused and --panels are mutually "
+                         "exclusive (the quantized tiers have no fused "
+                         "cycle program)")
     serve_cfg = ServeConfig(
         batch_buckets=tuple(sorted({1, args.batch_bucket})),
         sizes=(model_cfg.image_size,),
         dtype=args.dtype or model_cfg.compute_dtype,
         with_cycle=args.panels,
         int8_tier=args.int8,
+        infer_tier=args.int8_fused,
     )
     n_progs = (len(serve_cfg.batch_buckets) * len(serve_cfg.sizes)
-               * (2 if args.int8 else 1))
+               * (1 + int(args.int8) + int(args.int8_fused)))
     print(f"compiling {n_progs} serve programs (warm cache makes this "
           f"instant — tools/cache_warm.py)...", flush=True)
     engine = InferenceEngine(model_cfg, fwd_params, bwd_params,
@@ -594,10 +603,10 @@ def main(argv: Optional[list] = None) -> None:
                        (args.tenant is not None, "--tenant")):
         if flag and args.fleet <= 0:
             raise SystemExit(f"{name} requires fleet mode (--fleet N)")
-    if args.brownout and not args.int8:
+    if args.brownout and not (args.int8 or args.int8_fused):
         raise SystemExit("--brownout needs a degradation ladder — "
-                         "enable --int8 so there is a cheaper tier to "
-                         "degrade onto")
+                         "enable --int8 and/or --int8_fused so there "
+                         "is a cheaper tier to degrade onto")
     if args.fleet > 0:
         from cyclegan_tpu.serve.fleet import (
             AutoscaleConfig,

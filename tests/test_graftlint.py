@@ -13,8 +13,8 @@ Covers, per ISSUE 11's acceptance bar:
   jit-in-loop retrace hazards and unhashable static literals;
 - the compile-site census recognizes construction sites semantically
   (lower_forward().compile() yes, re.compile/str.lower no) and the
-  committed docs/compile_sites_r01.json matches a fresh scan on the
-  line-independent keys;
+  newest committed docs/compile_sites_r*.json matches a fresh scan on
+  the line-independent keys;
 - suppressions require a reason; the baseline grandfathers one finding
   per entry and stale entries never fail;
 - the whole repo is ZERO unsuppressed findings under the committed
@@ -295,14 +295,19 @@ def test_census_ignores_re_compile_and_str_lower(tmp_path):
 
 
 def test_committed_census_matches_fresh_scan():
-    """docs/compile_sites_r01.json stays truthful: a fresh scan finds
-    exactly the committed construction sites, compared on the
-    line-independent keys (path::kind::enclosing#occurrence) so
-    unrelated edits don't churn this test. If you add or remove a
-    compile site, regenerate with
-    `python tools/graftlint --census-json docs/compile_sites_r01.json`."""
-    committed = json.load(
-        open(os.path.join(REPO, "docs", "compile_sites_r01.json")))
+    """The NEWEST committed docs/compile_sites_r*.json stays truthful:
+    a fresh scan finds exactly the committed construction sites,
+    compared on the line-independent keys
+    (path::kind::enclosing#occurrence) so unrelated edits don't churn
+    this test. If you add or remove a compile site, regenerate with
+    `python tools/graftlint --census-json docs/compile_sites_rNN.json`
+    (bump NN — earlier rounds stay committed as history)."""
+    import glob
+
+    rounds = sorted(glob.glob(
+        os.path.join(REPO, "docs", "compile_sites_r*.json")))
+    assert rounds, "no committed census round"
+    committed = json.load(open(rounds[-1]))
     rule = CompileSiteCensusRule()
     engine.run(REPO, [rule])
     fresh = {site_key(s) for s in rule.sites}

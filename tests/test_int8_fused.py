@@ -1,0 +1,344 @@
+"""Inference-only fused int8 program tier: in-kernel dequant upsample
+(ops/pallas/upsample_kernel.py), forward-only (no_vjp) kernel builds,
+dtype-aware VMEM accounting (ops/pallas/vmem.py), the engine's
+``int8_fused`` tier (ServeConfig(infer_tier=True)), and the brownout
+ladder's fail-fast config validation.
+
+Numerics contract: the fused kernel streams int8 weights and widens
+INSIDE the kernel, applying each output channel's scale once after the
+C_in reduction — the same sums as dequantize-then-convolve up to float
+summation order, so parity gates at the repo's standard f32 bound
+(1e-5). The no_vjp build path calls the SAME forward, so its outputs
+are pinned bit-identical, not merely close.
+"""
+
+import dataclasses
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from cyclegan_tpu.config import GeneratorConfig, ModelConfig  # noqa: E402
+from cyclegan_tpu.ops.pallas import vmem  # noqa: E402
+from cyclegan_tpu.ops.pallas.epilogue_kernel import (  # noqa: E402
+    instance_norm_relu_pad_pallas,
+)
+from cyclegan_tpu.ops.pallas.norm_kernel import (  # noqa: E402
+    instance_norm_pallas,
+)
+from cyclegan_tpu.ops.pallas.upsample_kernel import (  # noqa: E402
+    upsample_eligible,
+    upsample_eligible_int8,
+    upsample_norm_relu_pad_pallas,
+    upsample_norm_relu_pad_pallas_int8,
+)
+
+
+def _rand(shape, seed=0, dtype=jnp.float32):
+    k = jax.random.PRNGKey(seed)
+    return (jax.random.normal(k, shape) * 2 + 0.5).astype(dtype)
+
+
+def _quantize_kernel(kernel):
+    """Per-output-channel symmetric int8, the engine's scheme."""
+    from cyclegan_tpu.serve.engine import quantize_params_int8
+
+    leaf = quantize_params_int8({"k": kernel})["k"]
+    return leaf["int8_q"], leaf["int8_scale"]
+
+
+# -- in-kernel dequant parity ----------------------------------------------
+
+@pytest.mark.parametrize("shape,cout,pad", [
+    ((1, 8, 8, 16), 8, 0),
+    ((2, 7, 4, 8), 8, 0),
+    ((1, 8, 8, 16), 8, 3),
+])
+def test_fused_int8_matches_dequant_outside(shape, cout, pad):
+    """int8 weights widened inside the kernel produce the same result
+    as dequantizing the weights first and running the f32 fused kernel
+    — the scale distributes over the C_in sum, so the only difference
+    is float summation order (same 1e-5 gate as f32 zeroskip parity,
+    strictly tighter than the int8 tier's 0.05 end-to-end bound)."""
+    x = _rand(shape, seed=0)
+    kernel = _rand((3, 3, shape[-1], cout), seed=1) * 0.3
+    scale = _rand((cout,), seed=2)
+    bias = _rand((cout,), seed=3) * 0.1
+    q, kscale = _quantize_kernel(kernel)
+    assert q.dtype == jnp.int8
+    dequant = q.astype(jnp.float32) * kscale.astype(jnp.float32)
+    want = upsample_norm_relu_pad_pallas(
+        x, dequant, scale, bias, pad=pad, interpret=True)
+    got = upsample_norm_relu_pad_pallas_int8(
+        x, q, kscale, scale, bias, pad=pad, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_fused_int8_rejects_non_int8_kernel():
+    x = _rand((1, 8, 8, 16))
+    kernel = _rand((3, 3, 16, 8))
+    scale = bias = _rand((8,))
+    with pytest.raises(TypeError, match="int8"):
+        upsample_norm_relu_pad_pallas_int8(
+            x, kernel, jnp.ones((1, 1, 1, 8)), scale, bias,
+            interpret=True)
+
+
+# -- forward-only (no_vjp) builds ------------------------------------------
+
+def test_no_vjp_builds_are_bit_identical():
+    """The no_vjp path skips custom-VJP registration but calls the SAME
+    forward function, so outputs must match bit for bit — not within a
+    tolerance. A drifted fused-tier program would silently eat the
+    shadow-probe quality budget."""
+    x = _rand((1, 8, 8, 16), seed=0)
+    scale = _rand((16,), seed=1)
+    bias = _rand((16,), seed=2) * 0.1
+    a = instance_norm_pallas(x, scale, bias, interpret=True)
+    b = instance_norm_pallas(x, scale, bias, interpret=True, no_vjp=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    a = instance_norm_relu_pad_pallas(x, scale, bias, pad=3,
+                                      interpret=True)
+    b = instance_norm_relu_pad_pallas(x, scale, bias, pad=3,
+                                      interpret=True, no_vjp=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    kernel = _rand((3, 3, 16, 8), seed=3) * 0.3
+    os_, ob = _rand((8,), seed=4), _rand((8,), seed=5) * 0.1
+    a = upsample_norm_relu_pad_pallas(x, kernel, os_, ob, pad=0,
+                                      interpret=True)
+    b = upsample_norm_relu_pad_pallas(x, kernel, os_, ob, pad=0,
+                                      interpret=True, no_vjp=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_norm_impl_accepts_fwd_variants():
+    from cyclegan_tpu.ops.norm import instance_norm
+
+    x = _rand((1, 8, 8, 16))
+    scale, bias = _rand((16,)), _rand((16,)) * 0.1
+    ref = instance_norm(x, scale, bias, impl="auto")
+    got = instance_norm(x, scale, bias, impl="auto_fwd")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+# -- dtype-aware VMEM accounting -------------------------------------------
+
+def test_vmem_int8_accounting_charges_one_byte_per_weight():
+    h, w, c_in, pad, item = 8, 8, 64, 0, 4
+    f32 = vmem.upsample_bytes(h, w, c_in, pad, item)
+    q = vmem.upsample_bytes_int8(h, w, c_in, pad, item)
+    # Same activation slabs; the kernel term shrinks from 4 B to 1 B
+    # per weight, plus one f32 scale row per output-channel block.
+    kernel_elems = 9 * c_in * vmem.C_BLK
+    assert f32 - q == kernel_elems * (item - 1) - vmem.C_BLK * 4
+
+
+def test_int8_widens_the_eligibility_boundary():
+    """The headline VMEM win: a bucket whose f32 weights overflow the
+    budget fits once the kernel streams as int8. (32, 32, 1024) is the
+    canonical straddle shape: f32 ~13.4 MB > budget, int8 ~9.8 MB."""
+    h = w = 32
+    c_in, pad, item = 1024, 0, 4
+    assert vmem.upsample_fits(h, w, c_in, pad, item) is False
+    assert vmem.upsample_fits_int8(h, w, c_in, pad, item) is True
+    shape = (1, h, w, c_in)
+    assert upsample_eligible(shape, jnp.float32, pad) is False
+    assert upsample_eligible_int8(shape, jnp.float32, pad) is True
+    # Everything f32-eligible stays int8-eligible (monotone win).
+    small = (1, 8, 8, 16)
+    assert upsample_eligible(small, jnp.float32, 0)
+    assert upsample_eligible_int8(small, jnp.float32, 0)
+    # Degenerate geometry still refuses.
+    assert vmem.upsample_fits_int8(0, 8, 16, 0, item) is False
+    assert vmem.upsample_fits_int8(8, 8, 16, -1, item) is False
+
+
+def test_fused_int8_ineligible_shape_raises():
+    # Far past even the int8 budget: accounting, not geometry.
+    shape = (1, 64, 64, 4096)
+    assert not upsample_eligible_int8(shape, jnp.float32, 0)
+    x = _rand((1, 4, 4, 8))
+    q = jnp.zeros((3, 3, 8, 4), jnp.int8)
+    with pytest.raises(NotImplementedError):
+        upsample_norm_relu_pad_pallas_int8(
+            jnp.zeros(shape, jnp.float32), jnp.zeros(
+                (3, 3, 4096, 4), jnp.int8), jnp.ones((1, 1, 1, 4)),
+            jnp.ones((4,)), jnp.zeros((4,)))
+    del x, q
+
+
+# -- engine tier -----------------------------------------------------------
+
+def _tiny_model_cfg():
+    return ModelConfig(
+        generator=GeneratorConfig(filters=4, num_residual_blocks=1),
+        image_size=16,
+        compute_dtype="float32",
+    )
+
+
+@pytest.fixture(scope="module")
+def fused_engine():
+    from cyclegan_tpu.serve.engine import (
+        InferenceEngine,
+        ServeConfig,
+        build_generator,
+    )
+
+    cfg = _tiny_model_cfg()
+    gen = build_generator(cfg)
+    params = gen.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, 16, 16, 3), jnp.float32))
+    return InferenceEngine(
+        cfg, params,
+        serve_cfg=ServeConfig(batch_buckets=(2,), sizes=(16,),
+                              dtype="float32", int8_tier=True,
+                              infer_tier=True))
+
+
+def test_fused_tier_compiles_and_tracks_base(fused_engine):
+    eng = fused_engine
+    assert eng.tiers == ("base", "int8", "int8_fused")
+    assert set(eng.programs_int8_fused) == set(eng.programs)
+    assert eng.resolve_tier("int8_fused") == "int8_fused"
+    x = np.random.RandomState(1).uniform(
+        -1, 1, (2, 16, 16, 3)).astype(np.float32)
+    base = np.asarray(eng.run(x, size=16)[0][0])
+    int8 = np.asarray(eng.run(x, size=16, tier="int8")[0][0])
+    fused = np.asarray(eng.run(x, size=16, tier="int8_fused")[0][0])
+    assert fused.dtype == np.float32
+    assert np.all(np.isfinite(fused))
+    # Same end-to-end quality budget as the int8 tier (weight-only
+    # quantization over a tanh-bounded trunk)...
+    assert float(np.max(np.abs(fused - base))) < 0.05
+    # ...and the fused program computes the SAME quantized math as the
+    # dequant-outside int8 program up to summation order, so the two
+    # tiers sit orders of magnitude closer to each other than either
+    # sits to f32.
+    assert float(np.max(np.abs(fused - int8))) < 1e-5
+
+
+def test_fused_tier_shares_one_quantized_tree(fused_engine):
+    # int8 and int8_fused run off the SAME quantized params — the
+    # fused tier adds programs, not a second copy of the weights.
+    assert fused_engine._fwd_params_int8 is not None
+
+
+def test_engine_without_infer_tier_rejects_fused_requests():
+    from cyclegan_tpu.serve.engine import (
+        InferenceEngine,
+        ServeConfig,
+        build_generator,
+    )
+
+    cfg = _tiny_model_cfg()
+    gen = build_generator(cfg)
+    params = gen.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, 16, 16, 3), jnp.float32))
+    eng = InferenceEngine(
+        cfg, params,
+        serve_cfg=ServeConfig(batch_buckets=(1,), sizes=(16,),
+                              dtype="float32"))
+    with pytest.raises(ValueError, match="infer_tier"):
+        eng.resolve_tier("int8_fused")
+
+
+def test_infer_tier_refuses_fused_cycle():
+    from cyclegan_tpu.serve.engine import ServeConfig
+
+    with pytest.raises(ValueError, match="infer_tier"):
+        ServeConfig(with_cycle=True, infer_tier=True)
+
+
+def test_fleet_executor_e2e_int8_fused(fused_engine):
+    from cyclegan_tpu.serve.fleet import FleetConfig, FleetExecutor
+
+    fleet = FleetExecutor(fused_engine, FleetConfig(
+        n_replicas=1, max_batch=2, max_wait_ms=1.0))
+    try:
+        assert "int8_fused" in fleet.stats()["tiers"]
+        img = np.random.RandomState(2).uniform(
+            -1, 1, (16, 16, 3)).astype(np.float32)
+        out = fleet.submit(img, tier="int8_fused").result(timeout=60)
+        want = np.asarray(fused_engine.run(
+            img[None], size=16, tier="int8_fused")[0][0])[0]
+        np.testing.assert_allclose(np.asarray(out["fake"]), want,
+                                   rtol=1e-5, atol=1e-5)
+    finally:
+        fleet.close()
+
+
+# -- brownout ladder: fused rung + fail-fast config ------------------------
+
+def test_cascade_steps_through_fused_rung():
+    from cyclegan_tpu.serve.fleet.cascade import (
+        BrownoutController,
+        CascadeConfig,
+    )
+
+    cfg = CascadeConfig(tiers=("base", "int8", "int8_fused"))
+    b = BrownoutController(cfg, cfg.tiers,
+                           ["interactive", "batch", "best_effort"])
+    assert b.max_level == 6  # 3 classes x 2 ladder steps
+    b._level = 1
+    assert b.tier_for("best_effort", "base") == "int8"
+    b._level = 2
+    assert b.tier_for("best_effort", "base") == "int8_fused"
+    assert b.tier_for("batch", "base") == "base"
+    b._level = 6
+    assert b.tier_for("interactive", "base") == "int8_fused"
+    # An explicit int8 request degrades one rung, to the fused floor.
+    b._level = 2
+    assert b.tier_for("best_effort", "int8") == "int8_fused"
+
+
+def test_fleet_config_rejects_unknown_degrade_order_class():
+    from cyclegan_tpu.serve.fleet import FleetConfig
+    from cyclegan_tpu.serve.fleet.cascade import CascadeConfig
+
+    with pytest.raises(ValueError, match="platinum") as ei:
+        FleetConfig(cascade=CascadeConfig(
+            tiers=("base", "int8"),
+            degrade_order=("best_effort", "platinum")))
+    # Domain-registry-style refusal: the error names the valid set.
+    for name in ("interactive", "batch", "best_effort"):
+        assert name in str(ei.value)
+
+
+def test_fleet_executor_rejects_uncompiled_cascade_tier(fused_engine):
+    from cyclegan_tpu.serve.fleet import FleetConfig, FleetExecutor
+    from cyclegan_tpu.serve.fleet.cascade import CascadeConfig
+
+    # The real fused engine never compiled "perturb": asking the ladder
+    # to degrade into it must fail at construction, naming both sides.
+    with pytest.raises(ValueError, match="perturb") as ei:
+        FleetExecutor(fused_engine, FleetConfig(cascade=CascadeConfig(
+            tiers=("base", "int8", "perturb"))))
+    assert "int8_fused" in str(ei.value)  # ...have [compiled tiers]
+
+
+# -- cache_warm coverage ---------------------------------------------------
+
+def test_cache_warm_lists_fused_programs():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from tools.cache_warm import serve_programs
+
+    progs = serve_programs()
+    fused = [p for p in progs if p.get("quantized") == "fused"]
+    assert fused, "no int8_fused rows in the warm list"
+    keys = [p["key"] for p in progs]
+    assert len(keys) == len(set(keys))
+    for p in fused:
+        assert p["dtype"] == "float32"
+        assert any(c.startswith("serve/int8_fused/") for c in p["covers"])

@@ -199,6 +199,19 @@ def serve_programs() -> list:
                 "quantized": True,
                 "covers": [f"serve/int8/b{batch}/i{size}"],
             })
+        # The int8_fused inference-only tier (server --int8_fused /
+        # brownout rung below int8): the SAME quantized tree, traced
+        # with upsample_impl="zeroskip_fused_int8" (upsample weights
+        # stay int8 into the Pallas kernel) + forward-only norm builds.
+        for batch in DEFAULT_BATCH_BUCKETS:
+            progs.append({
+                "key": f"serve int8f:b{batch}i{size}",
+                "mode": "serve", "dtype": "float32", "batch": batch,
+                "image": size, "k": 1, "pad_mode": "reflect",
+                "pad_impl": "pad", "accum": None, "with_cycle": False,
+                "quantized": "fused",
+                "covers": [f"serve/int8_fused/b{batch}/i{size}"],
+            })
         # The --panels fused two-pass program, largest bucket only
         # (panel requests are batch-CLI traffic, not the server's
         # low-latency path).
@@ -244,6 +257,19 @@ def _lower(prog: dict):
                 # startup quantization into pure avals — identical
                 # trace to InferenceEngine's int8_tier compile.
                 p_spec = quantized_param_specs(model_cfg, (image,))
+                if prog["quantized"] == "fused":
+                    # int8_fused traces the fused generator (in-kernel
+                    # dequant upsample, forward-only norms) against the
+                    # SAME quantized avals — mirrors the engine's
+                    # infer_tier compile exactly.
+                    import dataclasses
+
+                    fused_cfg = dataclasses.replace(
+                        model_cfg,
+                        upsample_impl="zeroskip_fused_int8",
+                        instance_norm_impl="auto_fwd")
+                    return lower_forward(fused_cfg, p_spec, None, batch,
+                                         image, False, quantized="fused")
                 return lower_forward(model_cfg, p_spec, None, batch,
                                      image, False, quantized=True)
             p_spec = param_specs(model_cfg, (image,))

@@ -543,7 +543,7 @@ def drill_overload_brownout(fast: bool) -> dict:
     engine = InferenceEngine(
         cfg, params,
         serve_cfg=ServeConfig(batch_buckets=(1, 2), sizes=(16,),
-                              int8_tier=True))
+                              int8_tier=True, infer_tier=True))
     rec = _Recorder()
     # Capacity must leave backlog headroom ABOVE the autoscale trigger
     # (capacity/drain > up_backlog_s), or the queue saturates and sheds
@@ -551,7 +551,8 @@ def drill_overload_brownout(fast: bool) -> dict:
     auto = AutoscaleConfig(min_replicas=1, max_replicas=3, eval_s=0.05,
                            hysteresis=2, cooldown_s=0.4,
                            up_backlog_s=0.1)
-    casc = CascadeConfig(tiers=("base", "int8"), enter_backlog_s=0.05,
+    casc = CascadeConfig(tiers=("base", "int8", "int8_fused"),
+                         enter_backlog_s=0.05,
                          exit_backlog_s=0.02, hysteresis=2,
                          cooldown_s=0.1, shadow_fraction=0.1)
     ex = FleetExecutor(

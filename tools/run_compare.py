@@ -61,8 +61,10 @@ Cross-platform pairs (cpu seed rounds vs the first TPU round) are
 SKIPPED, not failed: the committed series legally changes platform.
 
 bench_serve records (metric `cyclegan_serve_*`) get a serving axis:
-saturated pipeline + fleet + int8-tier images/sec (each gated by
---max_bench_drop), the p95 latency set — low-load, saturated, the
+saturated pipeline + fleet + int8-tier + int8_fused-tier images/sec
+(each gated by --max_bench_drop), the fused tier's unrounded
+max|int8_fused - f32| quality probe (candidate-side, gated by
+--max_int8_fused_drift), the p95 latency set — low-load, saturated, the
 overload sweep's per-class p95s, and the autoscale phases' per-class
 p95s — gated by --max_serve_p95_increase, and the class-ordered-
 shedding invariant (a candidate that sheds `interactive` while
@@ -165,6 +167,8 @@ def serve_profile(record: dict, name: str = "?") -> dict:
         else {}
     int8 = parsed.get("int8") if isinstance(parsed.get("int8"), dict) \
         else {}
+    int8_fused = parsed.get("int8_fused") \
+        if isinstance(parsed.get("int8_fused"), dict) else {}
     overload = fleet.get("overload") \
         if isinstance(fleet.get("overload"), dict) else {}
     p95: Dict[str, float] = {}
@@ -211,6 +215,8 @@ def serve_profile(record: dict, name: str = "?") -> dict:
         "config": parsed.get("config"),
         "fleet_ips": _float(fleet.get("images_per_sec")),
         "int8_ips": _float(int8.get("images_per_sec")),
+        "int8_fused_ips": _float(int8_fused.get("images_per_sec")),
+        "int8_fused_drift": _float(int8_fused.get("max_abs_diff_vs_base")),
         "p95_ms": p95,
         "shed_by_class": {str(k): int(v) for k, v in shed.items()
                           if isinstance(v, (int, float))},
@@ -446,7 +452,8 @@ def _compare_serve(base: dict, cand: dict, th) -> List[Check]:
                  f"{cand.get('platform')}: serving perf not comparable")]
     for axis, key in (("serve headline", "value"),
                       ("serve fleet", "fleet_ips"),
-                      ("serve int8", "int8_ips")):
+                      ("serve int8", "int8_ips"),
+                      ("serve int8_fused", "int8_fused_ips")):
         bv, cv = base.get(key), cand.get(key)
         if bv is None or cv is None:
             checks.append((SKIP, axis,
@@ -457,6 +464,21 @@ def _compare_serve(base: dict, cand: dict, th) -> List[Check]:
         checks.append((status, axis,
                        f"{bv:.2f} -> {cv:.2f} img/s (drop {100 * drop:.1f}% "
                        f"vs limit {100 * th.max_bench_drop:.1f}%)"))
+    # Fused-tier quality probe — a CANDIDATE invariant (the base may
+    # predate the tier): the unrounded max|int8_fused - f32| from the
+    # bench round is the same shadow-probe budget the brownout ladder
+    # serves under, so a drifted fused program fails here before it
+    # ever fails a drill.
+    drift = cand.get("int8_fused_drift")
+    if drift is not None:
+        over = drift > th.max_int8_fused_drift
+        checks.append((
+            FAIL if over else PASS, "serve int8_fused drift",
+            f"max|int8_fused - f32| {drift:.3e} vs limit "
+            f"{th.max_int8_fused_drift:g} (shadow-probe quality budget)"))
+    elif cand.get("int8_fused_ips") is not None:
+        checks.append((SKIP, "serve int8_fused drift",
+                       "fused tier measured but no drift recorded"))
     common_p95 = sorted(set(base["p95_ms"]) & set(cand["p95_ms"]))
     for key in common_p95:
         bv, cv = base["p95_ms"][key], cand["p95_ms"][key]
@@ -863,6 +885,7 @@ def make_thresholds(
     max_transfer_epoch_frac: float = 0.25,
     max_trace_overhead: float = 0.03,
     max_goodput_drop: float = 0.05,
+    max_int8_fused_drift: float = 0.05,
     json: bool = False,
 ) -> argparse.Namespace:
     """Programmatic threshold bundle (bench.py's end-of-run hook)."""
@@ -877,6 +900,7 @@ def make_thresholds(
         max_transfer_epoch_frac=max_transfer_epoch_frac,
         max_trace_overhead=max_trace_overhead,
         max_goodput_drop=max_goodput_drop,
+        max_int8_fused_drift=max_int8_fused_drift,
         json=json,
     )
 
@@ -911,6 +935,11 @@ def main(argv=None) -> int:
                         help="max fractional throughput cost of serving "
                              "at --trace_sample 1.0 vs 0.0 (candidate-"
                              "side; bench_serve trace_overhead phase)")
+    parser.add_argument("--max_int8_fused_drift", default=0.05, type=float,
+                        help="max unrounded max|int8_fused - f32| a "
+                             "candidate bench_serve round may record for "
+                             "the fused inference tier (candidate-side "
+                             "shadow-probe quality budget)")
     parser.add_argument("--max_goodput_drop", default=0.05, type=float,
                         help="max absolute drop of the seconds-weighted "
                              "goodput fraction (obs/goodput.py ledger) "
