@@ -10,9 +10,22 @@ The reference cannot express this measurement at all — MirroredStrategy
 publishes no scaling counters; its only timer is the per-epoch `elapse`
 scalar (/root/reference/main.py:388-392).
 
+dp x spatial grid mode (`--grid`): instead of growing a pure-data mesh,
+measure an explicit list of `DPxSP` cells — each cell builds the 2-D
+mesh, holds per-DATA-SHARD batch fixed, and can run either conv
+sharding (`--spatial_impl {xla,halo}`), remat, and gradient
+accumulation. This is how the 1024^2 workload is measured: it only
+exists as a (spatial >= 4, remat, accum) cell, and each cell first
+passes the analytic HBM ledger (anchored on the compiler-measured
+512^2/256^2 temp peaks in docs/BENCHMARKS.md) before any compile is
+attempted — on TPU a predicted-OOM cell is skipped, never burned.
+
 Run on a TPU slice:   python bench_scaling.py --batch 8 --dtype bfloat16
 Smoke-run on CPU:     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
                         python bench_scaling.py --image 32 --tiny
+dp x spatial grid:    python bench_scaling.py --grid 8x1,4x2,2x4 --spatial_impl halo
+1024^2 cell:          python bench_scaling.py --grid 2x4 --image 1024 \
+                        --batch 1 --accum 4 --remat --tiny
 
 Prints ONE JSON line: {"metric": "weak_scaling_efficiency", ...}.
 """
@@ -27,24 +40,50 @@ import time
 from cyclegan_tpu.utils.platform import ensure_platform_from_env
 
 
-def measure(n_devices: int, args) -> float:
-    """images/sec on the first n_devices devices, scan-mode."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
+# Analytic HBM ledger anchors: XLA:TPU compiler cost analysis of the
+# exact jitted step (docs/BENCHMARKS.md, docs/aot_analysis.json).
+# Temp peaks scale ~linearly with per-device activation volume
+# (batch x H x W), and spatial sharding divides H across the axis.
+_LEDGER_ANCHOR_REMAT = (10.75, 4, 512)    # temps GB @ b4, 512^2, remat
+_LEDGER_ANCHOR_PLAIN = (14.68, 16, 256)   # temps GB @ b16, 256^2
+_LEDGER_CODE_ARGS_GB = 1.6                # code + args margin (b4 row)
+_LEDGER_HBM_USABLE_GB = 15.75             # v5e: 16G - runtime reserve
+
+
+def hbm_ledger(image: int, per_shard_batch: int, spatial: int,
+               remat: bool) -> dict:
+    """BENCHMARKS-style per-device HBM prediction for one grid cell.
+
+    Accumulation is deliberately absent from the formula: the microbatch
+    IS `per_shard_batch`, and peak temps track the microbatch (that is
+    the point of accumulation).
+    """
+    gb_anchor, b_anchor, s_anchor = (
+        _LEDGER_ANCHOR_REMAT if remat else _LEDGER_ANCHOR_PLAIN)
+    temps = (gb_anchor * (per_shard_batch / b_anchor)
+             * (image / s_anchor) ** 2 / max(1, spatial))
+    predicted = temps + _LEDGER_CODE_ARGS_GB
+    return {
+        "anchor": f"compiler temps {gb_anchor} GB @ b{b_anchor} "
+                  f"{s_anchor}^2{' remat' if remat else ''}",
+        "predicted_temp_gb": round(temps, 2),
+        "predicted_total_gb": round(predicted, 2),
+        "hbm_usable_gb": _LEDGER_HBM_USABLE_GB,
+        "fits": bool(predicted <= _LEDGER_HBM_USABLE_GB),
+    }
+
+
+def _build_config(args, spatial: int):
+    import dataclasses
 
     from cyclegan_tpu.config import (
         Config,
         DiscriminatorConfig,
         GeneratorConfig,
         ModelConfig,
+        ParallelConfig,
         TrainConfig,
     )
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from cyclegan_tpu.parallel import make_mesh_plan
-    from cyclegan_tpu.parallel.mesh import replicated
-    from cyclegan_tpu.train import create_state, make_train_step
 
     gen_cfg = (
         GeneratorConfig(filters=8, num_residual_blocks=2)
@@ -52,69 +91,121 @@ def measure(n_devices: int, args) -> float:
         else GeneratorConfig()
     )
     disc_cfg = DiscriminatorConfig(filters=8) if args.tiny else DiscriminatorConfig()
-    cfg = Config(
-        model=ModelConfig(
-            generator=gen_cfg,
-            discriminator=disc_cfg,
-            compute_dtype=args.dtype,
-            image_size=args.image,
-        ),
+    model = ModelConfig(
+        generator=gen_cfg,
+        discriminator=disc_cfg,
+        compute_dtype=args.dtype,
+        image_size=args.image,
+        remat=args.remat,
+    )
+    model = dataclasses.replace(model, spatial_impl=args.spatial_impl)
+    return Config(
+        model=model,
+        parallel=ParallelConfig(spatial_parallelism=spatial),
         train=TrainConfig(batch_size=args.batch),
     )
+
+
+def measure(n_devices: int, args, spatial: int = 1) -> float:
+    """images/sec on the first n_devices devices arranged as an
+    (n_devices/spatial) x spatial mesh, scan-mode (or accum-mode when
+    --accum > 1). Per-DATA-SHARD batch is held fixed."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cyclegan_tpu.parallel import make_mesh_plan
+    from cyclegan_tpu.parallel.dp import (
+        shard_accum_train_step,
+        shard_multi_train_step,
+    )
+    from cyclegan_tpu.parallel.mesh import replicated
+    from cyclegan_tpu.train import (
+        create_state,
+        make_accum_train_step,
+        make_train_step,
+    )
+
+    if n_devices % max(1, spatial):
+        raise ValueError(f"{n_devices} devices not divisible by "
+                         f"spatial={spatial}")
+    cfg = _build_config(args, spatial)
     plan = make_mesh_plan(cfg.parallel, jax.devices()[:n_devices])
-    global_batch = n_devices * args.batch
+    global_batch = plan.n_data * args.batch
 
     state = jax.device_put(
         create_state(cfg, jax.random.PRNGKey(0)), replicated(plan)
     )
-    step_fn = make_train_step(cfg, global_batch)
-    rep = replicated(plan)
-    # Stacked inputs are [k, batch, ...]: the scan axis k leads, so the
-    # batch shard spec moves to dim 1 (images and weights alike).
-    bs = NamedSharding(plan.mesh, P(None, plan.data_axis))
-
-    k = args.scan_steps
-
-    def multi_step(state, xs, ys, wts):
-        def body(st, inp):
-            st, m = step_fn(st, *inp)
-            return st, m["loss_G/total"]
-        state, losses = jax.lax.scan(body, state, (xs, ys, wts))
-        return state, losses[-1]
-
-    step = jax.jit(
-        multi_step,
-        in_shardings=(rep, bs, bs, bs),
-        out_shardings=(rep, rep),
-        donate_argnums=(0,),
-    )
-
     rng = np.random.RandomState(0)
     s = args.image
+
+    if args.accum > 1:
+        # [K, micro, ...] microbatches, one optimizer update per call.
+        step = shard_accum_train_step(
+            plan,
+            make_accum_train_step(
+                cfg, global_batch * args.accum, args.accum, plan),
+        )
+        k = args.accum
+    else:
+        step = shard_multi_train_step(
+            plan, make_train_step(cfg, global_batch, plan), args.scan_steps)
+        k = args.scan_steps
+
     xs = jnp.asarray(rng.rand(k, global_batch, s, s, 3).astype(np.float32) * 2 - 1)
     ys = jnp.asarray(rng.rand(k, global_batch, s, s, 3).astype(np.float32) * 2 - 1)
     wts = jnp.ones((k, global_batch), jnp.float32)
 
-    state, last = step(state, xs, ys, wts)
-    float(jax.device_get(last))  # execution fence (not block_until_ready)
+    def fence(metrics):
+        leaf = jax.tree_util.tree_leaves(metrics)[0]
+        float(jax.device_get(leaf if leaf.ndim == 0 else leaf[-1]))
+
+    state, m = step(state, xs, ys, wts)
+    fence(m)  # execution fence (not block_until_ready)
     t0 = time.perf_counter()
     for _ in range(args.iters):
-        state, last = step(state, xs, ys, wts)
-    float(jax.device_get(last))
+        state, m = step(state, xs, ys, wts)
+    fence(m)
     dt = time.perf_counter() - t0
     return 2 * global_batch * k * args.iters / dt
 
 
+def _parse_grid(spec: str):
+    """'8x1,4x2,2x4' -> [(8, 1), (4, 2), (2, 4)] (dp, spatial)."""
+    cells = []
+    for cell in spec.split(","):
+        dp, _, sp = cell.strip().lower().partition("x")
+        cells.append((int(dp), int(sp or 1)))
+    return cells
+
+
 def _emit(results, n_all, args) -> None:
     results = dict(results)
-    max_n = max(results) if results else 0
-    scaled = max_n > 1 and 1 in results
-    if scaled:
-        eff = (results[max_n] / max_n) / results[1]
-    elif results and n_all == 1:
-        eff = 1.0  # single-device platform: nothing to scale over
+    grid = bool(args.grid)
+    if grid:
+        # Weak scaling across cells: per-device throughput of the
+        # largest mesh (last measured on ties — e.g. 8x1 vs 4x2) vs the
+        # smallest (first measured on ties).
+        ordered = [(dp * sp, v) for (dp, sp), v in results.items()]
+        scaled = len(ordered) > 1
+        if scaled:
+            n_lo, ips_lo = min(ordered, key=lambda t: t[0])
+            n_hi, ips_hi = max(reversed(ordered), key=lambda t: t[0])
+            eff = (ips_hi / n_hi) / (ips_lo / n_lo)
+        else:
+            eff = 1.0 if results else 0.0
+        ips = {f"{dp}x{sp}": round(v, 2) for (dp, sp), v in results.items()}
+        max_n = max(n for n, _ in ordered) if ordered else 0
     else:
-        eff = 0.0  # multi-device platform but no scaling was measured
+        max_n = max(results) if results else 0
+        scaled = max_n > 1 and 1 in results
+        if scaled:
+            eff = (results[max_n] / max_n) / results[1]
+        elif results and n_all == 1:
+            eff = 1.0  # single-device platform: nothing to scale over
+        else:
+            eff = 0.0  # multi-device platform but no scaling measured
+        ips = {str(k): round(v, 2) for k, v in results.items()}
     line = {
         "metric": "weak_scaling_efficiency",
         "value": round(eff, 4),
@@ -123,11 +214,25 @@ def _emit(results, n_all, args) -> None:
         "devices": n_all,
         "measured_devices": max_n,
         "per_device_batch": args.batch,
-        "images_per_sec": {str(k): round(v, 2) for k, v in results.items()},
+        "images_per_sec": ips,
     }
+    if grid:
+        line["mode"] = "grid"
+        line["image"] = args.image
+        line["spatial_impl"] = args.spatial_impl
+        line["remat"] = bool(args.remat)
+        line["accum"] = args.accum
+        if args.image >= 512:
+            # Ledger for the most-sharded measured cell; when nothing
+            # completed, fall back to the ATTEMPTED grid so the emitted
+            # ledger still describes the config that was preflighted.
+            sp_max = max((sp for _, sp in results), default=0) or max(
+                (sp for _, sp in _parse_grid(args.grid)), default=1)
+            line["hbm_ledger"] = hbm_ledger(
+                args.image, args.batch, sp_max, args.remat)
     if not results:
         line["error"] = "no mesh size completed"
-    elif not scaled and n_all > 1:
+    elif not scaled and n_all > 1 and not grid:
         line["error"] = "only the 1-device size completed; no scaling measured"
     print(json.dumps(line), flush=True)
 
@@ -185,6 +290,48 @@ def main(args) -> None:
 
     n_all = len(jax.devices())
     n_all_box[0] = n_all
+
+    if args.grid:
+        cells = [(dp, sp) for dp, sp in _parse_grid(args.grid)
+                 if dp * sp <= n_all]
+        dropped = [c for c in _parse_grid(args.grid) if c not in cells]
+        if dropped:
+            print(f"[scaling] dropping cells beyond {n_all} devices: "
+                  f"{dropped}", file=sys.stderr, flush=True)
+        on_tpu = jax.devices()[0].platform == "tpu"
+        t0 = time.perf_counter()
+        for dp, sp in cells:
+            if results and time.perf_counter() - t0 > budget:
+                print(f"[scaling] skipping {dp}x{sp}+ (budget spent)",
+                      file=sys.stderr, flush=True)
+                break
+            if args.image >= 512:
+                ledger = hbm_ledger(args.image, args.batch, sp, args.remat)
+                print(f"[scaling] {dp}x{sp} HBM ledger: "
+                      f"{ledger['predicted_total_gb']} GB predicted vs "
+                      f"{ledger['hbm_usable_gb']} usable "
+                      f"({'fits' if ledger['fits'] else 'DOES NOT FIT'})",
+                      file=sys.stderr, flush=True)
+                if on_tpu and not ledger["fits"]:
+                    print(f"[scaling] {dp}x{sp}: skipped (predicted OOM)",
+                          file=sys.stderr, flush=True)
+                    continue
+            try:
+                ips = measure(dp * sp, args, spatial=sp)
+            except Exception as e:
+                # Cells are independent (a floor violation in one mesh
+                # shape says nothing about the others) — keep going.
+                print(f"[scaling] {dp}x{sp}: FAILED "
+                      f"{type(e).__name__}: {e}",
+                      file=sys.stderr, flush=True)
+                continue
+            results[(dp, sp)] = ips
+            print(f"[scaling] {dp}x{sp}: {ips:.2f} images/sec "
+                  f"({ips / (dp * sp):.2f}/device)",
+                  file=sys.stderr, flush=True)
+        emit_once()
+        return
+
     sizes = [1]
     n = 2
     while n < n_all:
@@ -214,11 +361,22 @@ def main(args) -> None:
 
 if __name__ == "__main__":
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--batch", default=8, type=int, help="per-device batch")
+    p.add_argument("--batch", default=8, type=int,
+                   help="per-data-shard batch (per-device when spatial=1)")
     p.add_argument("--dtype", default="bfloat16", choices=["float32", "bfloat16"])
     p.add_argument("--image", default=256, type=int)
     p.add_argument("--scan_steps", default=4, type=int)
     p.add_argument("--iters", default=2, type=int)
     p.add_argument("--tiny", action="store_true",
                    help="tiny model (CPU smoke runs)")
+    p.add_argument("--grid", default=None,
+                   help="comma-separated DPxSP mesh cells to measure "
+                        "(e.g. 8x1,4x2,2x4); overrides the doubling scan")
+    p.add_argument("--spatial_impl", default="xla", choices=["xla", "halo"],
+                   help="conv sharding for spatial cells (grid mode)")
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint residual blocks (512^2+ configs)")
+    p.add_argument("--accum", default=1, type=int,
+                   help="gradient-accumulation microbatches per update "
+                        "(>1 replaces the scan-steps loop)")
     main(p.parse_args())

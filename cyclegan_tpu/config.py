@@ -112,6 +112,16 @@ class ModelConfig:
     #                    on TPU, per-kernel dequant + XLA zeroskip off
     #                    TPU); no VJP exists on this path.
     upsample_impl: str = "dense"  # "dense"|"zeroskip"|"zeroskip_fused"|"zeroskip_fused_int8"
+    # Spatial-sharding backend for the H-sharded mesh axis:
+    # "xla"  = shard the H axis under jit and let the SPMD partitioner
+    #          synthesize every halo exchange (the historical path);
+    # "halo" = run the stride-1 conv sites inside shard_map on
+    #          row-sharded blocks, trading exactly `halo` boundary rows
+    #          over lax.ppermute per conv (parallel/halo.py) — same
+    #          param tree, checkpoints interchange across impls. Only
+    #          engages when a MeshPlan with n_spatial > 1 is passed to
+    #          build_models; single-device inference is unaffected.
+    spatial_impl: str = "xla"  # "xla" | "halo"
 
     def __post_init__(self):
         # A typo like "Reflect" would otherwise silently select zero/SAME
@@ -145,6 +155,20 @@ class ModelConfig:
                 "upsample_impl must be 'dense', 'zeroskip', "
                 "'zeroskip_fused' or 'zeroskip_fused_int8', "
                 f"got {self.upsample_impl!r}"
+            )
+        if self.spatial_impl not in ("xla", "halo"):
+            raise ValueError(
+                f"spatial_impl must be 'xla' or 'halo', got "
+                f"{self.spatial_impl!r}"
+            )
+        if self.spatial_impl == "halo" and self.pad_impl in (
+                "fused", "epilogue"):
+            raise ValueError(
+                f"spatial_impl='halo' is incompatible with pad_impl="
+                f"{self.pad_impl!r}: the halo path schedules its own "
+                "pad+conv inside shard_map, so there is no separate "
+                "reflect-pad site for the fused/epilogue kernels to "
+                "absorb — use pad_impl='pad'"
             )
         if (self.upsample_impl in ("zeroskip_fused", "zeroskip_fused_int8")
                 and self.instance_norm_impl == "xla"):

@@ -19,7 +19,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from cyclegan_tpu.config import DiscriminatorConfig
-from cyclegan_tpu.models.modules import Downsample, init_normal
+from cyclegan_tpu.models.modules import Downsample, HaloConv, init_normal
 
 
 class PatchGANDiscriminator(nn.Module):
@@ -31,6 +31,14 @@ class PatchGANDiscriminator(nn.Module):
     # trunk slab is at the default 256^2 sizes). Same param tree as
     # "pad"; numerics agree to fp tolerance.
     pad_impl: str = "pad"
+    # spatial_impl="halo": the two stride-1 4x4 SAME sites (the last
+    # trunk Downsample and the patch-logits head) run as explicit
+    # asymmetric zero-mode halo exchanges (modules.HaloConv — SAME for
+    # k=4 pads 1 above / 2 below). Stride-2 sites stay on the XLA
+    # partitioner. Param tree unchanged; None = the historical path.
+    halo_mesh: Optional[Any] = None
+    data_axis: str = "data"
+    spatial_axis: str = "spatial"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -65,16 +73,30 @@ class PatchGANDiscriminator(nn.Module):
                 dtype=self.dtype,
                 norm_impl=self.norm_impl,
                 fuse_epilogue=self.pad_impl == "epilogue",
+                halo_mesh=self.halo_mesh,
+                data_axis=self.data_axis,
+                spatial_axis=self.spatial_axis,
             )(y)
 
-        # Patch logits head (model.py:207-211): bias on, no activation
-        y = nn.Conv(
-            1,
-            (4, 4),
-            strides=(1, 1),
-            padding="SAME",
-            use_bias=True,
-            kernel_init=init_normal,
-            dtype=self.dtype,
-        )(y)
+        # Patch logits head (model.py:207-211): bias on, no activation.
+        # "Conv_1" is the name the unnamed-nn.Conv layout auto-assigns
+        # here (stem took "Conv_0"), pinned so the halo layout keeps the
+        # identical checkpoint tree.
+        if self.halo_mesh is not None:
+            y = HaloConv(
+                1, kernel_size=(4, 4), mode="zero", use_bias=True,
+                dtype=self.dtype, mesh=self.halo_mesh,
+                data_axis=self.data_axis, spatial_axis=self.spatial_axis,
+                name="Conv_1",
+            )(y)
+        else:
+            y = nn.Conv(
+                1,
+                (4, 4),
+                strides=(1, 1),
+                padding="SAME",
+                use_bias=True,
+                kernel_init=init_normal,
+                dtype=self.dtype,
+            )(y)
         return y.astype(in_dtype)

@@ -38,6 +38,9 @@ class _TrunkBody(nn.Module):
     remat: bool = False
     pad_mode: str = "reflect"
     pad_impl: str = "pad"
+    halo_mesh: Optional[Any] = None
+    data_axis: str = "data"
+    spatial_axis: str = "spatial"
 
     @nn.compact
     def __call__(self, carry, _):
@@ -45,6 +48,8 @@ class _TrunkBody(nn.Module):
         y = block_cls(
             dtype=self.dtype, norm_impl=self.norm_impl,
             pad_mode=self.pad_mode, pad_impl=self.pad_impl,
+            halo_mesh=self.halo_mesh, data_axis=self.data_axis,
+            spatial_axis=self.spatial_axis,
             name="ResidualBlock_0"
         )(carry)
         return y, None
@@ -74,6 +79,14 @@ class ResNetGenerator(nn.Module):
     # "zeroskip_fused". All three share one param tree (checkpoints
     # interchange); model_meta records the setting for provenance.
     upsample_impl: str = "dense"
+    # spatial_impl="halo" support: when a Mesh with a >1 spatial axis is
+    # bound here, every stride-1 conv site (the 7x7 edge convs and the
+    # residual trunk's 3x3 convs) runs as an explicit shard_map halo
+    # exchange (modules.HaloConv) instead of relying on the XLA SPMD
+    # partitioner. Param tree unchanged; None = the historical path.
+    halo_mesh: Optional[Any] = None
+    data_axis: str = "data"
+    spatial_axis: str = "spatial"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -88,14 +101,18 @@ class ResNetGenerator(nn.Module):
         reflect = self.pad_mode == "reflect"
         epilogue = reflect and self.pad_impl == "epilogue"
         fused = reflect and self.pad_impl in ("fused", "epilogue")
+        halo = self.halo_mesh is not None
 
         def edge_conv(features, use_bias, name):
             return parity_conv(features, pad=3, reflect=reflect, fused=fused,
-                               use_bias=use_bias, dtype=self.dtype, name=name)
+                               use_bias=use_bias, dtype=self.dtype, name=name,
+                               halo_mesh=self.halo_mesh,
+                               data_axis=self.data_axis,
+                               spatial_axis=self.spatial_axis)
 
         filters = cfg.filters
         # c7s1-64 (model.py:138-145)
-        y = reflect_pad(x, 3) if reflect and not fused else x
+        y = reflect_pad(x, 3) if reflect and not fused and not halo else x
         y = edge_conv(filters, use_bias=False, name="Conv_0")(y)
         y = InstanceNorm(impl=self.norm_impl)(y)
         y = nn.relu(y)
@@ -134,6 +151,9 @@ class ResNetGenerator(nn.Module):
                 remat=self.remat,
                 pad_mode=self.pad_mode,
                 pad_impl=self.pad_impl,
+                halo_mesh=self.halo_mesh,
+                data_axis=self.data_axis,
+                spatial_axis=self.spatial_axis,
                 name="ScannedTrunk",
             )
             y, _ = trunk(y, None)
@@ -163,6 +183,9 @@ class ResNetGenerator(nn.Module):
                     norm_impl=self.norm_impl,
                     pad_mode=self.pad_mode,
                     pad_impl=self.pad_impl,
+                    halo_mesh=self.halo_mesh,
+                    data_axis=self.data_axis,
+                    spatial_axis=self.spatial_axis,
                     name=f"ResidualBlock_{i}",
                 )(y)
 
@@ -199,7 +222,7 @@ class ResNetGenerator(nn.Module):
                             fused=False, use_bias=True, dtype=self.dtype,
                             name="Conv_1")(y)
         else:
-            y = reflect_pad(y, 3) if reflect and not fused else y
+            y = reflect_pad(y, 3) if reflect and not fused and not halo else y
             y = edge_conv(self.out_channels, use_bias=True, name="Conv_1")(y)
         y = jnp.tanh(y)
         return y.astype(in_dtype)

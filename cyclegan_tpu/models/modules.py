@@ -75,19 +75,111 @@ class ReflectConv(nn.Module):
         return y
 
 
+class HaloConv(nn.Module):
+    """Stride-1 conv whose H-axis halo exchange is EXPLICIT: the body
+    runs inside shard_map on row-sharded [N, H_local, W, C] blocks and
+    trades exactly the boundary rows a VALID conv needs over
+    lax.ppermute (parallel/halo.py:spatial_sharded_conv), instead of
+    whatever the XLA SPMD partitioner synthesizes.
+
+    Drop-in for the reflect-pad + nn.Conv(VALID) pair (mode="reflect")
+    and for nn.Conv(SAME) (mode="zero"): same "kernel"/"bias" param
+    names, shapes, and init, so checkpoints interchange with the
+    spatial_impl="xla" layouts when given the same module `name`.
+
+    The shard_map island only engages when a mesh with a >1 spatial
+    axis is bound AND the module is not initializing (create_state's
+    batch-1 dummy init could never satisfy the in_specs); otherwise the
+    module computes the identical plain pad+conv, so a halo checkpoint
+    restores and serves on a single device unchanged.
+    """
+
+    features: int
+    kernel_size: Tuple[int, int] = (3, 3)
+    mode: str = "reflect"  # "reflect" | "zero"
+    use_bias: bool = False
+    dtype: Optional[Dtype] = None
+    mesh: Any = None  # jax.sharding.Mesh; None = plain path
+    data_axis: str = "data"
+    spatial_axis: str = "spatial"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        from jax import lax
+
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel", init_normal, (kh, kw, x.shape[-1], self.features),
+            jnp.float32,
+        )
+        bias = (
+            self.param(
+                "bias", nn.initializers.zeros_init(), (self.features,),
+                jnp.float32,
+            )
+            if self.use_bias
+            else None
+        )
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+            kernel = kernel.astype(self.dtype)
+            bias = bias.astype(self.dtype) if bias is not None else None
+        engaged = (
+            self.mesh is not None
+            and not self.is_initializing()
+            and dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+            .get(self.spatial_axis, 1) > 1
+        )
+        if engaged:
+            from cyclegan_tpu.parallel.halo import spatial_sharded_conv
+
+            y = spatial_sharded_conv(
+                x, kernel, self.mesh, data_axis=self.data_axis,
+                spatial_axis=self.spatial_axis, mode=self.mode,
+            )
+        else:
+            if self.mode == "reflect":
+                ph, pw = kh // 2, kw // 2
+                y = (jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)),
+                             mode="reflect") if ph or pw else x)
+            else:
+                ph_lo, ph_hi = (kh - 1) // 2, (kh - 1) - (kh - 1) // 2
+                pw_lo, pw_hi = (kw - 1) // 2, (kw - 1) - (kw - 1) // 2
+                y = jnp.pad(
+                    x, ((0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi), (0, 0)))
+            y = lax.conv_general_dilated(
+                y, kernel, window_strides=(1, 1), padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+        if bias is not None:
+            y = y + bias
+        return y
+
+
 def parity_conv(features: int, pad: int, reflect: bool, fused: bool,
-                use_bias: bool, dtype: Optional[Dtype], name: str):
+                use_bias: bool, dtype: Optional[Dtype], name: str,
+                halo_mesh: Any = None, data_axis: str = "data",
+                spatial_axis: str = "spatial"):
     """The conv factory for every reference reflect-pad site, shared by
     ResidualBlock and ResNetGenerator so the checkpoint-compat invariants
     (pinned "Conv_N" names, VALID-for-reflect vs built-in-SAME-for-zero)
     have one author. Kernel size is (2*pad+1)^2 — the only geometries the
     reference uses at these sites (3x3/pad-1, 7x7/pad-3; model.py:14-33).
+    `halo_mesh` routes the site through HaloConv (explicit ppermute halo
+    under spatial_impl='halo') — identical param tree either way.
     """
+    ksz = 2 * pad + 1
+    if halo_mesh is not None:
+        return HaloConv(
+            features, kernel_size=(ksz, ksz),
+            mode="reflect" if reflect else "zero", use_bias=use_bias,
+            dtype=dtype, mesh=halo_mesh, data_axis=data_axis,
+            spatial_axis=spatial_axis, name=name,
+        )
     if fused:
         return ReflectConv(
             features, pad=pad, use_bias=use_bias, dtype=dtype, name=name
         )
-    ksz = 2 * pad + 1
     return nn.Conv(
         features,
         (ksz, ksz),
@@ -168,6 +260,9 @@ class ResidualBlock(nn.Module):
     norm_impl: str = "auto"
     pad_mode: str = "reflect"
     pad_impl: str = "pad"
+    halo_mesh: Any = None  # spatial_impl="halo": explicit-halo conv sites
+    data_axis: str = "data"
+    spatial_axis: str = "spatial"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -175,12 +270,16 @@ class ResidualBlock(nn.Module):
         reflect = self.pad_mode == "reflect"
         epilogue = reflect and self.pad_impl == "epilogue"
         fused = reflect and self.pad_impl in ("fused", "epilogue")
+        halo = self.halo_mesh is not None
 
         def conv(name: str):
             return parity_conv(filters, pad=1, reflect=reflect, fused=fused,
-                               use_bias=False, dtype=self.dtype, name=name)
+                               use_bias=False, dtype=self.dtype, name=name,
+                               halo_mesh=self.halo_mesh,
+                               data_axis=self.data_axis,
+                               spatial_axis=self.spatial_axis)
 
-        y = reflect_pad(x, 1) if reflect and not fused else x
+        y = reflect_pad(x, 1) if reflect and not fused and not halo else x
         y = conv("Conv_0")(y)
         if epilogue:
             y = FusedNormReluPad(pad=1, impl=self.norm_impl,
@@ -193,7 +292,7 @@ class ResidualBlock(nn.Module):
         else:
             y = InstanceNorm(impl=self.norm_impl, name="InstanceNorm_0")(y)
             y = nn.relu(y)
-            y = reflect_pad(y, 1) if reflect and not fused else y
+            y = reflect_pad(y, 1) if reflect and not fused and not halo else y
             y = conv("Conv_1")(y)
         y = InstanceNorm(impl=self.norm_impl, name="InstanceNorm_1")(y)
         return x + y
@@ -324,18 +423,34 @@ class Downsample(nn.Module):
     norm_impl: str = "auto"
     pad_after: int = 0
     fuse_epilogue: bool = False
+    halo_mesh: Any = None  # spatial_impl="halo": stride-1 sites only
+    data_axis: str = "data"
+    spatial_axis: str = "spatial"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        y = nn.Conv(
-            self.filters,
-            self.kernel_size,
-            strides=self.strides,
-            padding="SAME",
-            use_bias=False,
-            kernel_init=init_normal,
-            dtype=self.dtype,
-        )(x)
+        if self.halo_mesh is not None and self.strides == (1, 1):
+            # The stride-1 SAME conv is the only Downsample geometry with
+            # a halo to trade (stride-2 windows never straddle shard
+            # boundaries the same way — those stay on the XLA partitioner).
+            # nn.Conv auto-names its site "Conv_0" inside this module, the
+            # name HaloConv must pin for checkpoint interchange.
+            y = HaloConv(
+                self.filters, kernel_size=self.kernel_size, mode="zero",
+                use_bias=False, dtype=self.dtype, mesh=self.halo_mesh,
+                data_axis=self.data_axis, spatial_axis=self.spatial_axis,
+                name="Conv_0",
+            )(x)
+        else:
+            y = nn.Conv(
+                self.filters,
+                self.kernel_size,
+                strides=self.strides,
+                padding="SAME",
+                use_bias=False,
+                kernel_init=init_normal,
+                dtype=self.dtype,
+            )(x)
         return _norm_act_epilogue(
             y, pad_after=self.pad_after, norm_impl=self.norm_impl,
             activation=self.activation, fuse=self.fuse_epilogue,

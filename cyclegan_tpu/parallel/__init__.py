@@ -10,7 +10,10 @@ from cyclegan_tpu.parallel.mesh import (
     MeshPlan,
     make_mesh_plan,
     batch_sharding,
+    match_partition_rules,
     replicated,
+    state_partition_rules,
+    state_shardings,
 )
 from cyclegan_tpu.parallel.dp import (
     shard_train_step,
@@ -39,5 +42,8 @@ __all__ = [
     "pad_to_global_batch",
     "halo_exchange",
     "make_sharded_conv",
+    "match_partition_rules",
     "sharded_conv",
+    "state_partition_rules",
+    "state_shardings",
 ]

@@ -92,11 +92,15 @@ def shard_train_step(plan: MeshPlan, train_step: Callable) -> Callable:
 
 def _stacked_shardings(plan: MeshPlan):
     """Shardings for K stacked batches [K, N, ...]: leading step axis
-    unsharded; batch/spatial shard as usual."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    unsharded; batch/spatial shard as usual. Specs come from the
+    partition-rules table (mesh.activation_partition_rules), the single
+    source of truth for step-input layouts."""
+    from jax.sharding import NamedSharding
 
-    bs = NamedSharding(plan.mesh, P(None, *plan.batch_spec()))
-    ws = NamedSharding(plan.mesh, P(None, *plan.weight_spec()))
+    from cyclegan_tpu.parallel.mesh import activation_spec
+
+    bs = NamedSharding(plan.mesh, activation_spec(plan, "xs"))
+    ws = NamedSharding(plan.mesh, activation_spec(plan, "ws"))
     return bs, ws
 
 

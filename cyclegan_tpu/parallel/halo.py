@@ -33,10 +33,16 @@ def _axis_size(axis_name) -> int:
 
 
 def halo_exchange(
-    x: jnp.ndarray, halo: int, axis_name: str, mode: str = "reflect"
+    x: jnp.ndarray, halo, axis_name: str, mode: str = "reflect"
 ) -> jnp.ndarray:
-    """Extend a row-sharded [N, H_local, W, C] block with `halo` boundary
-    rows from each ring neighbor.
+    """Extend a row-sharded [N, H_local, W, C] block with boundary rows
+    from each ring neighbor.
+
+    `halo` is an int (symmetric) or a `(lo, hi)` pair: `lo` rows arrive
+    from the shard above, `hi` from the shard below — the asymmetric form
+    an even-kernel 'SAME' conv needs (k=4 pads 1 above / 2 below).
+    Asymmetric halos are zero-mode only: reflect semantics are defined
+    for the symmetric odd-kernel pads the reference uses.
 
     Must be called inside `shard_map` with the H axis sharded over
     `axis_name`. Interior shards receive real neighbor rows; the first and
@@ -47,19 +53,25 @@ def halo_exchange(
         VALID conv over the result equals a reflect-padded global conv.
       - mode="zero": zero rows, matching a 'SAME'-padded global conv.
 
-    Returns [N, H_local + 2*halo, W, C].
+    Returns [N, H_local + lo + hi, W, C].
     """
     if mode not in ("reflect", "zero"):
         raise ValueError(f"unknown halo mode: {mode!r}")
+    lo, hi = (halo, halo) if isinstance(halo, int) else halo
+    if lo != hi and mode == "reflect":
+        raise ValueError(
+            f"asymmetric halo {(lo, hi)} is zero-mode only (reflect "
+            "semantics are symmetric)"
+        )
     n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
-    # Zero mode only needs `halo` neighbor rows; reflect additionally
+    # Zero mode only needs the traded rows locally; reflect additionally
     # mirrors halo rows past the border row on the boundary shards, which
     # takes halo+1 local rows (and is computed on every shard under SPMD).
-    min_rows = halo + 1 if mode == "reflect" else halo
+    min_rows = lo + 1 if mode == "reflect" else max(lo, hi)
     if x.shape[1] < min_rows:
         raise ValueError(
-            f"H_local={x.shape[1]} too small for halo={halo} "
+            f"H_local={x.shape[1]} too small for halo={(lo, hi)} "
             f"(need >= {min_rows} for mode={mode!r})"
         )
 
@@ -68,19 +80,22 @@ def halo_exchange(
     # below, so a single ring permutation serves all shards.
     ring_down = [(i, (i + 1) % n) for i in range(n)]
     ring_up = [(i, (i - 1) % n) for i in range(n)]
-    top = lax.ppermute(x[:, -halo:], axis_name, ring_down)
-    bottom = lax.ppermute(x[:, :halo], axis_name, ring_up)
-
-    if mode == "reflect":
-        outer_top = x[:, 1 : halo + 1][:, ::-1]
-        outer_bottom = x[:, -halo - 1 : -1][:, ::-1]
-    else:
-        outer_top = jnp.zeros_like(x[:, :halo])
-        outer_bottom = jnp.zeros_like(x[:, :halo])
-
-    top = jnp.where(idx == 0, outer_top, top)
-    bottom = jnp.where(idx == n - 1, outer_bottom, bottom)
-    return jnp.concatenate([top, x, bottom], axis=1)
+    parts = [x]
+    if lo:
+        top = lax.ppermute(x[:, -lo:], axis_name, ring_down)
+        if mode == "reflect":
+            outer_top = x[:, 1 : lo + 1][:, ::-1]
+        else:
+            outer_top = jnp.zeros_like(x[:, :lo])
+        parts.insert(0, jnp.where(idx == 0, outer_top, top))
+    if hi:
+        bottom = lax.ppermute(x[:, :hi], axis_name, ring_up)
+        if mode == "reflect":
+            outer_bottom = x[:, -hi - 1 : -1][:, ::-1]
+        else:
+            outer_bottom = jnp.zeros_like(x[:, :hi])
+        parts.append(jnp.where(idx == n - 1, outer_bottom, bottom))
+    return jnp.concatenate(parts, axis=1) if len(parts) > 1 else x
 
 
 def sharded_conv(
@@ -94,16 +109,21 @@ def sharded_conv(
     H halos come from ring neighbors (`halo_exchange`); the unsharded W
     axis is padded locally with the same mode. With an odd HWIO kernel
     this reproduces the reference's reflect-pad->VALID-conv residual
-    blocks (model.py:36-74) and 'SAME' convs shard-by-shard.
+    blocks (model.py:36-74) and 'SAME' convs shard-by-shard. Even
+    kernels are zero-mode only (the discriminator's 4x4 stride-1 sites):
+    the asymmetric SAME pad (lo = (k-1)//2, hi = k-1-lo, matching
+    XLA/TF) maps onto an asymmetric halo.
     """
     kh, kw = kernel.shape[0], kernel.shape[1]
-    if kh % 2 == 0 or kw % 2 == 0:
+    if (kh % 2 == 0 or kw % 2 == 0) and mode == "reflect":
         raise ValueError(f"sharded_conv needs odd kernel sizes, got {(kh, kw)}")
-    ph, pw = kh // 2, kw // 2
-    y = halo_exchange(x, ph, axis_name, mode=mode) if ph else x
-    if pw:
+    ph_lo, ph_hi = (kh - 1) // 2, (kh - 1) - (kh - 1) // 2
+    pw_lo, pw_hi = (kw - 1) // 2, (kw - 1) - (kw - 1) // 2
+    y = (halo_exchange(x, (ph_lo, ph_hi), axis_name, mode=mode)
+         if ph_lo or ph_hi else x)
+    if pw_lo or pw_hi:
         wmode = "reflect" if mode == "reflect" else "constant"
-        y = jnp.pad(y, ((0, 0), (0, 0), (pw, pw), (0, 0)), mode=wmode)
+        y = jnp.pad(y, ((0, 0), (0, 0), (pw_lo, pw_hi), (0, 0)), mode=wmode)
     return lax.conv_general_dilated(
         y,
         kernel,
@@ -113,19 +133,50 @@ def sharded_conv(
     )
 
 
+def _shard_map():
+    """The shard_map entry point, new spelling preferred. Older jax (the
+    image pins 0.4.37) only ships the experimental spelling — same shim
+    as parallel/collective.py."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map  # pragma: no cover
+
+    return shard_map
+
+
+def spatial_sharded_conv(
+    x: jnp.ndarray,
+    kernel: jnp.ndarray,
+    mesh,
+    data_axis: str = "data",
+    spatial_axis: str = "spatial",
+    mode: str = "reflect",
+) -> jnp.ndarray:
+    """One explicit-halo conv site: `sharded_conv` wrapped in shard_map
+    over (data, spatial), callable from INSIDE an already-jitted train
+    step (no jit wrapper here — the step owns the program). The kernel
+    stays replicated (P()); check_rep's default keeps the transpose
+    correct: the replicated kernel's cotangent is psum'd over the mesh,
+    so gradients match the XLA-SPMD path."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(data_axis, spatial_axis, None, None)
+
+    def fn(xs, k):
+        return sharded_conv(xs, k, spatial_axis, mode=mode)
+
+    return _shard_map()(
+        fn, mesh=mesh, in_specs=(spec, P()), out_specs=spec
+    )(x, kernel)
+
+
 def make_sharded_conv(plan, mode: str = "reflect"):
     """Wrap `sharded_conv` in shard_map over the plan's spatial axis,
     batch over its data axis — a standalone, jittable building block.
     Returns fn(x, kernel): x row-sharded NHWC, kernel replicated HWIO."""
     from jax.sharding import PartitionSpec as P
 
-    # Older jax (the image pins 0.4.37) only ships the experimental
-    # spelling — same shim as parallel/collective.py.
-    if hasattr(jax, "shard_map"):
-        shard_map = jax.shard_map
-    else:  # pragma: no cover - exercised on jax<0.5 images
-        from jax.experimental.shard_map import shard_map
-
+    shard_map = _shard_map()
     spec = P(plan.data_axis, plan.spatial_axis, None, None)
 
     def fn(x, k):

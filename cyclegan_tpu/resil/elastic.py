@@ -78,16 +78,12 @@ class ElasticTopologyError(RuntimeError):
 
 def _path_key(path) -> str:
     """Flatten a jax key path to 'a/b/c' (same scheme as
-    utils/checkpoint.py so specs line up with manifest/restore paths)."""
-    parts = []
-    for e in path:
-        for attr in ("name", "key", "idx"):
-            if hasattr(e, attr):
-                parts.append(str(getattr(e, attr)))
-                break
-        else:
-            parts.append(str(e))
-    return "/".join(parts)
+    utils/checkpoint.py so specs line up with manifest/restore paths).
+    Shared with the partition-rules table so rule patterns and manifest
+    keys name leaves identically."""
+    from cyclegan_tpu.parallel.mesh import tree_path_key
+
+    return tree_path_key(path)
 
 
 def leaf_sharding_specs(state) -> dict:
@@ -271,15 +267,27 @@ def reshard_to_plan(state, plan, template=None):
     exact failure checkpoint._rebuffer documents (intermittent glibc
     heap corruption, garbage in post-resume saves). Routing through an
     XLA computation yields a genuinely XLA-owned buffer with the same
-    sharding."""
+    sharding.
+
+    Placement: a CycleGANState resolves every leaf through the
+    partition-rules table (parallel/mesh.py:state_partition_rules — the
+    declarative layout registry; an unknown path raises with the path
+    named instead of silently landing replicated). Other pytrees (ad-hoc
+    test states) keep the template-sharding / replicated fallback."""
     import jax.numpy as jnp
 
-    from cyclegan_tpu.parallel.mesh import replicated
+    from cyclegan_tpu.parallel.mesh import replicated, state_shardings
+    from cyclegan_tpu.train.state import CycleGANState
 
     fallback = replicated(plan)
     t_leaves = None
-    if template is not None:
-        t_leaves = jax.tree_util.tree_leaves(template)
+    if isinstance(state, CycleGANState):
+        t_leaves = jax.tree_util.tree_leaves(state_shardings(plan, state))
+    elif template is not None:
+        t_leaves = [
+            getattr(leaf, "sharding", None)
+            for leaf in jax.tree_util.tree_leaves(template)
+        ]
 
     leaves, treedef = jax.tree_util.tree_flatten(state)
     out = []
@@ -289,7 +297,7 @@ def reshard_to_plan(state, plan, template=None):
             continue
         sharding = None
         if t_leaves is not None and i < len(t_leaves):
-            sharding = getattr(t_leaves[i], "sharding", None)
+            sharding = t_leaves[i]
         host = jax.device_get(leaf)  # sanctioned-fetch: restore-time gather, off the dispatch path by construction
         placed = jax.device_put(host, sharding or fallback)
         out.append(jnp.copy(placed))

@@ -48,16 +48,33 @@ def make_optimizer(config: Config) -> optax.GradientTransformation:
 
 
 def build_models(
-    config: Config,
+    config: Config, plan=None
 ) -> Tuple[ResNetGenerator, PatchGANDiscriminator]:
     """One generator module and one discriminator module definition.
 
     The same module definition is applied with two independent param trees
     (G/F and d_x/d_y) — the functional equivalent of the reference
     building four Keras models (main.py:128-131).
+
+    `plan` (parallel.mesh.MeshPlan) only matters under
+    `model.spatial_impl="halo"`: with a >1 spatial axis the stride-1 conv
+    sites bind the mesh and run explicit shard_map halo exchanges
+    (parallel/halo.py) instead of relying on XLA's SPMD partitioner.
+    Param trees are identical either way, so checkpoints interchange
+    across spatial_impl values and callers that never shard spatially
+    (inference, serving, single-device tests) simply omit the plan.
     """
     m = config.model
     dtype = jnp.bfloat16 if m.compute_dtype == "bfloat16" else None
+    halo_mesh = None
+    data_axis, spatial_axis = "data", "spatial"
+    if (
+        m.spatial_impl == "halo"
+        and plan is not None
+        and plan.n_spatial > 1
+    ):
+        halo_mesh = plan.mesh
+        data_axis, spatial_axis = plan.data_axis, plan.spatial_axis
     gen = ResNetGenerator(
         config=m.generator,
         out_channels=m.channels,
@@ -69,10 +86,16 @@ def build_models(
         pad_impl=m.pad_impl,
         trunk_impl=m.trunk_impl,
         upsample_impl=m.upsample_impl,
+        halo_mesh=halo_mesh,
+        data_axis=data_axis,
+        spatial_axis=spatial_axis,
     )
     disc = PatchGANDiscriminator(
         config=m.discriminator, dtype=dtype, norm_impl=m.instance_norm_impl,
         pad_impl=m.pad_impl if m.pad_impl == "epilogue" else "pad",
+        halo_mesh=halo_mesh,
+        data_axis=data_axis,
+        spatial_axis=spatial_axis,
     )
     return gen, disc
 

@@ -95,7 +95,9 @@ def _frozen_group(config: Config) -> bool:
     return transfer.freeze_active(config)
 
 
-def make_grad_fn(config: Config, global_batch_size: int) -> Callable:
+def make_grad_fn(
+    config: Config, global_batch_size: int, plan=None
+) -> Callable:
     """Build the fused gradient function for `config.train.grad_impl`.
 
     Returned fn: (g_params, f_params, dx_params, dy_params, x, y, w)
@@ -124,9 +126,9 @@ def make_grad_fn(config: Config, global_batch_size: int) -> Callable:
     structurally equal to an unfrozen run's (checkpoints interchange).
     """
     if config.train.grad_impl == "fusedprop":
-        fn = _make_fusedprop_grad_fn(config, global_batch_size)
+        fn = _make_fusedprop_grad_fn(config, global_batch_size, plan)
     else:
-        fn = _make_combined_grad_fn(config, global_batch_size)
+        fn = _make_combined_grad_fn(config, global_batch_size, plan)
 
     from cyclegan_tpu.domains import transfer
 
@@ -140,9 +142,11 @@ def make_grad_fn(config: Config, global_batch_size: int) -> Callable:
     return frozen_grad_fn
 
 
-def _make_combined_grad_fn(config: Config, global_batch_size: int) -> Callable:
+def _make_combined_grad_fn(
+    config: Config, global_batch_size: int, plan=None
+) -> Callable:
     """One combined scalar, one jax.grad (module docstring derivation)."""
-    gen, disc = build_models(config)
+    gen, disc = build_models(config, plan)
     lam_c = config.loss.lambda_cycle
     lam_i = config.loss.lambda_identity
     with_health = config.obs.health
@@ -210,7 +214,9 @@ def _make_combined_grad_fn(config: Config, global_batch_size: int) -> Callable:
     return jax.grad(combined_loss, argnums=(0, 1, 2, 3), has_aux=True)
 
 
-def _make_fusedprop_grad_fn(config: Config, global_batch_size: int) -> Callable:
+def _make_fusedprop_grad_fn(
+    config: Config, global_batch_size: int, plan=None
+) -> Callable:
     """FusedProp (arXiv:2004.03335): shared-forward G/D gradients.
 
     Each discriminator forward appears ONCE per fake and once per real;
@@ -219,7 +225,7 @@ def _make_fusedprop_grad_fn(config: Config, global_batch_size: int) -> Callable:
     `_make_combined_grad_fn` — same gradients to f32 tolerance, same
     metric keys, same linear `_health/` moments (module docstring).
     """
-    gen, disc = build_models(config)
+    gen, disc = build_models(config, plan)
     lam_c = config.loss.lambda_cycle
     lam_i = config.loss.lambda_identity
     with_health = config.obs.health
@@ -365,16 +371,17 @@ def make_update_fn(config: Config) -> Callable:
 
 
 def make_train_step(
-    config: Config, global_batch_size: int
+    config: Config, global_batch_size: int, plan=None
 ) -> Callable[[CycleGANState, jnp.ndarray, jnp.ndarray, jnp.ndarray], Tuple[CycleGANState, Metrics]]:
     """Build the fused global-semantics train step.
 
     Returned fn: (state, x, y, weights) -> (new_state, metrics). Written
     over the GLOBAL batch: under a batch-sharded jit, XLA inserts the
     gradient all-reduces (parallel/dp.py); under shard_map the explicit
-    psum variant lives in parallel/collective.py.
+    psum variant lives in parallel/collective.py. `plan` is forwarded to
+    build_models for the spatial_impl="halo" conv sites.
     """
-    grad_fn = make_grad_fn(config, global_batch_size)
+    grad_fn = make_grad_fn(config, global_batch_size, plan)
     update = make_update_fn(config)
     with_health = config.obs.health
     frozen_group = _frozen_group(config)
@@ -400,7 +407,7 @@ def make_train_step(
 
 
 def make_accum_train_step(
-    config: Config, global_batch_size: int, accum_steps: int
+    config: Config, global_batch_size: int, accum_steps: int, plan=None
 ) -> Callable:
     """Gradient-accumulation train step: ONE optimizer update from
     `accum_steps` microbatches, exactly equal to the single-big-batch
@@ -422,7 +429,7 @@ def make_accum_train_step(
     (xs: [K, micro, H, W, C]) -> (state, metrics) where metrics are the
     exact full-batch scalars.
     """
-    grad_fn = make_grad_fn(config, global_batch_size)
+    grad_fn = make_grad_fn(config, global_batch_size, plan)
     update = make_update_fn(config)
     with_health = config.obs.health
     frozen_group = _frozen_group(config)
@@ -466,10 +473,10 @@ def make_accum_train_step(
     return accum_step
 
 
-def make_cycle_step(config: Config):
+def make_cycle_step(config: Config, plan=None):
     """x -> G -> fake_y -> F -> cycle_x; y -> F -> fake_x -> G -> cycle_y
     (reference main.py:197-205)."""
-    gen, _ = build_models(config)
+    gen, _ = build_models(config, plan)
 
     def cycle_step(state: CycleGANState, x: jnp.ndarray, y: jnp.ndarray):
         fake_y = gen.apply(state.g_params, x)
@@ -481,11 +488,11 @@ def make_cycle_step(config: Config):
     return cycle_step
 
 
-def make_test_step(config: Config, global_batch_size: int):
+def make_test_step(config: Config, global_batch_size: int, plan=None):
     """Eval step: all training losses without gradients, plus the four
     cycle/identity MAE error metrics (reference main.py:275-323)."""
-    gen, disc = build_models(config)
-    cycle_step = make_cycle_step(config)
+    gen, disc = build_models(config, plan)
+    cycle_step = make_cycle_step(config, plan)
     lam_c = config.loss.lambda_cycle
     lam_i = config.loss.lambda_identity
     gbs = float(global_batch_size)

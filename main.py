@@ -121,6 +121,7 @@ def main(args: argparse.Namespace) -> None:
             image_size=args.image_size,
             trunk_impl=args.trunk_impl,
             upsample_impl=args.upsample_impl,
+            spatial_impl=args.spatial_impl,
         ),
         data=data_cfg,
         parallel=ParallelConfig(spatial_parallelism=args.spatial_parallelism),
@@ -320,11 +321,11 @@ def main(args: argparse.Namespace) -> None:
         train_step = shard_accum_train_step(
             plan,
             make_accum_train_step(
-                config, global_batch_size, config.train.grad_accum
+                config, global_batch_size, config.train.grad_accum, plan
             ),
         )
     else:
-        step = make_train_step(config, global_batch_size)
+        step = make_train_step(config, global_batch_size, plan)
         train_step = shard_train_step(plan, step)
         if config.train.steps_per_dispatch > 1:
             from cyclegan_tpu.parallel.dp import shard_multi_train_step
@@ -334,7 +335,9 @@ def main(args: argparse.Namespace) -> None:
             multi_step = shard_multi_train_step(
                 plan, step, config.train.steps_per_dispatch
             )
-    test_step = shard_test_step(plan, make_test_step(config, eval_batch_size))
+    test_step = shard_test_step(
+        plan, make_test_step(config, eval_batch_size, plan)
+    )
     cycle_step = jax.jit(make_cycle_step(config))
 
     # Periodic FID (the north-star quality metric — BASELINE.md; the
@@ -760,6 +763,14 @@ if __name__ == "__main__":
                              "epilogue)")
     parser.add_argument("--spatial_parallelism", default=1, type=int,
                         help="shard the image H axis over this many mesh columns")
+    parser.add_argument("--spatial_impl", default="xla",
+                        choices=["xla", "halo"],
+                        help="spatial conv sharding: 'xla' leaves halo "
+                             "choreography to the partitioner; 'halo' runs "
+                             "stride-1 convs in shard_map with explicit "
+                             "ppermute boundary-row exchanges "
+                             "(parallel/halo.py) — same params, same "
+                             "gradients to 1e-5, fewer spatial-axis bytes")
     parser.add_argument("--grad_accum", default=1, type=int, metavar="A",
                         help="gradient accumulation: one optimizer update "
                              "from A microbatches — effective global batch "

@@ -130,18 +130,25 @@ def predict(
     }
 
 
-def load_census_bytes(path: str) -> dict:
-    """Per-step data-axis collective bytes from a comms-census
-    artifact: a JSON file holding one census payload, or a JSONL
-    telemetry stream (the LAST `comms_census` event wins). Prefers the
-    measured (parsed-from-HLO) bytes; falls back to the analytic
-    ledger for census runs without HLO text."""
+def load_census_bytes(path: str, impl: str = "xla") -> dict:
+    """Per-step gradient-reduction collective bytes from a comms-census
+    artifact: a JSON file holding one census payload (possibly the
+    `--spatial_impl both` wrapper with an `impls` map — `impl` picks
+    which program), or a JSONL telemetry stream (the LAST
+    `comms_census` event wins). Prefers the measured (parsed-from-HLO)
+    bytes; falls back to the analytic ledger for census runs without
+    HLO text. For halo programs the payload is data-axis + mesh-wide
+    bytes: check_rep's kernel psums ride the same links the data
+    all-reduce does."""
     payload = None
     with open(path, "r", encoding="utf-8") as f:
         text = f.read().strip()
     try:
         doc = json.loads(text)
         if isinstance(doc, dict):
+            if "impls" in doc:
+                doc = doc["impls"].get(impl) or next(
+                    iter(doc["impls"].values()))
             payload = doc if "analytic" in doc else None
     except ValueError:
         doc = None
@@ -165,17 +172,64 @@ def load_census_bytes(path: str) -> dict:
         # actually compiled it — the right payload for the v4-32
         # question even though the gated census ran the smoke config.
         d_bytes, source = int(full["data"]["bytes"]), "measured-full-size"
+        d_bytes += int(full.get("other", {}).get("bytes", 0))
     elif measured.get("data", {}).get("bytes"):
         d_bytes, source = int(measured["data"]["bytes"]), "measured"
+        d_bytes += int(measured.get("other", {}).get("bytes", 0))
     else:
-        d_bytes = int(payload["analytic"]["data_bytes"])
+        d_bytes = int(payload["analytic"]["data_bytes"]
+                      + payload["analytic"].get("mesh_bytes", 0))
         source = "analytic"
     return {
         "bytes_per_step": d_bytes,
         "source": source,
+        "spatial_impl": payload.get("analytic", {}).get(
+            "spatial_impl", "xla"),
         "mesh": payload.get("mesh", {}),
         "max_recon_error": payload.get("max_recon_error"),
     }
+
+
+def load_measured_efficiency(spec: str) -> dict:
+    """A measured weak-scaling efficiency: either a bare float
+    ('0.973') or a path to a bench_scaling.py / MULTICHIP round
+    artifact — the LAST well-formed weak_scaling_efficiency JSON line
+    wins (MULTICHIP_r*.json stores the run tail under 'tail')."""
+    try:
+        return {"value": float(spec), "source": "literal"}
+    except ValueError:
+        pass
+    with open(spec, "r", encoding="utf-8") as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "tail" in doc:
+        text = doc["tail"] if isinstance(doc["tail"], str) else "\n".join(
+            str(t) for t in doc["tail"])
+    found = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or '"weak_scaling_efficiency"' not in line:
+            continue
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            continue
+        if (isinstance(ev, dict)
+                and ev.get("metric") == "weak_scaling_efficiency"):
+            found = ev
+    if found is None and isinstance(doc, dict) and (
+            doc.get("metric") == "weak_scaling_efficiency"):
+        found = doc
+    if found is None:
+        raise SystemExit(f"no weak_scaling_efficiency line in {spec}")
+    out = {"value": float(found["value"]), "source": spec}
+    for k in ("images_per_sec", "measured_devices", "spatial_impl", "mode"):
+        if k in found:
+            out[k] = found[k]
+    return out
 
 
 def main() -> None:
@@ -200,6 +254,13 @@ def main() -> None:
                    help="comms-census artifact (JSON payload or JSONL "
                         "stream): re-predict with the compiled ledger's "
                         "data-axis bytes beside the closed-form estimate")
+    p.add_argument("--census_impl", default="xla", choices=["xla", "halo"],
+                   help="which program to read from a --spatial_impl both "
+                        "census wrapper")
+    p.add_argument("--measured", default=None, metavar="EFF_OR_PATH",
+                   help="measured weak-scaling efficiency (bare float, or "
+                        "a bench_scaling/MULTICHIP artifact path): emit "
+                        "the predicted-vs-measured delta")
     args = p.parse_args()
 
     out = predict(args.devices, args.batch, args.chip,
@@ -223,7 +284,7 @@ def main() -> None:
     }
     line.update(out)
     if args.from_census:
-        census = load_census_bytes(args.from_census)
+        census = load_census_bytes(args.from_census, impl=args.census_impl)
         cen_out = predict(args.devices, args.batch, args.chip,
                           link_gbps=args.link_gbps, ips_1chip=args.ips,
                           bytes_per_step=census["bytes_per_step"])
@@ -242,9 +303,24 @@ def main() -> None:
             "grad_bytes_per_step": cen_out["grad_bytes_per_step"],
             "t_comm_ms_no_overlap": cen_out["t_comm_ms_no_overlap"],
             "source": census["source"],
+            "spatial_impl": census.get("spatial_impl", "xla"),
             "census_mesh": census["mesh"],
             "census_max_recon_error": census["max_recon_error"],
         }
+    if args.measured:
+        meas = load_measured_efficiency(args.measured)
+        predicted = line.get("from_census", {}).get(
+            "predicted_efficiency", out["predicted_efficiency"])
+        delta = meas["value"] - predicted
+        print(
+            f"[scaling_model] measured {meas['value'] * 100:.1f}% vs "
+            f"predicted {predicted * 100:.1f}% => delta "
+            f"{delta * 100:+.1f} points ({meas['source']})",
+            file=sys.stderr,
+            flush=True,
+        )
+        line["measured"] = meas
+        line["measured_vs_predicted_delta"] = round(delta, 4)
     print(json.dumps(line), flush=True)
 
 

@@ -13,6 +13,56 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_parse_grid():
+    sys.path.insert(0, REPO)
+    from bench_scaling import _parse_grid
+
+    assert _parse_grid("8x1,4x2,2x4") == [(8, 1), (4, 2), (2, 4)]
+    assert _parse_grid("8") == [(8, 1)]  # bare dp: spatial defaults to 1
+
+
+def test_hbm_ledger_divides_by_spatial():
+    sys.path.insert(0, REPO)
+    from bench_scaling import hbm_ledger
+
+    # b1/1024^2 matches the b4/512^2 anchor's activation volume exactly.
+    flat = hbm_ledger(1024, 1, 1, remat=True)
+    assert flat["predicted_temp_gb"] == 10.75
+    sharded = hbm_ledger(1024, 1, 4, remat=True)
+    assert sharded["predicted_temp_gb"] == pytest.approx(10.75 / 4, abs=0.01)
+    assert sharded["fits"]
+    # Holding the 512^2 record's per-shard batch does NOT fit unsharded.
+    assert not hbm_ledger(1024, 4, 1, remat=True)["fits"]
+
+
+def test_grid_emit_efficiency_and_ledger(capsys):
+    sys.path.insert(0, REPO)
+    import argparse
+
+    from bench_scaling import _emit
+
+    args = argparse.Namespace(
+        grid="8x1,4x2", batch=1, image=1024, spatial_impl="halo",
+        remat=True, accum=2)
+    # Equal-n cells: efficiency isolates the spatial-sharding overhead
+    # (per-device ips of the LAST-measured max-n cell / first min-n).
+    _emit({(8, 1): 80.0, (4, 2): 72.0}, 8, args)
+    d = json.loads(capsys.readouterr().out.strip())
+    assert d["mode"] == "grid"
+    assert d["value"] == pytest.approx(0.9)
+    assert d["images_per_sec"] == {"8x1": 80.0, "4x2": 72.0}
+    # Ledger reflects the most-sharded measured cell (spatial=2 here).
+    assert d["hbm_ledger"]["predicted_temp_gb"] == pytest.approx(
+        10.75 / 2, abs=0.01)
+    # Zero completed cells: the ledger falls back to the ATTEMPTED grid
+    # instead of silently reporting the unsharded footprint.
+    _emit({}, 8, args)
+    d = json.loads(capsys.readouterr().out.strip())
+    assert d["error"] == "no mesh size completed"
+    assert d["hbm_ledger"]["predicted_temp_gb"] == pytest.approx(
+        10.75 / 2, abs=0.01)
+
+
 @pytest.mark.slow
 def test_scaling_harness_emits_json():
     env = dict(os.environ)

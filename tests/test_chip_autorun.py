@@ -60,6 +60,7 @@ def test_queue_order_and_budgets():
                      "bench_cold", "bench_warm",
                      "pad_sweep", "epilogue_sweep", "grad_sweep",
                      "upsample_sweep", "accum512", "scan512",
+                     "spatial_sweep", "spatial_1024",
                      "serve_sweep", "serve_trace", "trace",
                      "chaos_drill", "timed_main"]
     by = {s.name: s for s in q}
@@ -75,6 +76,17 @@ def test_queue_order_and_budgets():
     assert by["comms_census"].always_run
     assert by["comms_census"].env.get("JAX_PLATFORMS") == "cpu"
     assert by["comms_census"].stdout_to.endswith("comms_census.json")
+    # the census gates BOTH conv shardings so the spatial sweeps below
+    # never run a halo program the ledger can't account for
+    assert "both" in by["comms_census"].argv
+    # dp x spatial sweep + the 1024^2 cell: halo impl, one JSON line each
+    for name in ("spatial_sweep", "spatial_1024"):
+        argv = by[name].argv
+        assert "bench_scaling.py" in argv[1]
+        assert argv[argv.index("--spatial_impl") + 1] == "halo"
+        assert by[name].stdout_to.endswith("_onchip.json")
+    assert "--grid" in by["spatial_1024"].argv
+    assert "--remat" in by["spatial_1024"].argv
     # cold run gets the cache-warming budget; warm run is the record
     assert float(by["bench_cold"].env["BENCH_TIME_BUDGET_S"]) > float(
         by["bench_warm"].env["BENCH_TIME_BUDGET_S"])

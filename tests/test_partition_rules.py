@@ -1,0 +1,131 @@
+"""The partition-rules table (parallel/mesh.py): the declarative layout
+registry every sharding decision routes through.
+
+Contract pinned here: every leaf path of a REAL model state resolves to
+exactly ONE rule (the table is complete AND disjoint), activation names
+resolve to the specs dp.py ships, and unknown paths fail at construction
+with the path named — layout gaps must never silently land replicated.
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from cyclegan_tpu.config import ParallelConfig
+from cyclegan_tpu.parallel import make_mesh_plan
+from cyclegan_tpu.parallel.mesh import (
+    activation_partition_rules,
+    activation_spec,
+    match_partition_rules,
+    state_partition_rules,
+    state_shardings,
+    tree_path_key,
+)
+from cyclegan_tpu.train import create_state
+
+
+@pytest.fixture(scope="module")
+def spatial_plan():
+    return make_mesh_plan(ParallelConfig(spatial_parallelism=2), jax.devices())
+
+
+@pytest.fixture(scope="module")
+def tiny_state(tiny_config):
+    return create_state(tiny_config, jax.random.PRNGKey(0))
+
+
+def _matching_rules(rules, path):
+    return [name for name, pat, _ in rules if re.search(pat, path)]
+
+
+def test_every_state_path_matches_exactly_one_rule(spatial_plan, tiny_state):
+    rules = state_partition_rules(spatial_plan)
+    flat = jax.tree_util.tree_flatten_with_path(tiny_state)[0]
+    assert len(flat) > 100  # a real model, not a stub tree
+    for path, _ in flat:
+        key = tree_path_key(path)
+        hits = _matching_rules(rules, key)
+        assert len(hits) == 1, f"{key!r} matched {hits}"
+
+
+def test_scanned_trunk_paths_resolve(tiny_config, spatial_plan):
+    """The scan_blocks=True layout (stacked leaves under ScannedTrunk)
+    must resolve through the same table."""
+    import dataclasses
+
+    cfg = tiny_config.replace(
+        model=dataclasses.replace(tiny_config.model, scan_blocks=True)
+    )
+    state = create_state(cfg, jax.random.PRNGKey(0))
+    rules = state_partition_rules(spatial_plan)
+    for path, _ in jax.tree_util.tree_flatten_with_path(state)[0]:
+        key = tree_path_key(path)
+        hits = _matching_rules(rules, key)
+        assert len(hits) == 1, f"{key!r} matched {hits}"
+
+
+def test_activation_names_resolve_to_dp_specs(spatial_plan):
+    assert activation_spec(spatial_plan, "x") == P("data", "spatial", None, None)
+    assert activation_spec(spatial_plan, "weights") == P("data")
+    assert activation_spec(spatial_plan, "xs") == P(
+        None, "data", "spatial", None, None
+    )
+    assert activation_spec(spatial_plan, "ws") == P(None, "data")
+
+    dp_plan = make_mesh_plan(ParallelConfig(), jax.devices())
+    assert activation_spec(dp_plan, "x") == P("data")
+    assert activation_spec(dp_plan, "xs") == P(None, "data")
+
+
+def test_unknown_path_fails_naming_it(spatial_plan):
+    with pytest.raises(ValueError, match="fc_head/lora_A"):
+        match_partition_rules(
+            state_partition_rules(spatial_plan), "fc_head/lora_A"
+        )
+    with pytest.raises(ValueError, match="latents"):
+        activation_spec(spatial_plan, "latents")
+
+
+def test_state_shardings_tree(spatial_plan, tiny_state):
+    shardings = state_shardings(spatial_plan, tiny_state)
+    flat_state = jax.tree_util.tree_leaves(tiny_state)
+    flat_shard = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: hasattr(x, "spec")
+    )
+    assert len(flat_state) == len(flat_shard)
+    for s in flat_shard:
+        assert s.spec == P()  # the model's layout: replicated state
+
+    # and the placements are usable: a device_put through the table
+    # round-trips the state numerically
+    placed = jax.device_put(tiny_state, shardings)
+    np.testing.assert_allclose(
+        np.asarray(jax.tree_util.tree_leaves(placed)[1]),
+        np.asarray(flat_state[1]),
+    )
+
+
+def test_reshard_to_plan_uses_rules(spatial_plan, tiny_state):
+    """elastic.reshard_to_plan routes CycleGANState placement through
+    the table (no template needed) and yields donation-safe buffers."""
+    from cyclegan_tpu.resil.elastic import reshard_to_plan
+
+    out = reshard_to_plan(tiny_state, spatial_plan)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(tiny_state)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        assert a.sharding.spec == P()
+
+
+def test_activation_rules_cover_only_known_names(spatial_plan):
+    names = [n for n, _, _ in activation_partition_rules(spatial_plan)]
+    assert names == [
+        "image_batch",
+        "sample_weights",
+        "stacked_image_batch",
+        "stacked_sample_weights",
+    ]

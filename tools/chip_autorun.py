@@ -176,8 +176,12 @@ def build_queue(mode: str, round_tag: str = ROUND_TAG) -> list:
         # silently resharding where the model says halo, or a gradient
         # tree dropping out of the all-reduce — fails reconciliation
         # here and aborts the queue BEFORE any chip time burns on it.
+        # Gates BOTH conv shardings (xla partitioner halos + explicit
+        # shard_map halo exchanges) so the spatial_sweep below never
+        # runs a halo program the ledger can't account for.
         Step("comms_census",
-             [py, "tools/comms_census.py", "--devices", "8"], 1800.0,
+             [py, "tools/comms_census.py", "--devices", "8",
+              "--spatial_impl", "both"], 1800.0,
              env={**env, "JAX_PLATFORMS": "cpu"},
              abort_queue_on_fail=True, always_run=True,
              stdout_to=os.path.join(
@@ -244,6 +248,36 @@ def build_queue(mode: str, round_tag: str = ROUND_TAG) -> list:
         Step("scan512",
              [py, "tools/chip_sweep.py", "scan:b4k2i512",
               "scan:b4k2zeroi512"], 3600.0, env=env, artifacts=[sweeps]),
+        # dp x spatial weak-scaling sweep (ISSUE 18): bench_scaling in
+        # grid mode over the (data x spatial) factorizations of the
+        # 8-device mesh at the headline geometry, explicit-halo conv
+        # sharding. One JSON line with img/s per grid cell plus the
+        # measured weak-scaling efficiency — the number
+        # scaling_model.py --measured diffs against the analytic ~99%
+        # prediction, and run_compare gates in absolute points
+        # (--max_scaling_efficiency_drop). comms_census above has
+        # already certified the halo program's collectives by the time
+        # this runs.
+        Step("spatial_sweep",
+             [py, "bench_scaling.py", "--grid", "8x1,4x2,2x4",
+              "--batch", "4", "--iters", "20", "--spatial_impl", "halo"],
+             3600.0, env={**env, "BENCH_TIME_BUDGET_S": "3000"},
+             stdout_to=os.path.join(
+                 "docs", f"scaling_{round_tag}_onchip.json")),
+        # The first 1024^2 cell: spatial=4 shrinks per-device
+        # activation temps 4x, remat + accum shrink the rest — the
+        # HBM ledger (bench_scaling.hbm_ledger, anchored on the
+        # compiler-measured 512^2/256^2 temps in docs/BENCHMARKS.md)
+        # predicts ~4.3 GB of 15.75 GB usable. bench_scaling preflights
+        # that ledger per cell and skips a predicted non-fit instead of
+        # OOMing the relay window.
+        Step("spatial_1024",
+             [py, "bench_scaling.py", "--grid", "2x4", "--image", "1024",
+              "--batch", "1", "--accum", "2", "--remat", "--iters", "4",
+              "--spatial_impl", "halo"], 3600.0,
+             env={**env, "BENCH_TIME_BUDGET_S": "3000"},
+             stdout_to=os.path.join(
+                 "docs", f"scaling1024_{round_tag}_onchip.json")),
         # Serving open-loop sweep on chip (ROADMAP serving item): the
         # bench_serve contract — serial baseline, saturated pipeline,
         # offered-load curve, fleet/int8 tiers, trace_overhead — lands

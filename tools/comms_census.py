@@ -20,8 +20,15 @@ its measured (parsed-from-HLO) per-axis bytes as an advisory section.
   python tools/comms_census.py --devices 8             # gate, 4x2 mesh
   python tools/comms_census.py --devices 8 --full      # + advisory 256^2
   python tools/comms_census.py --devices 8 --out docs/comms_census.json
+  python tools/comms_census.py --devices 8 --spatial_impl both  # gate xla+halo
 
-Prints ONE JSON line (the census payload) to stdout; progress to
+`--spatial_impl` picks which conv sharding the gated program uses
+(`xla` partitioner halos, `halo` explicit shard_map exchanges, or
+`both` to gate the two programs in one run — the halo ledger adds the
+mesh-wide kernel-psum axis; see obs/comms.py).
+
+Prints ONE JSON line (the census payload; for `both`, a wrapper with
+an `impls` map and the AND of the verdicts) to stdout; progress to
 stderr. Forces CPU host devices — the census reads the compiled
 program's text, it never needs the chip.
 """
@@ -29,6 +36,7 @@ program's text, it never needs the chip.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -43,6 +51,9 @@ def main() -> int:
     p.add_argument("--spatial", default=None, type=int,
                    help="spatial axis size (default: 2 when --devices "
                         "is even, matching dryrun_multichip)")
+    p.add_argument("--spatial_impl", default="xla",
+                   choices=("xla", "halo", "both"),
+                   help="conv sharding impl(s) to gate (default: xla)")
     p.add_argument("--full", action="store_true",
                    help="also compile the full-size (256^2, scanned "
                         "trunk) program and attach its measured "
@@ -84,7 +95,7 @@ def main() -> int:
         s = cfg.model.image_size
         state = jax.eval_shape(
             lambda: create_state(cfg, jax.random.PRNGKey(0)))
-        step = shard_train_step(plan, make_train_step(cfg, gb))
+        step = shard_train_step(plan, make_train_step(cfg, gb, plan))
         img = jax.ShapeDtypeStruct((gb, s, s, 3), np.float32)
         w = jax.ShapeDtypeStruct((gb,), np.float32)
         return state, step.lower(state, img, img, w).compile()
@@ -100,18 +111,39 @@ def main() -> int:
         spatial = 2 if args.devices % 2 == 0 and args.devices > 1 else 1
     par = ParallelConfig(spatial_parallelism=spatial)
     plan = make_mesh_plan(par, devices)
-    cfg = tiny_test_config().replace(parallel=par)
-    gb = plan.n_data * cfg.train.batch_size
-    s = cfg.model.image_size
-    print(f"[comms_census] compiling mesh {plan.n_data}x{plan.n_spatial}, "
-          f"{s}^2, global batch {gb} ...", file=sys.stderr, flush=True)
-    state, compiled = compile_step(cfg, plan, gb)
-    census = build_census(plan, cfg, gb, state,
-                          hlo_text=compiled.as_text(),
-                          link_gbps=args.link_gbps)
+    impls = (("xla", "halo") if args.spatial_impl == "both"
+             else (args.spatial_impl,))
+    per_impl = {}
+    for impl in impls:
+        cfg = tiny_test_config().replace(parallel=par)
+        cfg = cfg.replace(
+            model=dataclasses.replace(cfg.model, spatial_impl=impl))
+        gb = plan.n_data * cfg.train.batch_size
+        s = cfg.model.image_size
+        print(f"[comms_census] compiling mesh "
+              f"{plan.n_data}x{plan.n_spatial}, {s}^2, global batch {gb}, "
+              f"spatial_impl={impl} ...", file=sys.stderr, flush=True)
+        state, compiled = compile_step(cfg, plan, gb)
+        per_impl[impl] = build_census(plan, cfg, gb, state,
+                                      hlo_text=compiled.as_text(),
+                                      link_gbps=args.link_gbps)
+    if len(impls) == 1:
+        census = per_impl[impls[0]]
+    else:
+        census = {
+            "schema": 1,
+            "spatial_impl": "both",
+            "impls": per_impl,
+            "tolerance": per_impl["xla"]["tolerance"],
+            "max_recon_error": max(
+                c.get("max_recon_error", 0.0) for c in per_impl.values()),
+            "ok": all(c.get("ok", False) for c in per_impl.values()),
+        }
     if args.full:
         batch = -(-8 // plan.n_data)  # ceil: global batch >= 8
         cfg_full = Config(
+            # advisory section stays on the xla impl: the scanned trunk
+            # is outside the analytic model's validity domain either way
             model=ModelConfig(image_size=256, scan_blocks=True),
             parallel=par,
             train=TrainConfig(batch_size=batch),
@@ -130,12 +162,13 @@ def main() -> int:
                 compiled_full.as_text(), plan.n_data,
                 plan.n_spatial)["axes"],
         }
-    for ax, v in census.get("reconciliation", {}).items():
-        print(f"[comms_census] {ax}: analytic "
-              f"{v['analytic_bytes'] / 1e6:.2f} MB vs measured "
-              f"{v['measured_bytes'] / 1e6:.2f} MB over "
-              f"{v['measured_ops']} ops (err {v['error'] * 100:.1f}%)",
-              file=sys.stderr, flush=True)
+    for impl, c in per_impl.items():
+        for ax, v in c.get("reconciliation", {}).items():
+            print(f"[comms_census] {impl}/{ax}: analytic "
+                  f"{v['analytic_bytes'] / 1e6:.2f} MB vs measured "
+                  f"{v['measured_bytes'] / 1e6:.2f} MB over "
+                  f"{v['measured_ops']} ops (err {v['error'] * 100:.1f}%)",
+                  file=sys.stderr, flush=True)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
             json.dump(census, f, indent=2, sort_keys=True)

@@ -117,6 +117,8 @@ def load_profile(path: str) -> dict:
             else obj
         if str(parsed.get("metric", "")).startswith("cyclegan_serve"):
             return serve_profile(obj, name=os.path.basename(path))
+        if str(parsed.get("metric", "")).startswith("weak_scaling"):
+            return scaling_profile(obj, name=os.path.basename(path))
         return bench_profile(obj, name=os.path.basename(path))
     events = []
     skipped = 0
@@ -151,6 +153,26 @@ def bench_profile(record: dict, name: str = "?") -> dict:
         "all": {
             str(k): fv
             for k, v in (parsed.get("all") or {}).items()
+            if (fv := _float(v)) is not None
+        },
+    }
+
+
+def scaling_profile(record: dict, name: str = "?") -> dict:
+    """Profile of one bench_scaling.py weak-scaling record (plain
+    doubling scan or dp x spatial grid mode)."""
+    parsed = record.get("parsed") if isinstance(record.get("parsed"), dict) \
+        else record
+    return {
+        "kind": "scaling",
+        "name": name,
+        "value": _float(parsed.get("value")),
+        "mode": parsed.get("mode") or "scan",
+        "spatial_impl": parsed.get("spatial_impl"),
+        "measured_devices": parsed.get("measured_devices"),
+        "ips": {
+            str(k): fv
+            for k, v in (parsed.get("images_per_sec") or {}).items()
             if (fv := _float(v)) is not None
         },
     }
@@ -406,6 +428,8 @@ def compare_profiles(base: dict, cand: dict, th: argparse.Namespace) -> List[Che
         return _compare_bench(base, cand, th)
     if base["kind"] == "serve":
         return _compare_serve(base, cand, th)
+    if base["kind"] == "scaling":
+        return _compare_scaling(base, cand, th)
     return _compare_streams(base, cand, th)
 
 
@@ -441,6 +465,43 @@ def _compare_bench(base: dict, cand: dict, th) -> List[Check]:
                        + ", ".join(only_base)))
     if not checks:
         checks.append((SKIP, "bench", "no comparable values in either record"))
+    return checks
+
+
+def _compare_scaling(base: dict, cand: dict, th) -> List[Check]:
+    """Weak-scaling gate: efficiency is a fraction of ideal, so the
+    budget is ABSOLUTE points (a 0.97 -> 0.91 regression is 6 points
+    of lost scaling, not a 6% throughput story); per-mesh img/s cells
+    ride the relative --max_bench_drop budget."""
+    checks: List[Check] = []
+    bv, cv = base.get("value"), cand.get("value")
+    if bv is not None and cv is not None:
+        if base.get("mode") != cand.get("mode"):
+            checks.append((INFO, "scaling mode",
+                           f"{base.get('mode')} -> {cand.get('mode')}: "
+                           "efficiency definitions differ"))
+        drop = bv - cv
+        status = FAIL if drop > th.max_scaling_efficiency_drop else PASS
+        checks.append((status, "scaling efficiency",
+                       f"{bv:.4f} -> {cv:.4f} (drop {100 * drop:.1f} points "
+                       f"vs limit "
+                       f"{100 * th.max_scaling_efficiency_drop:.1f})"))
+    common = sorted(set(base["ips"]) & set(cand["ips"]))
+    for key in common:
+        bi, ci = base["ips"][key], cand["ips"][key]
+        drop = _rel_drop(bi, ci)
+        status = FAIL if drop > th.max_bench_drop else PASS
+        checks.append((status, f"scaling {key}",
+                       f"{bi:.2f} -> {ci:.2f} img/s "
+                       f"(drop {100 * drop:.1f}%)"))
+    only_base = sorted(set(base["ips"]) - set(cand["ips"]))
+    if only_base:
+        checks.append((INFO, "scaling cells",
+                       f"{len(only_base)} mesh size(s) not re-measured: "
+                       + ", ".join(only_base)))
+    if not checks:
+        checks.append((SKIP, "scaling",
+                       "no comparable values in either record"))
     return checks
 
 
@@ -886,6 +947,7 @@ def make_thresholds(
     max_trace_overhead: float = 0.03,
     max_goodput_drop: float = 0.05,
     max_int8_fused_drift: float = 0.05,
+    max_scaling_efficiency_drop: float = 0.05,
     json: bool = False,
 ) -> argparse.Namespace:
     """Programmatic threshold bundle (bench.py's end-of-run hook)."""
@@ -901,6 +963,7 @@ def make_thresholds(
         max_trace_overhead=max_trace_overhead,
         max_goodput_drop=max_goodput_drop,
         max_int8_fused_drift=max_int8_fused_drift,
+        max_scaling_efficiency_drop=max_scaling_efficiency_drop,
         json=json,
     )
 
@@ -944,6 +1007,11 @@ def main(argv=None) -> int:
                         help="max absolute drop of the seconds-weighted "
                              "goodput fraction (obs/goodput.py ledger) "
                              "vs base")
+    parser.add_argument("--max_scaling_efficiency_drop", default=0.05,
+                        type=float,
+                        help="max ABSOLUTE drop (in fraction points) of "
+                             "the weak-scaling efficiency between two "
+                             "bench_scaling records")
     parser.add_argument("--max_transfer_epoch_frac", default=0.25, type=float,
                         help="max epochs a transfer-onboarded fine-tune may "
                              "run, as a fraction of its parent's from-scratch "
