@@ -106,10 +106,14 @@ def _build_config(args, spatial: int):
     )
 
 
-def measure(n_devices: int, args, spatial: int = 1) -> float:
-    """images/sec on the first n_devices devices arranged as an
-    (n_devices/spatial) x spatial mesh, scan-mode (or accum-mode when
-    --accum > 1). Per-DATA-SHARD batch is held fixed."""
+def measure(n_devices: int, args, spatial: int = 1):
+    """(images/sec, timed-loop seconds) on the first n_devices devices
+    arranged as an (n_devices/spatial) x spatial mesh, scan-mode (or
+    accum-mode when --accum > 1). Per-DATA-SHARD batch is held fixed.
+    The second element is the fenced measurement-loop wall — the
+    per-cell timing the straggler observatory compares across grid
+    cells (same device count, different mesh shape => same ideal
+    step time)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -167,7 +171,7 @@ def measure(n_devices: int, args, spatial: int = 1) -> float:
         state, m = step(state, xs, ys, wts)
     fence(m)
     dt = time.perf_counter() - t0
-    return 2 * global_batch * k * args.iters / dt
+    return 2 * global_batch * k * args.iters / dt, dt
 
 
 def _parse_grid(spec: str):
@@ -179,7 +183,7 @@ def _parse_grid(spec: str):
     return cells
 
 
-def _emit(results, n_all, args) -> None:
+def _emit(results, n_all, args, cell_timing=None) -> None:
     results = dict(results)
     grid = bool(args.grid)
     if grid:
@@ -222,6 +226,21 @@ def _emit(results, n_all, args) -> None:
         line["spatial_impl"] = args.spatial_impl
         line["remat"] = bool(args.remat)
         line["accum"] = args.accum
+        if cell_timing:
+            # Per-cell timing for the straggler observatory: whole-cell
+            # wall (compile included) and the fenced per-iteration step
+            # time — cells with the same device count share an ideal
+            # step time, so the slowest cell names the straggling mesh
+            # shape, not just a slower efficiency number.
+            line["cell_wall_s"] = {
+                f"{dp}x{sp}": round(w, 3)
+                for (dp, sp), (w, _) in cell_timing.items()}
+            line["cell_step_s"] = {
+                f"{dp}x{sp}": round(dt / max(1, args.iters), 4)
+                for (dp, sp), (_, dt) in cell_timing.items()}
+            slowest = max(cell_timing,
+                          key=lambda c: cell_timing[c][1])
+            line["slowest_cell"] = f"{slowest[0]}x{slowest[1]}"
         if args.image >= 512:
             # Ledger for the most-sharded measured cell; when nothing
             # completed, fall back to the ATTEMPTED grid so the emitted
@@ -247,6 +266,7 @@ def main(args) -> None:
     enable_compilation_cache()
 
     results = {}
+    cell_timing = {}
 
     # Same hang/kill protection as bench.py: one compile wedging — or the
     # driver's SIGTERM — must not swallow the sizes that already completed.
@@ -264,7 +284,7 @@ def main(args) -> None:
             if emitted[0]:
                 return False
             emitted[0] = True
-        _emit(results, n_all_box[0], args)
+        _emit(results, n_all_box[0], args, cell_timing)
         return True
 
     def on_kill(signum, frame):
@@ -316,8 +336,9 @@ def main(args) -> None:
                     print(f"[scaling] {dp}x{sp}: skipped (predicted OOM)",
                           file=sys.stderr, flush=True)
                     continue
+            t_cell = time.perf_counter()
             try:
-                ips = measure(dp * sp, args, spatial=sp)
+                ips, loop_dt = measure(dp * sp, args, spatial=sp)
             except Exception as e:
                 # Cells are independent (a floor violation in one mesh
                 # shape says nothing about the others) — keep going.
@@ -326,6 +347,8 @@ def main(args) -> None:
                       file=sys.stderr, flush=True)
                 continue
             results[(dp, sp)] = ips
+            cell_timing[(dp, sp)] = (
+                time.perf_counter() - t_cell, loop_dt)
             print(f"[scaling] {dp}x{sp}: {ips:.2f} images/sec "
                   f"({ips / (dp * sp):.2f}/device)",
                   file=sys.stderr, flush=True)
@@ -347,7 +370,7 @@ def main(args) -> None:
                   file=sys.stderr, flush=True)
             break
         try:
-            ips = measure(n, args)
+            ips, _ = measure(n, args)
         except Exception as e:
             print(f"[scaling] {n} device(s): FAILED {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)

@@ -455,6 +455,31 @@ class ObsConfig:
     # providing adversarial signal. eps <= 0 disables.
     collapse_eps: float = 0.05
     collapse_patience: int = 50
+    # Training-run span tracing (cyclegan_tpu/obs/train_trace.py): one
+    # `trace` event per epoch whose dispatch spans tile the epoch wall
+    # exactly, derived purely from StepClock timestamps (zero extra
+    # dispatches/syncs). 0 disables tracing; >0 turns it on AND sets
+    # the fraction of dispatches that carry hop-level child spans
+    # (data_wait/submit/resolve/host + the device overlay).
+    train_trace_sample: float = 0.0
+    # Per-epoch span cap: a runaway pass cannot bloat one trace event
+    # unboundedly; drops are counted in the trace's `spans_dropped` /
+    # `tiling_complete` attrs (never silent).
+    train_trace_max_spans: int = 4096
+    # Host-side straggler observatory: emit a `train_straggler` event
+    # (with blame attributed to data_wait vs device vs host) when one
+    # dispatch's wall exceeds this multiple of the rolling median.
+    # Independent of train_trace_sample; 0 disables.
+    straggler_multiple: float = 4.0
+    # Measured collective probe (obs/collective_probe.py): run the
+    # timed psum/ppermute microbench at startup and then every N
+    # epochs, off the hot path, emitting `collective_probe` events
+    # whose measured_step_comms_s upgrades the goodput ledger's
+    # collective phase from census estimate to measurement. 0 disables.
+    probe_every: int = 0
+    # Probe payload buckets (KiB) and fenced repeats per bucket.
+    probe_payloads_kb: tuple = (4, 256, 4096)
+    probe_repeats: int = 3
 
     def __post_init__(self):
         # A typo like "Halt" would silently select the warn path on the
@@ -468,6 +493,11 @@ class ObsConfig:
         if self.max_rollbacks < 0:
             raise ValueError(
                 f"obs.max_rollbacks must be >= 0, got {self.max_rollbacks}"
+            )
+        if not (0.0 <= self.train_trace_sample <= 1.0):
+            raise ValueError(
+                f"obs.train_trace_sample must be in [0, 1], got "
+                f"{self.train_trace_sample}"
             )
 
 

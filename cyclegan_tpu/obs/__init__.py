@@ -21,6 +21,11 @@ on the existing backpressure path — never `block_until_ready`);
 `tools/check_no_sync.py` enforces this statically and runs in tier-1.
 """
 
+from cyclegan_tpu.obs.collective_probe import (
+    probe_event_payload,
+    reconcile,
+    run_probe,
+)
 from cyclegan_tpu.obs.comms import (
     RECON_TOLERANCE,
     analytic_census,
@@ -49,6 +54,12 @@ from cyclegan_tpu.obs.telemetry import (
     NullTelemetry,
     Telemetry,
     make_telemetry,
+)
+from cyclegan_tpu.obs.train_trace import (
+    StragglerDetector,
+    TrainTracer,
+    tiling_error,
+    trace_phase_sums,
 )
 from cyclegan_tpu.obs.trace import (
     NULL_TRACE,
@@ -92,4 +103,11 @@ __all__ = [
     "NullTraceContext",
     "NULL_TRACE",
     "Span",
+    "TrainTracer",
+    "StragglerDetector",
+    "trace_phase_sums",
+    "tiling_error",
+    "run_probe",
+    "reconcile",
+    "probe_event_payload",
 ]

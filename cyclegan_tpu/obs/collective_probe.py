@@ -1,0 +1,339 @@
+"""Measured (not estimated) collective time: a timed psum/ppermute
+microbench per (mesh, axis, payload-bucket).
+
+The comms census (obs/comms.py) reconciles an analytic byte ledger
+against the compiled HLO — it proves the program MOVES the bytes the
+model says, but the census's per-link TIME estimate is still a ring
+model over an assumed `link_gbps`. This module measures instead: for
+each mesh axis it dispatches a shard_map'd `lax.psum` (the gradient
+all-reduce shape) and a ring `lax.ppermute` (the halo-exchange shape)
+over a few payload buckets, fences each repeat through the tiny scalar
+the bench returns, and subtracts a no-collective baseline dispatch so
+the reported seconds are collective time, not dispatch+fence overhead.
+The measured per-axis bandwidth turns the census's `est_step_comms_s`
+from assumption into calibrated fact: `reconcile()` prices the
+census's per-link bytes at the PROBED bandwidth and reports the delta.
+
+Cost model: the probe runs OFF the hot path only — once at startup and
+at epoch boundaries (`--probe_every`), never inside the dispatch loop.
+It is the single obs/ module allowed to synchronize: graftlint's
+no-sync rule carries an explicit allow entry for this file (every
+fetch marked), while the rest of obs/ stays sync-free. Its jit +
+shard_map call sites are the probe's REGISTERED compile sites — two
+textual sites, parameterized by closure, so the compile-site census
+grows by exactly these and no more.
+
+CLI (host devices, the comms_census pattern — never needs the chip):
+
+  python -m cyclegan_tpu.obs.collective_probe --devices 8 \
+      --meshes 4x2,8x1 --out docs/collective_probe.json
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence
+
+# Default payload buckets: small (latency-bound), medium, large
+# (bandwidth-bound — the gradient-tree regime).
+PAYLOADS_KB = (4, 256, 4096)
+REPEATS = 3
+
+
+def _median(vals) -> float:
+    s = sorted(vals)
+    return s[len(s) // 2] if s else 0.0
+
+
+def _ring_link_bytes(payload_bytes: float, n: int) -> float:
+    """Per-link bytes of a ring all-reduce over n members."""
+    if n <= 1:
+        return 0.0
+    return 2.0 * (n - 1) / n * payload_bytes
+
+
+def _bench_fn(mesh, spec_axes, axis: Optional[str], kind: str,
+              axis_size: int):
+    """One jitted bench program: psum / ring-ppermute / baseline over
+    `axis`, returning a scalar that data-depends on the collective so
+    a fetch of it fences the whole program. The shard_map + jit below
+    are this module's only compile sites."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    if hasattr(jax, "shard_map"):
+        _shard_map = jax.shard_map
+        _check_kw = "check_vma"
+    else:  # pragma: no cover - exercised on jax<0.5 images
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        _check_kw = "check_rep"
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def local(x):
+        if kind == "psum":
+            y = jax.lax.psum(x, axis)
+        elif kind == "ppermute":
+            y = jax.lax.ppermute(x, axis_name=axis, perm=perm)
+        else:  # baseline: same dispatch + fence, no collective
+            y = x + 1.0
+        return jnp.sum(y)
+
+    f = _shard_map(
+        local, mesh=mesh, in_specs=(P(spec_axes),), out_specs=P(),
+        **{_check_kw: False},
+    )
+    return jax.jit(f)
+
+
+def _time_calls(fn, x, repeats: int) -> list:
+    """Compile + warm once, then time `repeats` fenced executions."""
+    import jax
+
+    float(jax.device_get(fn(x)))  # sanctioned-fetch: probe warm fence (off hot path)
+    out = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        o = fn(x)
+        float(jax.device_get(o))  # sanctioned-fetch: probe timing fence (off hot path)
+        out.append(time.perf_counter() - t0)
+    return out
+
+
+def run_probe(plan, payloads_kb: Sequence[int] = PAYLOADS_KB,
+              repeats: int = REPEATS) -> Dict[str, object]:
+    """Measured collective timings for every >1-sized axis of the
+    plan's mesh. Returns the `collective_probe` event payload."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = plan.mesh
+    spec_axes = tuple(mesh.axis_names)
+    n_dev = plan.n_devices
+    axes_out: Dict[str, dict] = {}
+    for axis, size in ((plan.data_axis, plan.n_data),
+                       (plan.spatial_axis, plan.n_spatial)):
+        if size <= 1:
+            continue
+        buckets = []
+        for kb in payloads_kb:
+            elems = max(1, int(kb) * 1024 // 4)
+            x = jax.device_put(
+                np.ones((n_dev, elems), np.float32),
+                NamedSharding(mesh, P(spec_axes)))
+            times = {}
+            for kind in ("baseline", "psum", "ppermute"):
+                fn = _bench_fn(mesh, spec_axes, axis, kind, size)
+                times[kind] = _time_calls(fn, x, repeats)
+            base = _median(times["baseline"])
+            payload_bytes = elems * 4
+            psum_s = max(0.0, _median(times["psum"]) - base)
+            perm_s = max(0.0, _median(times["ppermute"]) - base)
+            psum_link = _ring_link_bytes(payload_bytes, size)
+            buckets.append({
+                "payload_kb": int(kb),
+                "payload_bytes": payload_bytes,
+                "baseline_s": round(base, 6),
+                "psum_s": round(psum_s, 6),
+                "ppermute_s": round(perm_s, 6),
+                "psum_link_bytes": round(psum_link, 1),
+                # Gbit/s at the census's per-link convention, so the
+                # two time models price bytes in the same currency.
+                "psum_gbps": round(psum_link * 8 / max(psum_s, 1e-9)
+                                   / 1e9, 4),
+                "ppermute_gbps": round(payload_bytes * 8
+                                       / max(perm_s, 1e-9) / 1e9, 4),
+            })
+        axes_out[axis] = {"size": size, "buckets": buckets}
+    return {
+        "schema": 1,
+        "mesh": {
+            "n_data": plan.n_data,
+            "n_spatial": plan.n_spatial,
+            "n_devices": n_dev,
+        },
+        "mesh_axes": f"{plan.data_axis}x{plan.spatial_axis}",
+        "platform": jax.default_backend(),
+        "payloads_kb": [int(k) for k in payloads_kb],
+        "repeats": int(repeats),
+        "axes": axes_out,
+    }
+
+
+def reconcile(probe: Dict[str, object],
+              census: Dict[str, object]) -> Dict[str, object]:
+    """Price the census's per-link bytes at the PROBED bandwidth and
+    compare against its link-model estimate. Pure host arithmetic.
+
+    Uses the largest payload bucket's bandwidth — the gradient-tree
+    regime the census's per-step payload actually lives in."""
+    per_link = census.get("per_link") or {}
+    link_gbps = float(census.get("link_gbps") or 0.0)
+    axes_probe = probe.get("axes") or {}
+    axes_out: Dict[str, dict] = {}
+    measured_total = 0.0
+    est_total = 0.0
+    for axis, key, bw_key in (("data", "data_allreduce_bytes", "psum_gbps"),
+                              ("spatial", "spatial_bytes",
+                               "ppermute_gbps")):
+        link_bytes = float(per_link.get(key) or 0.0)
+        a = axes_probe.get(axis)
+        if link_bytes <= 0 or not a or not a.get("buckets"):
+            continue
+        bucket = a["buckets"][-1]
+        gbps = float(bucket.get(bw_key) or 0.0)
+        if gbps <= 0:
+            continue
+        measured_s = link_bytes * 8 / (gbps * 1e9)
+        est_s = (link_bytes / (link_gbps * 1e9 / 8.0)
+                 if link_gbps > 0 else None)
+        entry = {
+            "census_link_bytes": round(link_bytes, 1),
+            "probe_gbps": gbps,
+            "measured_s": round(measured_s, 6),
+        }
+        measured_total += measured_s
+        if est_s is not None:
+            entry["est_s"] = round(est_s, 6)
+            entry["delta_frac"] = round(
+                (measured_s - est_s) / max(est_s, 1e-12), 4)
+            est_total += est_s
+        axes_out[axis] = entry
+    out: Dict[str, object] = {
+        "axes": axes_out,
+        "measured_step_comms_s": round(measured_total, 6),
+    }
+    if est_total > 0:
+        out["est_step_comms_s"] = round(est_total, 6)
+        out["delta_frac"] = round(
+            (measured_total - est_total) / est_total, 4)
+    return out
+
+
+def probe_event_payload(plan, config, global_batch: int, state,
+                        payloads_kb: Sequence[int] = PAYLOADS_KB,
+                        repeats: int = REPEATS,
+                        link_gbps: float = 45.0) -> Dict[str, object]:
+    """The training-run entry point: run the probe on the run's own
+    mesh, mint an analytic census for the run's model, and attach the
+    reconciliation — one `collective_probe` event payload. The goodput
+    ledger picks `measured_step_comms_s` out of it, upgrading the
+    collective phase from link-model estimate to measured fact."""
+    from cyclegan_tpu.obs.comms import build_census
+
+    probe = run_probe(plan, payloads_kb=payloads_kb, repeats=repeats)
+    census = build_census(plan, config, global_batch, state,
+                          link_gbps=link_gbps)
+    recon = reconcile(probe, census)
+    probe["census"] = {
+        "per_link": census.get("per_link"),
+        "link_gbps": census.get("link_gbps"),
+        "est_step_comms_s": census.get("est_step_comms_s"),
+    }
+    probe["reconcile"] = recon
+    if "measured_step_comms_s" in recon:
+        probe["measured_step_comms_s"] = recon["measured_step_comms_s"]
+    return probe
+
+
+def _main() -> int:
+    import argparse
+    import json
+    import os
+    import sys
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--devices", default=8, type=int,
+                   help="host device count to force (CPU)")
+    p.add_argument("--meshes", default="4x2,8x1",
+                   help="comma-separated DPxSP meshes to probe")
+    p.add_argument("--payloads_kb", default=None,
+                   help="comma-separated payload buckets (KiB)")
+    p.add_argument("--repeats", default=REPEATS, type=int)
+    p.add_argument("--link_gbps", default=45.0, type=float,
+                   help="census link model to reconcile against")
+    p.add_argument("--out", default=None,
+                   help="write the probe payload (pretty JSON) here")
+    args = p.parse_args()
+
+    # Host devices only — assert BEFORE jax import wins the backend
+    # race (the comms_census.py pattern).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count"
+                    f"={args.devices}").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from cyclegan_tpu.config import ParallelConfig, tiny_test_config
+    from cyclegan_tpu.obs.comms import build_census
+    from cyclegan_tpu.parallel import make_mesh_plan
+    from cyclegan_tpu.train import create_state
+
+    payloads = (tuple(int(k) for k in args.payloads_kb.split(","))
+                if args.payloads_kb else PAYLOADS_KB)
+    devices = jax.devices()
+    out_meshes = []
+    for spec in args.meshes.split(","):
+        dp, sp = (int(v) for v in spec.strip().split("x"))
+        need = dp * sp
+        if len(devices) < need:
+            print(f"[collective_probe] skip {spec}: need {need} "
+                  f"devices, have {len(devices)}", file=sys.stderr)
+            continue
+        par = ParallelConfig(spatial_parallelism=sp)
+        plan = make_mesh_plan(par, devices[:need])
+        cfg = tiny_test_config()
+        cfg = cfg.replace(parallel=par)
+        gb = plan.n_data * cfg.train.batch_size
+        print(f"[collective_probe] probing mesh {dp}x{sp} "
+              f"(payloads {list(payloads)} KiB, "
+              f"repeats {args.repeats}) ...", file=sys.stderr, flush=True)
+        state = jax.eval_shape(
+            lambda c=cfg: create_state(c, jax.random.PRNGKey(0)))
+        probe = run_probe(plan, payloads_kb=payloads,
+                          repeats=args.repeats)
+        census = build_census(plan, cfg, gb, state,
+                              link_gbps=args.link_gbps)
+        recon = reconcile(probe, census)
+        probe["census"] = {
+            "per_link": census.get("per_link"),
+            "link_gbps": census.get("link_gbps"),
+            "est_step_comms_s": census.get("est_step_comms_s"),
+        }
+        probe["reconcile"] = recon
+        out_meshes.append({"mesh": f"{dp}x{sp}", **probe})
+        for axis, r in (recon.get("axes") or {}).items():
+            print(f"[collective_probe] {spec}/{axis}: measured "
+                  f"{r['measured_s'] * 1e3:.3f} ms vs census est "
+                  f"{r.get('est_s', 0) * 1e3:.3f} ms "
+                  f"(delta {r.get('delta_frac', 0) * 100:+.0f}%, "
+                  f"probe {r['probe_gbps']:.2f} Gbit/s)",
+                  file=sys.stderr, flush=True)
+    payload = {
+        "schema": 1,
+        "platform": jax.default_backend(),
+        "host_devices": len(devices),
+        "payloads_kb": list(payloads),
+        "repeats": args.repeats,
+        "link_gbps": args.link_gbps,
+        "meshes": out_meshes,
+    }
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+        print(f"[collective_probe] wrote {args.out}", file=sys.stderr)
+    json.dump(payload, sys.stdout)
+    print(flush=True)
+    return 0 if out_meshes else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

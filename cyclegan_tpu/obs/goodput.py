@@ -140,6 +140,8 @@ class GoodputLedger:
 
     def __init__(self, comms_s_per_step: float = 0.0):
         self.comms_s_per_step = float(comms_s_per_step)
+        self.comms_source = "config" if comms_s_per_step > 0 else "none"
+        self.census_comms_s = 0.0  # analytic estimate, kept for deltas
         self._passes: List[Dict[str, float]] = []
         self._service_s = 0.0
 
@@ -155,11 +157,34 @@ class GoodputLedger:
 
     def note_census(self, payload: dict) -> None:
         """Pick up the collective-seconds estimate when a comms census
-        with a link model is recorded."""
+        with a link model is recorded. A measured probe value, once
+        seen, always wins over the analytic estimate."""
         est = payload.get("est_step_comms_s")
         if est is not None:
             try:
-                self.comms_s_per_step = max(0.0, float(est))
+                self.census_comms_s = max(0.0, float(est))
+            except (TypeError, ValueError):
+                return
+            if self.comms_source != "probe":
+                self.comms_s_per_step = self.census_comms_s
+                self.comms_source = "census"
+
+    def note_probe(self, payload: dict) -> None:
+        """Pick up the MEASURED collective seconds when a collective
+        probe (obs/collective_probe.py) reports — calibrated fact
+        replaces the census's ring-model assumption."""
+        measured = payload.get("measured_step_comms_s")
+        if measured is None:
+            return
+        try:
+            self.comms_s_per_step = max(0.0, float(measured))
+        except (TypeError, ValueError):
+            return
+        self.comms_source = "probe"
+        census = (payload.get("census") or {}).get("est_step_comms_s")
+        if census is not None:
+            try:
+                self.census_comms_s = max(0.0, float(census))
             except (TypeError, ValueError):
                 pass
 
@@ -173,6 +198,11 @@ class GoodputLedger:
         out = rollup_phases(self._passes, self._service_s, elapse_s,
                             self.comms_s_per_step)
         out["epoch"] = epoch
+        out["comms_source"] = self.comms_source
+        if self.comms_source == "probe" and self.census_comms_s > 0:
+            out["comms_probe_delta_frac"] = round(
+                (self.comms_s_per_step - self.census_comms_s)
+                / self.census_comms_s, 4)
         self._passes = []
         self._service_s = 0.0
         return out

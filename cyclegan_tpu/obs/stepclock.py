@@ -49,6 +49,13 @@ dispatch's loop-iteration wall exceeds `stall_multiple` x the rolling
 median of recent walls — the wedged-tunnel epochs get attributed, not
 asserted. `depth` tracks pinned in-flight batches for the stall
 watchdog, and every dispatch/fetch beats the watchdog's heartbeat.
+
+An optional `observer` (obs/train_trace.py) receives the ABSOLUTE
+timestamps this clock already takes — record close, submit→ready
+resolution, pass finish — so a span-level training trace can be
+derived with zero additional clock reads and zero extra dispatches.
+The observer contract is pull-only: it must never mutate the record
+dicts it is shown (they are the `step` event payloads).
 """
 
 from __future__ import annotations
@@ -87,9 +94,11 @@ class StepClock:
         clock=time.perf_counter,
         stall_multiple: float = 0.0,
         on_finish: Optional[Callable[[dict], None]] = None,
+        observer=None,
     ):
         self._logger = logger
         self._on_finish = on_finish
+        self._observer = observer
         self._epoch = epoch
         self._split = split
         self._log_every = max(0, int(log_every))
@@ -119,6 +128,9 @@ class StepClock:
         self._open: dict = {}
         self._ready: dict = {}
         self._ready_vals: List[float] = []
+        self._cur_t_submit: Optional[float] = None
+        if observer is not None:
+            observer.pass_open(epoch, split, self._t_open)
 
     def _emit_record(self, rec: dict) -> None:
         if rec.pop("_emit"):
@@ -149,6 +161,12 @@ class StepClock:
         rec["host_work_s"] = round(host, 6)
         self._host_s += host
         self._walls.append(wall)
+        if self._observer is not None:
+            # Absolute timestamps for the trace layer: iteration start,
+            # submit instant, and record close — all reads this clock
+            # already took.
+            self._observer.record(rec, self._t_iter,
+                                  self._cur_t_submit, now)
         self._check_stall(rec, wall)
         rec["_emit"] = bool(
             self._log_every and (self.n_dispatches % self._log_every == 0)
@@ -222,6 +240,7 @@ class StepClock:
             "depth": self.depth,
         }
         self._submits.append((self.n_dispatches - 1, now))
+        self._cur_t_submit = now
         self._heartbeat()
 
     def fetched(self, wait_s: float, steps: int = 1,
@@ -243,6 +262,8 @@ class StepClock:
             idx, t_submit = self._submits.popleft()
             if at is not None:
                 self._resolve_ready(idx, max(0.0, at - t_submit))
+                if self._observer is not None:
+                    self._observer.ready(idx, t_submit, at)
         self._heartbeat()
 
     def drained(self, wait_s: float, n_entries: int = 0,
@@ -255,6 +276,8 @@ class StepClock:
             idx, t_submit = self._submits.popleft()
             if at is not None:
                 self._resolve_ready(idx, max(0.0, at - t_submit))
+                if self._observer is not None:
+                    self._observer.ready(idx, t_submit, at)
         self._heartbeat()
 
     def finish(self) -> dict:
@@ -292,6 +315,8 @@ class StepClock:
             "n_loop_stalls": self.n_loop_stalls,
         }
         self._logger.event("epoch_steps", **agg)
+        if self._observer is not None:
+            self._observer.pass_close(agg, now)
         if self._on_finish is not None:
             self._on_finish(agg)
         self._heartbeat()

@@ -27,12 +27,14 @@ class Telemetry:
         watchdog: Optional[StallWatchdog] = None,
         stall_multiple: float = 0.0,
         goodput: Optional[GoodputLedger] = None,
+        train_tracer=None,
     ):
         self.logger = logger
         self.step_log_every = step_log_every
         self.stall_multiple = stall_multiple
         self.watchdog = watchdog
         self.goodput = goodput
+        self.train_tracer = train_tracer
         self._clock: Optional[StepClock] = None
         if watchdog is not None:
             watchdog.start()
@@ -56,6 +58,7 @@ class Telemetry:
             log_every=self.step_log_every, heartbeat=beat,
             stall_multiple=self.stall_multiple,
             on_finish=on_finish,
+            observer=self.train_tracer,
         )
         self._clock = clock
         if self.watchdog is not None:
@@ -71,12 +74,22 @@ class Telemetry:
                 self.goodput.note_service(fields.get("seconds", 0.0))
             elif kind == "comms_census":
                 self.goodput.note_census(fields)
+            elif kind == "collective_probe":
+                self.goodput.note_probe(fields)
+        if self.train_tracer is not None:
+            # Epoch-scale happenings land as instants on the open
+            # epoch trace's root span (train_trace.INSTANT_KINDS).
+            self.train_tracer.note_event(kind, fields)
         self.logger.event(kind, **fields)
 
     def epoch(self, epoch: int, **fields) -> None:
         """Per-epoch rollup: throughput, utilization, eval metrics —
         followed by the goodput ledger's phase rollup for the same
         window when an epoch duration is available."""
+        if self.train_tracer is not None:
+            # The rollup moment closes the epoch's trace, so its wall
+            # covers passes + interludes up to exactly here.
+            self.train_tracer.close_epoch(epoch)
         self.logger.event("epoch", epoch=epoch, **fields)
         if self.goodput is not None:
             elapse = fields.get("elapse_s") or fields.get("seconds")
@@ -94,6 +107,9 @@ class Telemetry:
     def close(self, status: str = "completed") -> None:
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self.train_tracer is not None:
+            # A run ending mid-epoch still flushes its open trace.
+            self.train_tracer.close_epoch()
         if not self.logger.closed:
             self.logger.event("end", status=status)
             self.logger.close()
@@ -106,6 +122,7 @@ class NullTelemetry(Telemetry):
         self.stall_multiple = 0.0
         self.watchdog = None
         self.goodput = None
+        self.train_tracer = None
         self._clock = None
 
     @property
@@ -157,10 +174,25 @@ def make_telemetry(obs_config, output_dir: str, primary: bool = True) -> Telemet
     logger = MetricsLogger(path)
     deadline = float(getattr(obs_config, "watchdog_deadline_s", 0.0) or 0.0)
     watchdog = StallWatchdog(logger, deadline) if deadline > 0 else None
+    sample = float(getattr(obs_config, "train_trace_sample", 0.0) or 0.0)
+    straggler = float(
+        getattr(obs_config, "straggler_multiple", 0.0) or 0.0)
+    train_tracer = None
+    if sample > 0 or straggler > 0:
+        from cyclegan_tpu.obs.train_trace import TrainTracer
+
+        train_tracer = TrainTracer(
+            logger,
+            sample=sample,
+            max_spans=int(
+                getattr(obs_config, "train_trace_max_spans", 4096)),
+            straggler_multiple=straggler,
+        )
     return Telemetry(
         logger,
         step_log_every=int(getattr(obs_config, "step_log_every", 1)),
         watchdog=watchdog,
         stall_multiple=float(getattr(obs_config, "stall_multiple", 0.0) or 0.0),
         goodput=GoodputLedger(),
+        train_tracer=train_tracer,
     )

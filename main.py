@@ -155,6 +155,12 @@ def main(args: argparse.Namespace) -> None:
             divergence_multiple=args.health_divergence_multiple,
             collapse_eps=args.health_collapse_eps,
             collapse_patience=args.health_collapse_patience,
+            train_trace_sample=args.train_trace_sample,
+            straggler_multiple=args.obs_straggler_multiple,
+            probe_every=args.probe_every,
+            probe_payloads_kb=tuple(
+                int(k) for k in args.probe_payloads_kb.split(",") if k),
+            probe_repeats=args.probe_repeats,
         ),
     )
     if config.train.grad_accum < 1 or config.train.steps_per_dispatch < 1:
@@ -394,6 +400,38 @@ def main(args: argparse.Namespace) -> None:
             echo=print if primary else None,
         )
 
+    # Measured collective probe (obs/collective_probe.py): a timed
+    # psum/ppermute microbench on the run's OWN mesh, at startup and
+    # then every --probe_every epochs — always BETWEEN passes, never
+    # inside the dispatch loop. Its measured_step_comms_s upgrades the
+    # goodput ledger's collective phase from census estimate to
+    # measurement; a probe failure records an event and training
+    # continues (calibration must never kill the run).
+    def run_collective_probe():
+        from cyclegan_tpu.obs.collective_probe import probe_event_payload
+
+        try:
+            payload = probe_event_payload(
+                plan, config, global_batch_size, state,
+                payloads_kb=config.obs.probe_payloads_kb,
+                repeats=config.obs.probe_repeats,
+            )
+        except Exception as e:  # noqa: BLE001 — best-effort calibration
+            tele.event("service_error", job="collective_probe",
+                       error=str(e))
+            return
+        tele.event("collective_probe", **payload)
+        if primary:
+            recon = payload.get("reconcile") or {}
+            for axis, r in (recon.get("axes") or {}).items():
+                print(f"collective probe {axis}: measured "
+                      f"{r['measured_s'] * 1e3:.3f} ms/step vs census "
+                      f"est {r.get('est_s', 0) * 1e3:.3f} ms "
+                      f"({r.get('delta_frac', 0) * 100:+.0f}%)")
+
+    if config.obs.probe_every > 0 and tele.enabled:
+        run_collective_probe()
+
     run_status = "failed"  # until the epoch loop exits cleanly
     try:
         epoch = start_epoch
@@ -435,6 +473,13 @@ def main(args: argparse.Namespace) -> None:
                 run_status = "preempted"
                 tele.event("preempted", epoch=epoch)
                 break
+            if (config.obs.probe_every > 0 and tele.enabled
+                    and (epoch + 1) % config.obs.probe_every == 0
+                    and epoch + 1 < config.train.epochs):
+                # Epoch-boundary recalibration: link conditions drift
+                # (congestion, thermal throttling); the ledger tracks
+                # the probe's latest measurement, not a stale one.
+                run_collective_probe()
             epoch += 1
         else:
             run_status = "completed"
@@ -844,6 +889,43 @@ if __name__ == "__main__":
                              "dispatch's loop-iteration wall exceeds X times "
                              "the rolling median (32-dispatch window, armed "
                              "after 5 dispatches); 0 disables")
+    parser.add_argument("--train_trace_sample", default=0.0, type=float,
+                        metavar="F",
+                        help="training-run span tracing (obs/train_trace"
+                             ".py): emit one `trace` event per epoch whose "
+                             "dispatch spans tile the epoch wall exactly, "
+                             "derived purely from StepClock timestamps "
+                             "(zero extra dispatches or syncs). F is the "
+                             "fraction of dispatches carrying hop-level "
+                             "child spans (data_wait/submit/resolve/host "
+                             "+ device overlay); 0 disables tracing. "
+                             "Render with tools/trace_timeline.py")
+    parser.add_argument("--obs_straggler_multiple", default=4.0,
+                        type=float, metavar="X",
+                        help="straggler observatory: emit a "
+                             "`train_straggler` event with blame "
+                             "attribution (data_wait vs device vs host) "
+                             "when one dispatch's wall exceeds X times "
+                             "the rolling median; 0 disables")
+    parser.add_argument("--probe_every", default=0, type=int, metavar="N",
+                        help="measured collective probe (obs/"
+                             "collective_probe.py): run the timed psum/"
+                             "ppermute microbench on the run's mesh at "
+                             "startup and then every N epochs, off the "
+                             "hot path; the measured per-axis bandwidth "
+                             "replaces the comms census's link-model "
+                             "estimate in the goodput ledger's "
+                             "`collective` phase. 0 disables")
+    parser.add_argument("--probe_payloads_kb", default="4,256,4096",
+                        metavar="K1,K2,...",
+                        help="collective-probe payload buckets (KiB per "
+                             "shard): small = latency-bound, large = "
+                             "bandwidth-bound (the gradient-tree regime "
+                             "the census payload lives in)")
+    parser.add_argument("--probe_repeats", default=3, type=int,
+                        metavar="N",
+                        help="fenced repeats per (axis, payload) probe "
+                             "bucket; the median is reported")
     # Model-health flight recorder (cyclegan_tpu/obs/health.py)
     parser.add_argument("--no_health", action="store_true",
                         help="disable the model-health layer: in-step grad "

@@ -62,7 +62,8 @@ def test_queue_order_and_budgets():
                      "upsample_sweep", "accum512", "scan512",
                      "spatial_sweep", "spatial_1024",
                      "serve_sweep", "serve_trace", "trace",
-                     "chaos_drill", "timed_main"]
+                     "chaos_drill", "timed_main",
+                     "train_traced", "train_trace", "collective_probe"]
     by = {s.name: s for s in q}
     assert by["diag"].abort_queue_on_fail  # diag failing = relay sick
     # lint failing = known bug class in the code about to burn the
@@ -97,7 +98,8 @@ def test_queue_order_and_budgets():
     # static preflight and the trace-archive fold compile nothing and
     # keep tight budgets
     for s in q:
-        if s.name in ("graftlint", "serve_trace"):
+        if s.name in ("graftlint", "serve_trace", "train_trace",
+                      "collective_probe"):
             assert s.timeout_s >= 120.0
             continue
         assert s.timeout_s >= 1800.0, s.name
@@ -167,6 +169,40 @@ def test_timed_main_writes_outside_repo():
     argv = [s for s in build_queue("remote") if s.name == "timed_main"][0].argv
     out = argv[argv.index("--output_dir") + 1]
     assert os.path.isabs(out) and not out.startswith(REPO + os.sep)
+
+
+def test_train_trace_round_contract():
+    """The traced training run: fully sampled spans + per-epoch probe,
+    obs stream to /tmp, checkpoints OUTSIDE the repo; the fold step
+    collects the Perfetto timeline + raw slice and commits the
+    critical-path table via stdout_to. timed_main stays untraced (the
+    headline number carries no trace overhead)."""
+    by = {s.name: s for s in build_queue("remote")}
+    run = by["train_traced"].argv
+    assert run[run.index("--train_trace_sample") + 1] == "1.0"
+    assert run[run.index("--probe_every") + 1] == "1"
+    obs = run[run.index("--obs_jsonl") + 1]
+    out = run[run.index("--output_dir") + 1]
+    assert os.path.isabs(out) and not out.startswith(REPO + os.sep)
+    assert os.path.isabs(obs) and not obs.startswith(REPO + os.sep)
+    assert "--train_trace_sample" not in by["timed_main"].argv
+    fold = by["train_trace"]
+    assert "trace_timeline.py" in fold.argv[1]
+    assert obs in fold.argv
+    srcs = {src for src, _ in fold.collect}
+    dests = {dest for _, dest in fold.collect}
+    assert obs in srcs
+    assert all(d.startswith("docs/chip_logs/") for d in dests)
+    assert fold.stdout_to.endswith("train_trace_table.json")
+    # the round's measured-collective artifact comes out of the traced
+    # run's obs stream (the probe ran on the real mesh); re-running the
+    # probe CLI post-hoc would measure the wrong fabric
+    probe = by["collective_probe"]
+    assert "obs_report.py" in probe.argv[1]
+    assert "--probe-json" in probe.argv
+    assert obs in probe.argv
+    assert probe.stdout_to.endswith("collective_probe.json")
+    assert probe.stdout_to.startswith("docs/chip_logs/")
 
 
 def test_dry_run_prints_queue_and_executes_nothing(tmp_path):

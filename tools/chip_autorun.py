@@ -153,6 +153,11 @@ def build_queue(mode: str, round_tag: str = ROUND_TAG) -> list:
     # keeper slice into the round's chip_logs dir.
     serve_obs = f"/tmp/chip_serve_obs_{round_tag}.jsonl"
     serve_perfetto = f"/tmp/chip_serve_trace_{round_tag}.perfetto.json"
+    # Training-trace round (ISSUE 20): a short fully-sampled traced run
+    # (every dispatch minted a span graph, probe at startup + each
+    # epoch) streams to /tmp; the train_trace step folds it.
+    train_obs = f"/tmp/chip_train_obs_{round_tag}.jsonl"
+    train_perfetto = f"/tmp/chip_train_trace_{round_tag}.perfetto.json"
     q = [
         # Static-discipline preflight: graftlint over the whole tree
         # (donation-aliasing, no-sync, tracer-leak, compile-site census
@@ -349,6 +354,56 @@ def build_queue(mode: str, round_tag: str = ROUND_TAG) -> list:
               "--synthetic_test_size", "64",
               "--output_dir", "/tmp/chip_autorun_timed"],
              5400.0, env=env),
+        # Training-run distributed tracing on chip (ISSUE 20): the same
+        # geometry as timed_main but short and FULLY sampled — every
+        # fused dispatch mints its data_wait/host/submit/device/resolve
+        # span graph from StepClock's deferred timestamps (zero extra
+        # dispatches), the collective probe times psum/ppermute per mesh
+        # axis at startup and each epoch boundary, and the straggler
+        # detector attributes any outlier dispatch. timed_main above
+        # stays UNtraced so the headline number has no trace overhead;
+        # run_compare's --max_train_trace_overhead gates the pair.
+        # Output dir outside the repo (checkpoints); the obs stream goes
+        # to /tmp for the fold step below.
+        Step("train_traced",
+             [py, "main.py", "--epochs", "2", "--batch_size", "16", "--bf16",
+              "--steps_per_dispatch", "8", "--prefetch_batches", "2",
+              "--data_source", "synthetic", "--synthetic_train_size", "512",
+              "--synthetic_test_size", "64",
+              "--train_trace_sample", "1.0", "--probe_every", "1",
+              "--obs_jsonl", train_obs,
+              "--output_dir", "/tmp/chip_autorun_train_traced"],
+             3600.0, env=env),
+        # Archive the round's epoch span graphs next to the serve ones:
+        # the per-epoch critical-path table (per-hop p50/p95 + span-sum
+        # vs epoch-wall reconciliation) commits via stdout_to; the
+        # Perfetto timeline + raw slice (incl. collective_probe and
+        # train_straggler events) collect into the round's chip_logs
+        # dir — a goodput regression rounds later diffs THESE spans.
+        Step("train_trace",
+             [py, "tools/trace_timeline.py", train_obs,
+              "--out", train_perfetto, "--json"], 300.0, env=env,
+             collect=[(train_perfetto,
+                       os.path.join("docs", "chip_logs", round_tag,
+                                    "train_trace.perfetto.json")),
+                      (train_obs,
+                       os.path.join("docs", "chip_logs", round_tag,
+                                    "train_obs.jsonl"))],
+             stdout_to=os.path.join(
+                 "docs", "chip_logs", round_tag,
+                 "train_trace_table.json")),
+        # The round's measured-collective artifact: the traced run
+        # probed the REAL device mesh at startup + every epoch boundary
+        # (psum/ppermute per axis/payload bucket, reconciled against
+        # the analytic census); extract the last probe payload from the
+        # stream — re-running the CPU-forcing probe CLI here would
+        # measure the wrong fabric.
+        Step("collective_probe",
+             [py, "tools/obs_report.py", train_obs, "--probe-json"],
+             120.0, env=env,
+             stdout_to=os.path.join(
+                 "docs", "chip_logs", round_tag,
+                 "collective_probe.json")),
     ]
     return q
 

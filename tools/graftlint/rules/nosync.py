@@ -55,6 +55,11 @@ HOT_PATH_FILES: List[Tuple[str, bool]] = [
     # loop must stay fetch-free. Overrides the resil/ directory default
     # below (explicit file entries win over directory expansion).
     ("cyclegan_tpu/resil/elastic.py", True),
+    # Collective-probe microbench: its WHOLE JOB is to time fenced
+    # collectives, so its device_get fences are sanctioned — but it runs
+    # only at startup and epoch boundaries, never under an open
+    # StepClock. Overrides the obs/ directory's zero-fetch default.
+    ("cyclegan_tpu/obs/collective_probe.py", True),
 ]
 
 # Directories whose EVERY .py file is hot-path. Scanned as a directory

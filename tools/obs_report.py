@@ -144,6 +144,12 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
         # epoch wall-clock phase rollups and collective-traffic census.
         "goodputs": [],
         "comms_censuses": [],
+        # Training-trace observatory (obs/train_trace.py): straggler
+        # detections and measured collective-probe rounds. Epoch traces
+        # themselves arrive as `trace` events named train_epoch and are
+        # split out of the request-trace rollup below.
+        "train_stragglers": [],
+        "collective_probes": [],
         # Forward-compat census: event kinds this folder does not know.
         # They are still ignored (never fatal), but COUNTED — the render
         # names them explicitly instead of silently dropping them.
@@ -242,6 +248,10 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
             report["goodputs"].append(ev)
         elif kind == "comms_census":
             report["comms_censuses"].append(ev)
+        elif kind == "train_straggler":
+            report["train_stragglers"].append(ev)
+        elif kind == "collective_probe":
+            report["collective_probes"].append(ev)
         elif kind == "end":
             report["end"] = ev
         else:
@@ -520,16 +530,22 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
     # Request-trace rollup: status census, sampling provenance (head
     # sample vs tail-kept failure), per-hop duration stats, and the
     # slowest exemplars with their trace_id — the "which trace_id do I
-    # feed tools/trace_timeline.py" block.
-    if report["traces"]:
-        bases = [ev for ev in report["traces"] if not ev.get("late")]
-        late = [ev for ev in report["traces"] if ev.get("late")]
+    # feed tools/trace_timeline.py" block. Training epoch traces share
+    # the `trace` event schema but are a different animal (one per
+    # epoch, hop graph under dispatch spans) — split them out first.
+    serve_traces = [ev for ev in report["traces"]
+                    if ev.get("name") != "train_epoch"]
+    train_traces = [ev for ev in report["traces"]
+                    if ev.get("name") == "train_epoch"]
+    if serve_traces:
+        bases = [ev for ev in serve_traces if not ev.get("late")]
+        late = [ev for ev in serve_traces if ev.get("late")]
         statuses: Dict[str, int] = {}
         hop_durs: Dict[str, List[float]] = {}
         for ev in bases:
             s = str(ev.get("status", "?"))
             statuses[s] = statuses.get(s, 0) + 1
-        for ev in report["traces"]:
+        for ev in serve_traces:
             for span in ev.get("spans") or []:
                 t0, t1 = span.get("t0"), span.get("t1")
                 if t0 is None or t1 is None:
@@ -561,6 +577,63 @@ def fold(events: List[dict], skipped: int = 0) -> dict:
                  "tenant": (ev.get("attrs") or {}).get("tenant")}
                 for ev in slowest],
         }
+
+    # Train-trace rollup: per-hop duration stats over the dispatch hop
+    # graph, span-budget accounting, and the straggler census. Blame
+    # counts come from the per-detection `train_straggler` events when
+    # present (one event per detection, with full component attribution)
+    # and fall back to the epoch traces' accumulated attrs otherwise.
+    if train_traces or report["train_stragglers"]:
+        hop_durs = {}
+        spans_dropped = 0
+        attr_stragglers = 0
+        attr_blames: Dict[str, int] = {}
+        for ev in train_traces:
+            attrs = ev.get("attrs") or {}
+            spans_dropped += int(attrs.get("spans_dropped", 0) or 0)
+            attr_stragglers += int(attrs.get("n_stragglers", 0) or 0)
+            for b, n in (attrs.get("straggler_blames") or {}).items():
+                attr_blames[str(b)] = attr_blames.get(str(b), 0) + int(n)
+            for span in ev.get("spans") or []:
+                t0, t1 = span.get("t0"), span.get("t1")
+                if t0 is None or t1 is None:
+                    continue
+                name = str(span.get("name", "?"))
+                if name in ("dispatch", "data_wait", "submit", "device",
+                            "resolve", "host"):
+                    hop_durs.setdefault(name, []).append(t1 - t0)
+        hops = {}
+        for name in ("dispatch", "data_wait", "submit", "device",
+                     "resolve", "host"):
+            vals = sorted(hop_durs.get(name) or [])
+            if not vals:
+                continue
+            hops[name] = {
+                "n": len(vals),
+                "p50_ms": round(_percentile(vals, 0.5) * 1e3, 3),
+                "p95_ms": round(_percentile(vals, 0.95) * 1e3, 3),
+            }
+        if report["train_stragglers"]:
+            blames: Dict[str, int] = {}
+            for ev in report["train_stragglers"]:
+                b = str(ev.get("blame", "?"))
+                blames[b] = blames.get(b, 0) + 1
+            n_stragglers = len(report["train_stragglers"])
+        else:
+            blames, n_stragglers = attr_blames, attr_stragglers
+        report["train_trace_rollup"] = {
+            "n_traces": len(train_traces),
+            "hops": hops,
+            "spans_dropped": spans_dropped,
+            "n_stragglers": n_stragglers,
+            "blames": blames,
+        }
+
+    # Collective-probe rollup: the LAST measured round wins (a run
+    # legally re-probes at epoch boundaries); per-axis measured vs
+    # analytic step-collective seconds from its reconcile block.
+    if report["collective_probes"]:
+        report["collective_probe_rollup"] = report["collective_probes"][-1]
     return report
 
 
@@ -703,6 +776,12 @@ def render(report: dict) -> str:
         w(f"worst epoch: {gp.get('worst_epoch', '?')} at "
           f"{_fmt(gp.get('worst_epoch_fraction'), '.3f')} goodput "
           f"(open it in tools/goodput_timeline.py)")
+        src = report["goodputs"][-1].get("comms_source")
+        if src and src != "none":
+            delta = report["goodputs"][-1].get("comms_probe_delta_frac")
+            w(f"collective seconds source: {src}"
+              + (f" (probe vs census delta {_fmt(delta, '.3f')})"
+                 if delta is not None else ""))
     elif report["epoch_steps"]:
         # A training stream with loop aggregates but no rollups is a
         # version-skew signal, same convention as the traces line.
@@ -1118,6 +1197,51 @@ def render(report: dict) -> str:
         w("-- request traces: absent (no `trace` events in stream; "
           "is --trace_sample > 0?) --")
 
+    ttr = report.get("train_trace_rollup")
+    if ttr:
+        w(f"-- training traces ({ttr['n_traces']} epoch trace(s)) --")
+        for hop, s in ttr["hops"].items():
+            w(f"  hop {hop:<9} n={s['n']:<6} p50 {s['p50_ms']:>9.3f}ms  "
+              f"p95 {s['p95_ms']:>9.3f}ms")
+        if ttr["spans_dropped"]:
+            w(f"  SPANS DROPPED: {ttr['spans_dropped']} (epoch tiling "
+              f"incomplete — raise --train_trace_max_spans)")
+        if ttr["n_stragglers"]:
+            blame = ", ".join(f"{k}={v}"
+                              for k, v in sorted(ttr["blames"].items()))
+            w(f"  stragglers: {ttr['n_stragglers']} (blame: {blame})")
+        else:
+            w("  stragglers: none")
+    elif report["epoch_steps"]:
+        # A training stream without epoch traces is worth the same
+        # version/config-skew line the serving streams get.
+        w("-- training traces: absent (no `train_epoch` traces; is "
+          "--train_trace_sample > 0?) --")
+
+    probe = report.get("collective_probe_rollup")
+    if probe:
+        mesh = probe.get("mesh") or {}
+        w(f"-- collective probe (measured, mesh "
+          f"{mesh.get('n_data', '?')} data x "
+          f"{mesh.get('n_spatial', '?')} spatial) --")
+        rec = probe.get("reconcile") or {}
+        for ax, v in sorted((rec.get("axes") or {}).items()):
+            line = (f"{ax} axis: measured "
+                    f"{_fmt(v.get('measured_s'), '.6f')}s/step at "
+                    f"{_fmt(v.get('probe_gbps'), '.2f')} Gbit/s")
+            if v.get("est_s") is not None:
+                line += (f" vs analytic {_fmt(v.get('est_s'), '.6f')}s "
+                         f"(delta {_fmt(v.get('delta_frac'), '.3f')})")
+            w(line)
+        if probe.get("measured_step_comms_s") is not None:
+            line = (f"per-step collective (measured): "
+                    f"{_fmt(probe['measured_step_comms_s'], '.6f')}s")
+            if rec.get("est_step_comms_s") is not None:
+                line += (f" vs analytic "
+                         f"{_fmt(rec.get('est_step_comms_s'), '.6f')}s "
+                         f"(delta {_fmt(rec.get('delta_frac'), '.3f')})")
+            w(line)
+
     lint = report.get("lint")
     if lint:
         counts = lint.get("counts") or {}
@@ -1147,6 +1271,10 @@ def main(argv=None) -> int:
     parser.add_argument("jsonl", help="telemetry stream to fold")
     parser.add_argument("--json", action="store_true",
                         help="emit the folded report as JSON instead of text")
+    parser.add_argument("--probe-json", action="store_true",
+                        help="emit only the last collective_probe payload "
+                             "as JSON (the round's measured-collective "
+                             "artifact; exits 3 when the stream has none)")
     args = parser.parse_args(argv)
     try:
         events, skipped = load_events(args.jsonl)
@@ -1154,6 +1282,18 @@ def main(argv=None) -> int:
         print(f"cannot read {args.jsonl}: {e}", file=sys.stderr)
         return 2
     report = fold(events, skipped)
+    if args.probe_json:
+        probe = report.get("collective_probe_rollup")
+        if not probe:
+            print(f"no collective_probe event in {args.jsonl}",
+                  file=sys.stderr)
+            return 3
+        try:
+            print(json.dumps(probe, indent=2, sort_keys=True,
+                             default=str))
+        except BrokenPipeError:
+            sys.stderr.close()
+        return 0
     lint = load_lint_verdict(args.jsonl)
     if lint is not None:
         report["lint"] = lint

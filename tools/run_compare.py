@@ -47,6 +47,13 @@ Axes for a stream pair (each gated by its own threshold flag):
                compiled reconciliation error must sit inside the
                census's own tolerance (10%) — census drift means the
                model or the sharding changed silently
+  train-trace  two gates (obs/train_trace.py): the interleaved
+               traced-vs-untraced pair prices the epoch tracer via
+               per-step wall p50 (--max_train_trace_overhead), and the
+               candidate's goodput phase seconds must agree with its
+               epoch span tiling within 5% of pass wall — the ledger
+               and the trace fold the same StepClock numbers, so a gap
+               means one of them lies
   transfer     a fine-tune (`transfer_init` in the stream) is gated
                against its parent run: final losses within
                --max_loss_increase of the parent's, epoch count at most
@@ -370,6 +377,69 @@ def stream_profile(events: List[dict], skipped: int = 0, name: str = "?") -> dic
         if census is not None else None
     census_tol = (_float(census.get("tolerance")) or 0.10) \
         if census is not None else None
+    # Train-trace observatory (PR-19): (a) mean per-step wall p50 over
+    # the train passes — the quantity the interleaved traced-vs-
+    # untraced overhead pair prices; (b) the worst per-epoch
+    # disagreement between the goodput ledger's phase seconds and the
+    # same phases re-derived from the epoch trace's span graph
+    # (dispatch-span attrs + pass-span geometry), normalized by the
+    # pass wall. The two are independent folds of the same StepClock
+    # numbers, so a gap means one of them dropped or double-counted
+    # seconds.
+    train_traces = [e for e in events if e.get("event") == "trace"
+                    and e.get("name") == "train_epoch"]
+    wall_p50s = [
+        v for e in events
+        if e.get("event") == "epoch_steps" and e.get("split") == "train"
+        and (v := _float(e.get("wall_p50_s"))) is not None]
+    train_step_p50 = (sum(wall_p50s) / len(wall_p50s)) \
+        if wall_p50s else None
+    trace_recon = None
+    if train_traces:
+        gp_by_epoch: Dict[int, dict] = {}
+        for e in events:
+            if e.get("event") == "goodput" and e.get("epoch") is not None:
+                gp_by_epoch[int(e["epoch"])] = e
+        for tr in train_traces:
+            ep = (tr.get("attrs") or {}).get("epoch")
+            gp = gp_by_epoch.get(int(ep)) if ep is not None else None
+            if gp is None:
+                continue
+            sums = {"compute": 0.0, "data_wait": 0.0, "host": 0.0}
+            passes_wall = 0.0
+            for span in tr.get("spans") or []:
+                # NB: keep this local distinct from the profile's
+                # `name` parameter (shadowing it mislabels the run).
+                sname = span.get("name")
+                attrs = span.get("attrs") or {}
+                if sname == "dispatch":
+                    sums["compute"] += float(
+                        attrs.get("fetch_block_s") or 0.0)
+                    sums["data_wait"] += float(
+                        attrs.get("data_wait_s") or 0.0)
+                    sums["host"] += (
+                        float(attrs.get("dispatch_s") or 0.0)
+                        + float(attrs.get("host_work_s") or 0.0))
+                elif isinstance(sname, str) and sname.endswith("_pass"):
+                    sums["compute"] += float(attrs.get("drain_s") or 0.0)
+                    t0, t1 = span.get("t0"), span.get("t1")
+                    if t0 is not None and t1 is not None:
+                        passes_wall += t1 - t0
+            ph = gp.get("phases_s") or {}
+
+            def g(p: str) -> float:
+                return float(ph.get(p) or 0.0)
+
+            denom = _float(gp.get("passes_wall_s")) or passes_wall
+            if not denom:
+                continue
+            err = max(
+                abs(sums["compute"] - (g("compute") + g("collective"))),
+                abs(sums["data_wait"] - g("data_wait")),
+                abs(sums["host"] - (g("host") + g("compile"))),
+            ) / denom
+            trace_recon = err if trace_recon is None \
+                else max(trace_recon, err)
     end = next((e for e in events if e.get("event") == "end"), None)
     halting = sum(1 for e in faults if e.get("policy") == "halt")
     if end is not None and end.get("status") == "health_fault":
@@ -400,6 +470,9 @@ def stream_profile(events: List[dict], skipped: int = 0, name: str = "?") -> dic
         "goodput_fraction": goodput,
         "census_recon_error": census_err,
         "census_tolerance": census_tol,
+        "train_traced": bool(train_traces),
+        "train_step_p50_s": train_step_p50,
+        "train_trace_recon": trace_recon,
         "end_status": end.get("status") if end else None,
     }
 
@@ -788,6 +861,35 @@ def _compare_streams(base: dict, cand: dict, th) -> List[Check]:
         checks.append((SKIP, "comms-census",
                        "no comms_census event in the candidate stream"))
 
+    # Train-trace axes (PR-19). (1) Overhead pair: when the candidate
+    # traced its epochs (--train_trace_sample > 0) and the base ran the
+    # identical config untraced, the per-step wall p50 prices the
+    # tracer — the span graph is built from timestamps the StepClock
+    # already takes, so it must cost ~nothing; past the budget it grew
+    # a hidden sync or allocation. (2) Candidate-side invariant: the
+    # goodput ledger's phase seconds and the epoch span tiling are two
+    # independent folds of the same clock — past 5% of pass wall, one
+    # of them is dropping or double-counting seconds.
+    b_p50 = base.get("train_step_p50_s")
+    c_p50 = cand.get("train_step_p50_s")
+    if cand.get("train_traced") and not base.get("train_traced") \
+            and b_p50 and c_p50:
+        oh = (c_p50 - b_p50) / b_p50
+        status = FAIL if oh > th.max_train_trace_overhead else PASS
+        checks.append((status, "train-trace overhead",
+                       f"per-step wall p50 {b_p50:.4f}s -> {c_p50:.4f}s "
+                       f"traced ({100 * oh:+.2f}% vs limit "
+                       f"{100 * th.max_train_trace_overhead:.1f}%)"))
+    recon = cand.get("train_trace_recon")
+    if recon is not None:
+        status = FAIL if recon > 0.05 else PASS
+        checks.append((status, "train-trace recon",
+                       f"goodput phases vs span tiling disagree by "
+                       f"{100 * recon:.2f}% of pass wall (limit 5%)"))
+    elif cand.get("train_traced"):
+        checks.append((SKIP, "train-trace recon",
+                       "train traces without matching goodput rollups"))
+
     # Elastic axis: engages when the candidate resharded across
     # topologies or emergency-saved mid-epoch. The claim under gate is
     # cross-mesh EQUIVALENCE: same per-step losses as the base, same
@@ -945,6 +1047,7 @@ def make_thresholds(
     max_elastic_loss_diff: float = 1e-5,
     max_transfer_epoch_frac: float = 0.25,
     max_trace_overhead: float = 0.03,
+    max_train_trace_overhead: float = 0.03,
     max_goodput_drop: float = 0.05,
     max_int8_fused_drift: float = 0.05,
     max_scaling_efficiency_drop: float = 0.05,
@@ -961,6 +1064,7 @@ def make_thresholds(
         max_elastic_loss_diff=max_elastic_loss_diff,
         max_transfer_epoch_frac=max_transfer_epoch_frac,
         max_trace_overhead=max_trace_overhead,
+        max_train_trace_overhead=max_train_trace_overhead,
         max_goodput_drop=max_goodput_drop,
         max_int8_fused_drift=max_int8_fused_drift,
         max_scaling_efficiency_drop=max_scaling_efficiency_drop,
@@ -998,6 +1102,11 @@ def main(argv=None) -> int:
                         help="max fractional throughput cost of serving "
                              "at --trace_sample 1.0 vs 0.0 (candidate-"
                              "side; bench_serve trace_overhead phase)")
+    parser.add_argument("--max_train_trace_overhead", default=0.03,
+                        type=float,
+                        help="max fractional per-step wall cost of "
+                             "training with --train_trace_sample > 0 vs "
+                             "an untraced base stream of the same config")
     parser.add_argument("--max_int8_fused_drift", default=0.05, type=float,
                         help="max unrounded max|int8_fused - f32| a "
                              "candidate bench_serve round may record for "

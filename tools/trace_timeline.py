@@ -38,6 +38,16 @@ _QUEUE_HOPS = ("admit", "queue")
 # Hop display order for the critical-path table.
 _HOP_ORDER = ("admit", "queue", "stack", "submit", "device", "resolve",
               "queued")
+# Training traces (obs/train_trace.py): one trace per epoch, named
+# "train_epoch", whose spans are passes -> dispatches -> hop children.
+_TRAIN_TRACE_NAME = "train_epoch"
+_TRAIN_HOP_ORDER = ("train_pass", "test_pass", "interlude", "startup",
+                    "dispatch", "drain", "data_wait", "submit",
+                    "device", "resolve", "host")
+
+
+def is_train_trace(tr: dict) -> bool:
+    return tr.get("name") == _TRAIN_TRACE_NAME
 
 
 def load_traces(path: str, limit: Optional[int] = None) -> List[dict]:
@@ -108,6 +118,19 @@ def _span_track(span: dict) -> str:
     return "queue"
 
 
+def _train_span_track(span: dict) -> str:
+    """Train spans get their own track family so an epoch renders as
+    passes over dispatches over hop detail, beside the serve tracks."""
+    name = span.get("name", "?")
+    if name.endswith("_pass") or name == "interlude":
+        return "train passes"
+    if name in ("dispatch", "startup", "drain"):
+        return "train dispatch"
+    if name == "device":
+        return "train device"
+    return "train hops"
+
+
 def export_perfetto(traces: List[dict]) -> dict:
     """Chrome trace-event JSON: ph "X" complete events on one pid,
     one tid per track, ph "M" thread_name metadata naming the tracks,
@@ -129,11 +152,19 @@ def export_perfetto(traces: List[dict]) -> dict:
     for tr in traces:
         tid_label = tr.get("trace_id", "?")
         attrs = tr.get("attrs") or {}
+        train = is_train_trace(tr)
         if tr.get("t_start") is not None and tr.get("t_end") is not None:
+            if train:
+                root_track = tracks.setdefault(
+                    "train epochs", len(tracks) + 1)
+                root_name = f"epoch {attrs.get('epoch', '?')}"
+            else:
+                root_track = tracks["requests"]
+                root_name = f"request {tid_label[:8]}"
             events.append({
-                "name": f"request {tid_label[:8]}",
+                "name": root_name,
                 "cat": tr.get("status", "?"),
-                "ph": "X", "pid": 1, "tid": tracks["requests"],
+                "ph": "X", "pid": 1, "tid": root_track,
                 "ts": us(tr["t_start"]),
                 "dur": round((tr["t_end"] - tr["t_start"]) * 1e6, 3),
                 "args": dict(attrs, trace_id=tid_label,
@@ -143,7 +174,8 @@ def export_perfetto(traces: List[dict]) -> dict:
             t_start, t_end = span.get("t0"), span.get("t1")
             if t_start is None or t_end is None:
                 continue
-            track = _span_track(span)
+            track = (_train_span_track(span) if train
+                     else _span_track(span))
             tid = tracks.setdefault(track, len(tracks) + 1)
             events.append({
                 "name": span.get("name", "?"),
@@ -157,11 +189,14 @@ def export_perfetto(traces: List[dict]) -> dict:
         for ev in tr.get("events") or []:
             if ev.get("t") is None:
                 continue
+            inst_track = (tracks.setdefault("train epochs",
+                                            len(tracks) + 1)
+                          if train else tracks["queue"])
             events.append({
                 "name": ev.get("name", "?"),
                 "cat": "decision",
                 "ph": "i", "s": "t",
-                "pid": 1, "tid": tracks["queue"],
+                "pid": 1, "tid": inst_track,
                 "ts": us(ev["t"]),
                 "args": dict({k: v for k, v in ev.items()
                               if k not in ("name", "t")},
@@ -241,6 +276,64 @@ def critical_path(traces: List[dict]) -> dict:
     return out
 
 
+def train_critical_path(traces: List[dict]) -> dict:
+    """Per-epoch table for train_epoch traces, same shape as
+    critical_path() so render_table works on both. recon_frac is the
+    span-tiling error: |sum(root children) - epoch wall| / wall, where
+    root children are the pass + interlude spans (device overlays and
+    hop children are parented deeper and excluded). For a cleanly
+    traced epoch this is ~0 by construction — the passes and interludes
+    tile the root span exactly (obs/train_trace.py)."""
+    groups: Dict[str, dict] = {}
+    for tr in traces:
+        if tr.get("status") == "?":
+            continue
+        attrs = tr.get("attrs") or {}
+        label = "epoch=%s" % attrs.get("epoch", "-")
+        g = groups.setdefault(
+            label, {"n": 0, "e2e": [], "hops": {}, "recon": []})
+        g["n"] += 1
+        dur = tr.get("dur_s")
+        if dur is not None:
+            g["e2e"].append(dur)
+        root_sum = 0.0
+        for span in tr["spans"]:
+            t0, t1 = span.get("t0"), span.get("t1")
+            if t0 is None or t1 is None:
+                continue
+            name = span.get("name", "?")
+            g["hops"].setdefault(name, []).append(t1 - t0)
+            sattrs = span.get("attrs") or {}
+            if not span.get("parent") and not sattrs.get("overlap"):
+                root_sum += t1 - t0
+        if dur and tr["spans"]:
+            g["recon"].append(abs(root_sum - dur) / dur)
+
+    def stats(vals: List[float]) -> dict:
+        s = sorted(vals)
+        return {
+            "n": len(s),
+            "mean_ms": round(sum(s) / len(s) * 1e3, 3) if s else None,
+            "p50_ms": round(_percentile(s, 0.5) * 1e3, 3) if s else None,
+            "p95_ms": round(_percentile(s, 0.95) * 1e3, 3) if s else None,
+        }
+
+    out = {}
+    for label, g in sorted(groups.items()):
+        hops = {h: stats(v) for h, v in g["hops"].items()}
+        ordered = {h: hops[h] for h in _TRAIN_HOP_ORDER if h in hops}
+        ordered.update({h: v for h, v in sorted(hops.items())
+                        if h not in ordered})
+        out[label] = {
+            "n": g["n"],
+            "e2e": stats(g["e2e"]),
+            "hops": ordered,
+            "recon_frac": (round(sum(g["recon"]) / len(g["recon"]), 6)
+                           if g["recon"] else None),
+        }
+    return out
+
+
 def render_table(table: dict) -> str:
     lines = []
     for label, g in table.items():
@@ -299,11 +392,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             json.dump(export_perfetto(traces), f)
         print(f"wrote {args.out}: {len(traces)} traces "
               f"(load at ui.perfetto.dev)", file=sys.stderr)
-    table = critical_path(traces)
+    train = [t for t in traces if is_train_trace(t)]
+    serve = [t for t in traces if not is_train_trace(t)]
+    table = critical_path(serve) if serve else {}
+    ttable = train_critical_path(train) if train else {}
     if args.json:
-        print(json.dumps(table, indent=2))
+        merged = dict(table)
+        merged.update(ttable)
+        print(json.dumps(merged, indent=2))
     else:
-        print(render_table(table))
+        if table:
+            print(render_table(table))
+        if ttable:
+            print("==== training epochs ====")
+            print(render_table(ttable))
     return 0
 
 
